@@ -1,0 +1,31 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A ground-up rebuild of the capabilities of Eclipse DeepLearning4j
+(reference: romibuzi/deeplearning4j) designed for TPU hardware:
+
+- jax/XLA is the compute substrate (in place of libnd4j CPU/CUDA kernels);
+  every op in the catalog lowers to StableHLO and runs on the MXU.
+- Autodiff is JAX tracing (in place of SameDiff's manual reverse-mode
+  graph construction).
+- Distribution is `jax.sharding.Mesh` + XLA collectives over ICI/DCN
+  (in place of the Aeron parameter server / Spark stack).
+- Checkpointing is orbax-style sharded state serialization.
+
+Public surface mirrors the reference stack layer-for-layer (see SURVEY.md):
+
+- :mod:`deeplearning4j_tpu.nn`          — layers/networks  (ref: deeplearning4j-nn)
+- :mod:`deeplearning4j_tpu.activations` — activations      (ref: nd4j activations)
+- :mod:`deeplearning4j_tpu.learning`    — updaters         (ref: nd4j linalg/learning)
+- :mod:`deeplearning4j_tpu.losses`      — loss functions   (ref: nd4j lossfunctions)
+- :mod:`deeplearning4j_tpu.weightinit`  — weight init      (ref: dl4j nn/weights)
+- :mod:`deeplearning4j_tpu.eval`        — evaluation       (ref: nd4j evaluation)
+- :mod:`deeplearning4j_tpu.optimize`    — listeners        (ref: dl4j optimize/listeners)
+- :mod:`deeplearning4j_tpu.datasets`    — data iterators   (ref: deeplearning4j-data)
+- :mod:`deeplearning4j_tpu.parallel`    — distributed      (ref: scaleout + param server)
+- :mod:`deeplearning4j_tpu.util`        — serialization    (ref: dl4j util/ModelSerializer)
+
+Landing next (SURVEY.md §7 build order): ndarray facade + op catalog,
+SameDiff-class graph autodiff, DataVec-class ETL, model zoo, importers.
+"""
+
+__version__ = "0.1.0"

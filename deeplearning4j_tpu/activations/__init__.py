@@ -1,0 +1,303 @@
+"""Activation catalog — 21 activations matching the reference set.
+
+Ref: nd4j-api `org/nd4j/linalg/activations/impl/Activation*.java` (21 impls)
+and the `Activation` enum in `org/nd4j/linalg/activations/Activation.java`.
+
+TPU-first: every activation is a pure jnp function; backprop comes from JAX
+autodiff (the reference hand-writes each `backprop()`); XLA fuses these into
+the surrounding matmul/conv epilogue so they cost ~0 extra HBM traffic.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class Activation:
+    """Base activation. Subclasses are stateless & hashable (usable as
+    static jit arguments and JSON-serializable by ``name``)."""
+
+    #: canonical lowercase name (matches reference ``Activation`` enum names)
+    name: str = "identity"
+
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        raise NotImplementedError
+
+    # -- serde ---------------------------------------------------------
+    def to_json(self) -> dict:
+        return {"@class": self.name}
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.__dict__ == other.__dict__
+
+    def __hash__(self):
+        return hash((type(self).__name__, tuple(sorted(self.__dict__.items()))))
+
+    def __repr__(self):
+        return f"Activation({self.name})"
+
+
+class Identity(Activation):
+    name = "identity"
+
+    def __call__(self, x):
+        return x
+
+
+class Sigmoid(Activation):
+    name = "sigmoid"
+
+    def __call__(self, x):
+        return jax.nn.sigmoid(x)
+
+
+class Tanh(Activation):
+    name = "tanh"
+
+    def __call__(self, x):
+        return jnp.tanh(x)
+
+
+class ReLU(Activation):
+    name = "relu"
+
+    def __call__(self, x):
+        return jax.nn.relu(x)
+
+
+class ReLU6(Activation):
+    name = "relu6"
+
+    def __call__(self, x):
+        return jax.nn.relu6(x)
+
+
+class LeakyReLU(Activation):
+    """Ref: ActivationLReLU.java (default alpha 0.01)."""
+
+    name = "leakyrelu"
+
+    def __init__(self, alpha: float = 0.01):
+        self.alpha = float(alpha)
+
+    def __call__(self, x):
+        return jax.nn.leaky_relu(x, self.alpha)
+
+    def to_json(self):
+        return {"@class": self.name, "alpha": self.alpha}
+
+
+class ELU(Activation):
+    """Ref: ActivationELU.java (default alpha 1.0)."""
+
+    name = "elu"
+
+    def __init__(self, alpha: float = 1.0):
+        self.alpha = float(alpha)
+
+    def __call__(self, x):
+        return jax.nn.elu(x, self.alpha)
+
+    def to_json(self):
+        return {"@class": self.name, "alpha": self.alpha}
+
+
+class SELU(Activation):
+    name = "selu"
+
+    def __call__(self, x):
+        return jax.nn.selu(x)
+
+
+class GELU(Activation):
+    """Ref: ActivationGELU.java — tanh approximation by default there;
+    we use the exact erf form (XLA lowers both efficiently on TPU)."""
+
+    name = "gelu"
+
+    def __init__(self, precise: bool = True):
+        self.precise = bool(precise)
+
+    def __call__(self, x):
+        return jax.nn.gelu(x, approximate=not self.precise)
+
+    def to_json(self):
+        return {"@class": self.name, "precise": self.precise}
+
+
+class Swish(Activation):
+    name = "swish"
+
+    def __call__(self, x):
+        return jax.nn.swish(x)
+
+
+class Softmax(Activation):
+    """Softmax over the last axis (reference applies over dim 1 of NCHW-style
+    2d activations, which is the feature/last axis in our NC layout)."""
+
+    name = "softmax"
+
+    def __call__(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+
+class SoftPlus(Activation):
+    name = "softplus"
+
+    def __call__(self, x):
+        return jax.nn.softplus(x)
+
+
+class SoftSign(Activation):
+    name = "softsign"
+
+    def __call__(self, x):
+        return jax.nn.soft_sign(x)
+
+
+class HardSigmoid(Activation):
+    """Ref: ActivationHardSigmoid.java — clip(0.2*x + 0.5, 0, 1)."""
+
+    name = "hardsigmoid"
+
+    def __call__(self, x):
+        return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+class HardTanh(Activation):
+    name = "hardtanh"
+
+    def __call__(self, x):
+        return jnp.clip(x, -1.0, 1.0)
+
+
+class Cube(Activation):
+    name = "cube"
+
+    def __call__(self, x):
+        return x * x * x
+
+
+class RationalTanh(Activation):
+    """Ref: ActivationRationalTanh.java —
+    1.7159 * tanh_approx(2x/3) with the rational tanh approximation
+    f(x) = clip_{-1,1}( 1.7159 * sgn(y)*(1 - 1/(1+|y|+y^2+1.41645*y^4)) )."""
+
+    name = "rationaltanh"
+
+    def __call__(self, x):
+        y = x * (2.0 / 3.0)
+        a = jnp.abs(y)
+        approx = jnp.sign(y) * (1.0 - 1.0 / (1.0 + a + y * y + 1.41645 * (y ** 4)))
+        return jnp.clip(1.7159 * approx, -1.0, 1.0)
+
+
+class RectifiedTanh(Activation):
+    """Ref: ActivationRectifiedTanh.java — max(0, tanh(x))."""
+
+    name = "rectifiedtanh"
+
+    def __call__(self, x):
+        return jnp.maximum(0.0, jnp.tanh(x))
+
+
+class ThresholdedReLU(Activation):
+    """Ref: ActivationThresholdedReLU.java — x if x > theta else 0."""
+
+    name = "thresholdedrelu"
+
+    def __init__(self, theta: float = 1.0):
+        self.theta = float(theta)
+
+    def __call__(self, x):
+        return jnp.where(x > self.theta, x, jnp.zeros_like(x))
+
+    def to_json(self):
+        return {"@class": self.name, "theta": self.theta}
+
+
+class PReLU(Activation):
+    """Parametric ReLU. The learnable alpha lives in the owning layer's
+    params (ref: ActivationPReLU.java holds an alpha INDArray); call with
+    the alpha array via :meth:`apply_with_alpha`."""
+
+    name = "prelu"
+
+    def __call__(self, x):  # default alpha 0.01 when used standalone
+        return jax.nn.leaky_relu(x, 0.01)
+
+    @staticmethod
+    def apply_with_alpha(x, alpha):
+        return jnp.where(x >= 0, x, alpha * x)
+
+
+class RReLU(Activation):
+    """Randomized leaky ReLU (ref: ActivationRReLU.java, l=1/8, u=1/3).
+    Train mode samples alpha ~ U(l,u) (pass an rng key); eval uses the
+    mean (l+u)/2."""
+
+    name = "rrelu"
+
+    def __init__(self, l: float = 1.0 / 8.0, u: float = 1.0 / 3.0):
+        self.l = float(l)
+        self.u = float(u)
+
+    def __call__(self, x, rng: Optional[jax.Array] = None, train: bool = False):
+        if train and rng is not None:
+            alpha = jax.random.uniform(rng, x.shape, x.dtype, self.l, self.u)
+        else:
+            alpha = (self.l + self.u) / 2.0
+        return jnp.where(x >= 0, x, alpha * x)
+
+    def to_json(self):
+        return {"@class": self.name, "l": self.l, "u": self.u}
+
+
+class Mish(Activation):
+    """x * tanh(softplus(x)) — present in later reference versions; cheap
+    on TPU and used by some YOLO variants."""
+
+    name = "mish"
+
+    def __call__(self, x):
+        return x * jnp.tanh(jax.nn.softplus(x))
+
+
+_REGISTRY: Dict[str, type] = {}
+for _cls in list(globals().values()):
+    if isinstance(_cls, type) and issubclass(_cls, Activation) and _cls is not Activation:
+        _REGISTRY[_cls.name] = _cls
+
+
+def get(spec) -> Activation:
+    """Resolve an activation from an Activation instance, a name string
+    (reference enum style, case-insensitive), or a dict from to_json()."""
+    if isinstance(spec, Activation):
+        return spec
+    if callable(spec) and not isinstance(spec, str):
+        fn = spec
+
+        class _Wrapped(Activation):
+            name = getattr(spec, "__name__", "custom")
+
+            def __call__(self, x):
+                return fn(x)
+
+        return _Wrapped()
+    if isinstance(spec, dict):
+        d = dict(spec)
+        name = d.pop("@class")
+        return _REGISTRY[name](**d)
+    name = str(spec).lower().replace("_", "")
+    if name == "lrelu":
+        name = "leakyrelu"
+    if name not in _REGISTRY:
+        raise ValueError(f"Unknown activation: {spec!r}. Known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]()
+
+
+def names():
+    return sorted(_REGISTRY)

@@ -1,0 +1,280 @@
+"""Datasets + iterators.
+
+Ref: nd4j `DataSet` (features/labels/masks), dl4j `DataSetIterator` SPI,
+`AsyncDataSetIterator` (prefetch threads wrapped around fit —
+`MultiLayerNetwork.java:1584-1587`), fetchers in
+`deeplearning4j-data/deeplearning4j-datasets/.../fetchers/`.
+
+TPU-first: the iterator yields fixed-shape host numpy batches (static
+shapes keep one compiled XLA program per stage); `AsyncDataSetIterator`
+overlaps host ETL with device steps via a background thread, the analogue
+of the reference's prefetch queue. Device transfer happens inside the
+jitted step.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import queue
+import struct
+import threading
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+
+class DataSet:
+    """Ref: nd4j `org.nd4j.linalg.dataset.DataSet` — features, labels,
+    optional masks."""
+
+    def __init__(self, features, labels, features_mask=None, labels_mask=None):
+        self.features = features
+        self.labels = labels
+        self.features_mask = features_mask
+        self.labels_mask = labels_mask
+
+    def num_examples(self) -> int:
+        return int(np.asarray(self.features).shape[0])
+
+    def split_test_and_train(self, n_train: int) -> Tuple["DataSet", "DataSet"]:
+        f, l = np.asarray(self.features), np.asarray(self.labels)
+        return (DataSet(f[:n_train], l[:n_train]),
+                DataSet(f[n_train:], l[n_train:]))
+
+    def shuffle(self, seed: int = 0):
+        rng = np.random.RandomState(seed)
+        idx = rng.permutation(self.num_examples())
+        self.features = np.asarray(self.features)[idx]
+        self.labels = np.asarray(self.labels)[idx]
+        if self.features_mask is not None:
+            self.features_mask = np.asarray(self.features_mask)[idx]
+        if self.labels_mask is not None:
+            self.labels_mask = np.asarray(self.labels_mask)[idx]
+
+
+class DataSetIterator:
+    """Base iterator SPI (ref: `org.nd4j.linalg.dataset.api.iterator.
+    DataSetIterator`). Iterating yields (features, labels[, labels_mask])
+    tuples of numpy arrays."""
+
+    def __iter__(self) -> Iterator:
+        self.reset()
+        return self
+
+    def __next__(self):
+        if not self.has_next():
+            raise StopIteration
+        return self.next()
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next(self):
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+    def batch_size(self) -> int:
+        raise NotImplementedError
+
+
+class ArrayDataSetIterator(DataSetIterator):
+    """Minibatches over in-memory arrays (ref: ListDataSetIterator /
+    ExistingDataSetIterator). Drops the ragged final batch by default —
+    static shapes mean a single compiled program (TPU-first choice; pass
+    keep_last=True for parity with the reference's variable last batch)."""
+
+    def __init__(self, features, labels, batch: int = 32, shuffle: bool = False,
+                 seed: int = 0, keep_last: bool = False, labels_mask=None):
+        self.features = np.asarray(features)
+        self.labels = np.asarray(labels)
+        self.labels_mask = None if labels_mask is None else np.asarray(labels_mask)
+        self.batch = int(batch)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.keep_last = keep_last
+        self._order = np.arange(self.features.shape[0])
+        self._pos = 0
+        self._epoch = 0
+
+    def reset(self):
+        self._pos = 0
+        if self.shuffle:
+            rng = np.random.RandomState(self.seed + self._epoch)
+            self._order = rng.permutation(self.features.shape[0])
+        self._epoch += 1
+
+    def has_next(self) -> bool:
+        remaining = self.features.shape[0] - self._pos
+        return remaining >= self.batch or (self.keep_last and remaining > 0)
+
+    def next(self):
+        idx = self._order[self._pos:self._pos + self.batch]
+        self._pos += len(idx)
+        if self.labels_mask is not None:
+            return (self.features[idx], self.labels[idx], self.labels_mask[idx])
+        return (self.features[idx], self.labels[idx])
+
+    def batch_size(self) -> int:
+        return self.batch
+
+    def total_examples(self) -> int:
+        return self.features.shape[0]
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch wrapper (ref: AsyncDataSetIterator —
+    queue of pre-loaded batches so host ETL overlaps device compute).
+
+    reset() is generation-safe: each generation gets its own queue + stop
+    event, the worker closes over them (never touches self.*), and the old
+    worker is stopped and joined before the base iterator is reset — so a
+    stale worker can neither race the base nor poison the new queue."""
+
+    _DONE = object()
+
+    def __init__(self, base: DataSetIterator, prefetch: int = 2):
+        self.base = base
+        self.prefetch = prefetch
+        self._queue: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop: Optional[threading.Event] = None
+        self._next_item = None
+
+    def reset(self):
+        if self._thread is not None and self._thread.is_alive():
+            self._stop.set()
+            # drain so a worker blocked on put() can observe the stop flag
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=5)
+        self.base.reset()
+        q = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+        base = self.base
+        done = self._DONE
+
+        def worker():
+            try:
+                while not stop.is_set() and base.has_next():
+                    item = base.next()
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+            finally:
+                if not stop.is_set():
+                    q.put(done)
+
+        self._queue = q
+        self._stop = stop
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+        self._advance()
+
+    def _advance(self):
+        item = self._queue.get()
+        self._next_item = None if item is self._DONE else item
+
+    def has_next(self) -> bool:
+        if self._queue is None:
+            self.reset()
+        return self._next_item is not None
+
+    def next(self):
+        item = self._next_item
+        self._advance()
+        return item
+
+    def batch_size(self) -> int:
+        return self.base.batch_size()
+
+
+# ---------------------------------------------------------------------------
+# Fetchers (ref: MnistDataFetcher etc.). Zero-egress environment: these read
+# from well-known local caches and otherwise fall back to deterministic
+# synthetic data so tests/benchmarks run hermetically.
+# ---------------------------------------------------------------------------
+
+_MNIST_DIRS = [
+    os.path.expanduser("~/.deeplearning4j_tpu/mnist"),
+    os.path.expanduser("~/.cache/mnist"),
+    "/root/data/mnist",
+    "/data/mnist",
+]
+
+
+def _read_idx_images(path: str) -> np.ndarray:
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n, h, w = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, f"bad magic {magic}"
+        return np.frombuffer(f.read(), np.uint8).reshape(n, h, w)
+
+
+def _read_idx_labels(path: str) -> np.ndarray:
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        assert magic == 2049, f"bad magic {magic}"
+        return np.frombuffer(f.read(), np.uint8)
+
+
+def _find_mnist() -> Optional[str]:
+    names = ["train-images-idx3-ubyte", "train-labels-idx1-ubyte",
+             "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"]
+    for d in _MNIST_DIRS:
+        if not os.path.isdir(d):
+            continue
+        ok = all(os.path.exists(os.path.join(d, n)) or
+                 os.path.exists(os.path.join(d, n + ".gz")) for n in names)
+        if ok:
+            return d
+    return None
+
+
+def _synthetic_mnist(n: int, seed: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic learnable stand-in: each class is a distinct blob
+    pattern + noise. Lets LeNet-style models reach high accuracy so the
+    end-to-end path is exercised for real."""
+    # class prototypes are FIXED (shared by train and test splits); only
+    # noise and label draws vary with `seed`
+    protos = np.random.RandomState(424242).rand(10, 28, 28) > 0.75
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, n)
+    imgs = protos[labels].astype(np.float32) * 0.8
+    imgs += rng.rand(n, 28, 28).astype(np.float32) * 0.3
+    return (imgs * 255).clip(0, 255).astype(np.uint8), labels.astype(np.uint8)
+
+
+class MnistDataSetIterator(ArrayDataSetIterator):
+    """Ref: `deeplearning4j-datasets/.../iterator/impl/MnistDataSetIterator.java`.
+    Features normalized to [0,1], flattened to 784 (reference default), or
+    NHWC images with `flatten=False`."""
+
+    def __init__(self, batch: int, train: bool = True, shuffle: bool = True,
+                 seed: int = 6, flatten: bool = True, num_examples: Optional[int] = None):
+        d = _find_mnist()
+        self.synthetic = d is None
+        if d is not None:
+            prefix = "train" if train else "t10k"
+            def p(name):
+                full = os.path.join(d, name)
+                return full if os.path.exists(full) else full + ".gz"
+            imgs = _read_idx_images(p(f"{prefix}-images-idx3-ubyte"))
+            labels = _read_idx_labels(p(f"{prefix}-labels-idx1-ubyte"))
+        else:
+            n = num_examples or (10000 if train else 2000)
+            imgs, labels = _synthetic_mnist(n, seed=1 if train else 2)
+        if num_examples:
+            imgs, labels = imgs[:num_examples], labels[:num_examples]
+        feats = imgs.astype(np.float32) / 255.0
+        feats = feats.reshape(len(feats), -1) if flatten else feats[..., None]
+        onehot = np.eye(10, dtype=np.float32)[labels]
+        super().__init__(feats, onehot, batch=batch, shuffle=shuffle, seed=seed)

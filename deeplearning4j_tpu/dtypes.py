@@ -1,0 +1,87 @@
+"""Data type zoo.
+
+Mirrors the reference dtype surface (ref: nd4j-api
+`org/nd4j/linalg/api/buffer/DataType.java` — DOUBLE/FLOAT/HALF/LONG/INT/
+SHORT/UBYTE/BYTE/BOOL/UTF8/COMPRESSED/BFLOAT16...) mapped onto jax dtypes.
+
+TPU-first notes: BFLOAT16 is the native MXU compute type; FLOAT (f32) is
+the accumulation type. HALF maps to jnp.float16 (supported but slower than
+bf16 on TPU). UTF8/COMPRESSED have no device representation and are
+host-side concepts handled by the ETL layer.
+"""
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class DataType(enum.Enum):
+    """Element types, names matching the reference enum."""
+
+    DOUBLE = "double"
+    FLOAT = "float"
+    HALF = "half"
+    BFLOAT16 = "bfloat16"
+    LONG = "long"
+    INT = "int"
+    SHORT = "short"
+    UBYTE = "ubyte"
+    BYTE = "byte"
+    UINT16 = "uint16"
+    UINT32 = "uint32"
+    UINT64 = "uint64"
+    BOOL = "bool"
+    UTF8 = "utf8"
+
+    @property
+    def jax_dtype(self):
+        return _TO_JAX[self]
+
+    @property
+    def is_fp(self) -> bool:
+        return self in (DataType.DOUBLE, DataType.FLOAT, DataType.HALF, DataType.BFLOAT16)
+
+    @property
+    def is_int(self) -> bool:
+        return self in (
+            DataType.LONG, DataType.INT, DataType.SHORT, DataType.UBYTE,
+            DataType.BYTE, DataType.UINT16, DataType.UINT32, DataType.UINT64,
+        )
+
+    @property
+    def width(self) -> int:
+        """Bytes per element."""
+        return np.dtype(_TO_JAX[self]).itemsize
+
+    @classmethod
+    def from_jax(cls, dtype) -> "DataType":
+        return _FROM_JAX[np.dtype(dtype).name]
+
+
+_TO_JAX = {
+    DataType.DOUBLE: jnp.float64,
+    DataType.FLOAT: jnp.float32,
+    DataType.HALF: jnp.float16,
+    DataType.BFLOAT16: jnp.bfloat16,
+    DataType.LONG: jnp.int64,
+    DataType.INT: jnp.int32,
+    DataType.SHORT: jnp.int16,
+    DataType.UBYTE: jnp.uint8,
+    DataType.BYTE: jnp.int8,
+    DataType.UINT16: jnp.uint16,
+    DataType.UINT32: jnp.uint32,
+    DataType.UINT64: jnp.uint64,
+    DataType.BOOL: jnp.bool_,
+}
+
+_FROM_JAX = {np.dtype(v).name: k for k, v in _TO_JAX.items()}
+# UTF8 has no jax mapping; host-side only.
+
+#: Default floating-point type for parameters/activations. f32 params with
+#: bf16 compute is the standard TPU recipe; modules read this at init time.
+default_float = jnp.float32
+
+#: Default matmul/conv compute type on TPU (MXU-native).
+compute_dtype = jnp.bfloat16

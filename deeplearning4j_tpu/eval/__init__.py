@@ -1,0 +1,271 @@
+"""Evaluation stack.
+
+Ref: nd4j-api `org/nd4j/evaluation/classification/Evaluation.java:84`
+(confusion-matrix accuracy/precision/recall/F1), `EvaluationBinary`,
+`ROC/ROCBinary/ROCMultiClass`, `EvaluationCalibration`, and
+`regression/RegressionEvaluation.java`.
+
+Host-side numpy: evaluation is aggregation of small statistics; keeping it
+off-device avoids recompiles for ragged final batches. The per-batch model
+forward still runs on TPU; only argmax'd outputs land here.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class Evaluation:
+    """Multi-class classification evaluation (ref: Evaluation.java)."""
+
+    def __init__(self, num_classes: Optional[int] = None, labels: Optional[List[str]] = None):
+        self.num_classes = num_classes
+        self.label_names = labels
+        self._conf: Optional[np.ndarray] = None  # [actual, predicted]
+
+    def _ensure(self, n: int):
+        if self._conf is None:
+            self.num_classes = self.num_classes or n
+            self._conf = np.zeros((self.num_classes, self.num_classes), np.int64)
+
+    def eval(self, labels: np.ndarray, predictions: np.ndarray,
+             mask: Optional[np.ndarray] = None):
+        """labels/predictions: one-hot or prob arrays [N, C] (or [N, T, C]
+        with optional [N, T] mask — time-series flattened, ref
+        evalTimeSeries)."""
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:
+            if mask is not None:
+                keep = np.asarray(mask).reshape(-1).astype(bool)
+            else:
+                keep = np.ones(labels.shape[0] * labels.shape[1], bool)
+            labels = labels.reshape(-1, labels.shape[-1])[keep]
+            predictions = predictions.reshape(-1, predictions.shape[-1])[keep]
+        elif mask is not None:
+            keep = np.asarray(mask).reshape(-1).astype(bool)
+            labels = labels[keep]
+            predictions = predictions[keep]
+        self._ensure(labels.shape[-1])
+        actual = labels.argmax(-1)
+        pred = predictions.argmax(-1)
+        np.add.at(self._conf, (actual, pred), 1)
+
+    # -- metrics (names mirror the reference methods) -------------------
+    def accuracy(self) -> float:
+        c = self._conf
+        return float(np.trace(c)) / max(c.sum(), 1)
+
+    def precision(self, cls: Optional[int] = None) -> float:
+        c = self._conf
+        if cls is not None:
+            denom = c[:, cls].sum()
+            return float(c[cls, cls]) / denom if denom else 0.0
+        vals = [self.precision(i) for i in range(self.num_classes)
+                if c[:, i].sum() + c[i, :].sum() > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def recall(self, cls: Optional[int] = None) -> float:
+        c = self._conf
+        if cls is not None:
+            denom = c[cls, :].sum()
+            return float(c[cls, cls]) / denom if denom else 0.0
+        vals = [self.recall(i) for i in range(self.num_classes)
+                if c[:, i].sum() + c[i, :].sum() > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def f1(self, cls: Optional[int] = None) -> float:
+        p, r = self.precision(cls), self.recall(cls)
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+    def false_positive_rate(self, cls: int) -> float:
+        c = self._conf
+        fp = c[:, cls].sum() - c[cls, cls]
+        tn = c.sum() - c[cls, :].sum() - c[:, cls].sum() + c[cls, cls]
+        return float(fp) / (fp + tn) if (fp + tn) else 0.0
+
+    def confusion_matrix(self) -> np.ndarray:
+        return self._conf.copy()
+
+    def stats(self) -> str:
+        lines = [
+            "========================Evaluation Metrics========================",
+            f" # of classes:    {self.num_classes}",
+            f" Accuracy:        {self.accuracy():.4f}",
+            f" Precision:       {self.precision():.4f}",
+            f" Recall:          {self.recall():.4f}",
+            f" F1 Score:        {self.f1():.4f}",
+            "=================================================================",
+        ]
+        return "\n".join(lines)
+
+
+class EvaluationBinary:
+    """Per-output binary evaluation (ref: EvaluationBinary.java)."""
+
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = threshold
+        self.tp = self.fp = self.tn = self.fn = None
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels) > 0.5
+        pred = np.asarray(predictions) > self.threshold
+        if self.tp is None:
+            n = labels.shape[-1]
+            self.tp = np.zeros(n, np.int64)
+            self.fp = np.zeros(n, np.int64)
+            self.tn = np.zeros(n, np.int64)
+            self.fn = np.zeros(n, np.int64)
+        w = np.ones(labels.shape) if mask is None else np.asarray(mask)
+        if w.ndim == labels.ndim - 1:
+            w = w[..., None] * np.ones(labels.shape)
+        axis = tuple(range(labels.ndim - 1))
+        self.tp += (w * (labels & pred)).sum(axis).astype(np.int64)
+        self.fp += (w * (~labels & pred)).sum(axis).astype(np.int64)
+        self.tn += (w * (~labels & ~pred)).sum(axis).astype(np.int64)
+        self.fn += (w * (labels & ~pred)).sum(axis).astype(np.int64)
+
+    def accuracy(self, i: int) -> float:
+        tot = self.tp[i] + self.fp[i] + self.tn[i] + self.fn[i]
+        return float(self.tp[i] + self.tn[i]) / tot if tot else 0.0
+
+    def precision(self, i: int) -> float:
+        d = self.tp[i] + self.fp[i]
+        return float(self.tp[i]) / d if d else 0.0
+
+    def recall(self, i: int) -> float:
+        d = self.tp[i] + self.fn[i]
+        return float(self.tp[i]) / d if d else 0.0
+
+    def f1(self, i: int) -> float:
+        p, r = self.precision(i), self.recall(i)
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+
+class ROC:
+    """Binary ROC/AUC with exact thresholding (ref: ROC.java with
+    thresholdSteps=0 → exact mode)."""
+
+    def __init__(self):
+        self._scores: List[np.ndarray] = []
+        self._labels: List[np.ndarray] = []
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim > 1 and labels.shape[-1] == 2:
+            labels = labels[..., 1]
+            predictions = predictions[..., 1]
+        self._labels.append(labels.reshape(-1))
+        self._scores.append(predictions.reshape(-1))
+
+    def _curve_points(self):
+        """Cumulative (tps, fps) sampled only at distinct-threshold
+        boundaries, so tied scores form one ROC point (order-independent)."""
+        y = np.concatenate(self._labels)
+        s = np.concatenate(self._scores)
+        order = np.argsort(-s, kind="stable")
+        s_sorted = s[order]
+        y = y[order] > 0.5
+        tps = np.cumsum(y)
+        fps = np.cumsum(~y)
+        # last index of each tie group
+        boundary = np.r_[np.where(np.diff(s_sorted))[0], len(y) - 1]
+        return y, tps[boundary], fps[boundary]
+
+    def auc(self) -> float:
+        y, tps, fps = self._curve_points()
+        P, N = y.sum(), (~y).sum()
+        if P == 0 or N == 0:
+            return 0.5
+        tpr = np.concatenate([[0], tps / P])
+        fpr = np.concatenate([[0], fps / N])
+        return float(np.trapezoid(tpr, fpr))
+
+    def auprc(self) -> float:
+        y, tps, fps = self._curve_points()
+        P = y.sum()
+        if P == 0:
+            return 0.0
+        precision = tps / (tps + fps)
+        recall = tps / P
+        return float(np.trapezoid(np.r_[precision[:1], precision],
+                                  np.r_[0, recall]))
+
+
+class ROCMultiClass:
+    """One-vs-all ROC per class (ref: ROCMultiClass.java)."""
+
+    def __init__(self):
+        self._rocs: Dict[int, ROC] = {}
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        for c in range(labels.shape[-1]):
+            self._rocs.setdefault(c, ROC()).eval(labels[..., c], predictions[..., c])
+
+    def auc(self, cls: int) -> float:
+        return self._rocs[cls].auc()
+
+    def average_auc(self) -> float:
+        return float(np.mean([r.auc() for r in self._rocs.values()]))
+
+
+class RegressionEvaluation:
+    """Column-wise regression metrics (ref: RegressionEvaluation.java:
+    MSE, MAE, RMSE, RSE, PC, R^2)."""
+
+    def __init__(self):
+        self._sum_sq = None
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels, np.float64)
+        pred = np.asarray(predictions, np.float64)
+        labels = labels.reshape(-1, labels.shape[-1])
+        pred = pred.reshape(-1, pred.shape[-1])
+        if self._sum_sq is None:
+            n = labels.shape[-1]
+            self._n = np.zeros(n)
+            self._sum_sq = np.zeros(n)
+            self._sum_abs = np.zeros(n)
+            self._sum_lab = np.zeros(n)
+            self._sum_lab_sq = np.zeros(n)
+            self._sum_pred = np.zeros(n)
+            self._sum_pred_sq = np.zeros(n)
+            self._sum_labpred = np.zeros(n)
+        d = labels - pred
+        self._n += labels.shape[0]
+        self._sum_sq += (d ** 2).sum(0)
+        self._sum_abs += np.abs(d).sum(0)
+        self._sum_lab += labels.sum(0)
+        self._sum_lab_sq += (labels ** 2).sum(0)
+        self._sum_pred += pred.sum(0)
+        self._sum_pred_sq += (pred ** 2).sum(0)
+        self._sum_labpred += (labels * pred).sum(0)
+
+    def mean_squared_error(self, col: int) -> float:
+        return float(self._sum_sq[col] / self._n[col])
+
+    def mean_absolute_error(self, col: int) -> float:
+        return float(self._sum_abs[col] / self._n[col])
+
+    def root_mean_squared_error(self, col: int) -> float:
+        return float(np.sqrt(self.mean_squared_error(col)))
+
+    def r_squared(self, col: int) -> float:
+        n = self._n[col]
+        ss_tot = self._sum_lab_sq[col] - self._sum_lab[col] ** 2 / n
+        return float(1.0 - self._sum_sq[col] / ss_tot) if ss_tot else 0.0
+
+    def pearson_correlation(self, col: int) -> float:
+        n = self._n[col]
+        cov = self._sum_labpred[col] - self._sum_lab[col] * self._sum_pred[col] / n
+        vl = self._sum_lab_sq[col] - self._sum_lab[col] ** 2 / n
+        vp = self._sum_pred_sq[col] - self._sum_pred[col] ** 2 / n
+        d = np.sqrt(vl * vp)
+        return float(cov / d) if d else 0.0
+
+    def average_mean_squared_error(self) -> float:
+        return float(np.mean(self._sum_sq / self._n))

@@ -1,0 +1,369 @@
+"""Updater (optimizer) catalog — the reference's 11 gradient updaters.
+
+Ref: nd4j-api `org/nd4j/linalg/learning/*Updater.java` (AdaDelta, AdaGrad,
+AdaMax, Adam, AMSGrad, Nadam, Nesterovs, NoOp, RmsProp, Sgd) and their
+config classes in `linalg/learning/config/`.
+
+Design (TPU-first): an `Updater` is a config object exposing
+  - init_state(params)  -> state pytree (same structure as params)
+  - apply(state, grads, step) -> (new_state, updates)
+where `updates` are SUBTRACTED from params. Everything is pure and
+jit-traceable; `step` is a traced counter so bias correction and LR
+schedules compile into the step program (the reference mutates updater
+state buffers in place — here state flows functionally, which is what
+makes the optimizer shardable with the params under pjit).
+
+The same classes serve as the per-layer `updater=` config in the NN DSL
+(ref: `linalg/learning/config/IUpdater` used by BaseLayer configs).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from . import schedules
+from .schedules import Schedule, FixedSchedule
+
+
+def _lr_at(lr, step):
+    if isinstance(lr, Schedule):
+        return lr(jnp.asarray(step))
+    return jnp.asarray(lr, jnp.float32)
+
+
+def _zeros_like_tree(params):
+    return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+
+def _unzip(n, fn, *trees):
+    """tree_map `fn` (returning an n-tuple) over `trees`, then transpose into
+    an n-tuple of trees. Uses treedefs rather than leaf-type guessing, so
+    params pytrees that themselves contain tuples are handled correctly."""
+    outer = jax.tree_util.tree_structure(trees[0])
+    tup_tree = jax.tree_util.tree_map(fn, *trees)
+    inner = jax.tree_util.tree_structure(tuple(range(n)))
+    return jax.tree_util.tree_transpose(outer, inner, tup_tree)
+
+
+class Updater:
+    """Base updater config."""
+
+    name = "updater"
+
+    def __init__(self, learning_rate=1e-3):
+        self.learning_rate = schedules.get(learning_rate) if isinstance(
+            learning_rate, (dict, Schedule)) else learning_rate
+
+    # -- state ---------------------------------------------------------
+    def init_state(self, params) -> Any:
+        return ()
+
+    def apply(self, state, grads, step):
+        """Returns (new_state, updates). updates are subtracted from params."""
+        raise NotImplementedError
+
+    def lr(self, step):
+        return _lr_at(self.learning_rate, step)
+
+    # -- serde ---------------------------------------------------------
+    def to_json(self) -> dict:
+        d = {"@class": self.name}
+        for k, v in self.__dict__.items():
+            if isinstance(v, Schedule):
+                d[k] = v.to_json()
+            else:
+                d[k] = v
+        return d
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.to_json() == other.to_json()
+
+    def __hash__(self):
+        return hash((type(self).__name__,))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.__dict__})"
+
+
+class Sgd(Updater):
+    """Ref: SgdUpdater.java — update = lr * g."""
+
+    name = "sgd"
+
+    def __init__(self, learning_rate=0.1):
+        super().__init__(learning_rate)
+
+    def apply(self, state, grads, step):
+        lr = self.lr(step)
+        return state, jax.tree_util.tree_map(lambda g: lr * g, grads)
+
+
+class NoOp(Updater):
+    """Ref: NoOpUpdater.java — passes the gradient through unchanged."""
+
+    name = "noop"
+
+    def __init__(self):
+        super().__init__(0.0)
+
+    def to_json(self):
+        return {"@class": self.name}
+
+    def apply(self, state, grads, step):
+        return state, grads
+
+
+class Nesterovs(Updater):
+    """Ref: NesterovsUpdater.java — momentum with Nesterov correction:
+    vPrev = v; v = mu*v - lr*g; update = -(mu*vPrev - (1+mu)*v)."""
+
+    name = "nesterovs"
+
+    def __init__(self, learning_rate=0.1, momentum=0.9):
+        super().__init__(learning_rate)
+        self.momentum = float(momentum)
+
+    def init_state(self, params):
+        return _zeros_like_tree(params)
+
+    def apply(self, state, grads, step):
+        lr = self.lr(step)
+        mu = self.momentum
+
+        def upd(v, g):
+            v_new = mu * v - lr * g
+            return v_new, mu * v - (1 + mu) * v_new  # note: subtracted later
+
+        new_state, updates = _unzip(2, upd, state, grads)
+        return new_state, updates
+
+
+class AdaGrad(Updater):
+    """Ref: AdaGradUpdater.java — h += g^2; update = lr*g/(sqrt(h)+eps)."""
+
+    name = "adagrad"
+
+    def __init__(self, learning_rate=0.1, epsilon=1e-6):
+        super().__init__(learning_rate)
+        self.epsilon = float(epsilon)
+
+    def init_state(self, params):
+        return _zeros_like_tree(params)
+
+    def apply(self, state, grads, step):
+        lr = self.lr(step)
+
+        def upd(h, g):
+            h_new = h + jnp.square(g)
+            return h_new, lr * g / (jnp.sqrt(h_new) + self.epsilon)
+
+        return _unzip(2, upd, state, grads)
+
+
+class RmsProp(Updater):
+    """Ref: RmsPropUpdater.java — r = d*r + (1-d)*g^2; update = lr*g/sqrt(r+eps)."""
+
+    name = "rmsprop"
+
+    def __init__(self, learning_rate=0.1, rms_decay=0.95, epsilon=1e-8):
+        super().__init__(learning_rate)
+        self.rms_decay = float(rms_decay)
+        self.epsilon = float(epsilon)
+
+    def init_state(self, params):
+        # ref RmsPropUpdater.java seeds the cache with epsilon
+        return jax.tree_util.tree_map(lambda p: jnp.full_like(p, self.epsilon), params)
+
+    def apply(self, state, grads, step):
+        lr = self.lr(step)
+        d = self.rms_decay
+
+        def upd(r, g):
+            r_new = d * r + (1 - d) * jnp.square(g)
+            return r_new, lr * g / (jnp.sqrt(r_new) + self.epsilon)
+
+        return _unzip(2, upd, state, grads)
+
+
+class AdaDelta(Updater):
+    """Ref: AdaDeltaUpdater.java — no LR; rho-averaged squared grads and
+    squared updates."""
+
+    name = "adadelta"
+
+    def __init__(self, rho=0.95, epsilon=1e-6):
+        super().__init__(0.0)
+        self.rho = float(rho)
+        self.epsilon = float(epsilon)
+
+    def to_json(self):
+        return {"@class": self.name, "rho": self.rho, "epsilon": self.epsilon}
+
+    def init_state(self, params):
+        return {"msg": _zeros_like_tree(params), "msdx": _zeros_like_tree(params)}
+
+    def apply(self, state, grads, step):
+        rho, eps = self.rho, self.epsilon
+
+        def upd(msg, msdx, g):
+            msg_new = rho * msg + (1 - rho) * jnp.square(g)
+            dx = jnp.sqrt(msdx + eps) / jnp.sqrt(msg_new + eps) * g
+            msdx_new = rho * msdx + (1 - rho) * jnp.square(dx)
+            return msg_new, msdx_new, dx
+
+        msg, msdx, dx = _unzip(3, upd, state["msg"], state["msdx"], grads)
+        return {"msg": msg, "msdx": msdx}, dx
+
+
+class Adam(Updater):
+    """Ref: AdamUpdater.java:72 — bias-corrected first/second moments."""
+
+    name = "adam"
+
+    def __init__(self, learning_rate=1e-3, beta1=0.9, beta2=0.999, epsilon=1e-8):
+        super().__init__(learning_rate)
+        self.beta1 = float(beta1)
+        self.beta2 = float(beta2)
+        self.epsilon = float(epsilon)
+
+    def init_state(self, params):
+        return {"m": _zeros_like_tree(params), "v": _zeros_like_tree(params)}
+
+    def apply(self, state, grads, step):
+        step = jnp.asarray(step)
+        lr = self.lr(step)
+        t = step.astype(jnp.float32) + 1.0
+        b1, b2 = self.beta1, self.beta2
+        bc = jnp.sqrt(1.0 - jnp.power(b2, t)) / (1.0 - jnp.power(b1, t))
+
+        def upd(m, v, g):
+            m_new = b1 * m + (1 - b1) * g
+            v_new = b2 * v + (1 - b2) * jnp.square(g)
+            return m_new, v_new, lr * bc * m_new / (jnp.sqrt(v_new) + self.epsilon)
+
+        m, v, upds = _unzip(3, upd, state["m"], state["v"], grads)
+        return {"m": m, "v": v}, upds
+
+
+class AdaMax(Updater):
+    """Ref: AdaMaxUpdater.java — infinity-norm Adam variant."""
+
+    name = "adamax"
+
+    def __init__(self, learning_rate=1e-3, beta1=0.9, beta2=0.999, epsilon=1e-8):
+        super().__init__(learning_rate)
+        self.beta1 = float(beta1)
+        self.beta2 = float(beta2)
+        self.epsilon = float(epsilon)
+
+    def init_state(self, params):
+        return {"m": _zeros_like_tree(params), "u": _zeros_like_tree(params)}
+
+    def apply(self, state, grads, step):
+        step = jnp.asarray(step)
+        lr = self.lr(step)
+        t = step.astype(jnp.float32) + 1.0
+        b1, b2 = self.beta1, self.beta2
+        bc = 1.0 / (1.0 - jnp.power(b1, t))
+
+        def upd(m, u, g):
+            m_new = b1 * m + (1 - b1) * g
+            u_new = jnp.maximum(b2 * u, jnp.abs(g))
+            return m_new, u_new, lr * bc * m_new / (u_new + self.epsilon)
+
+        m, u, upds = _unzip(3, upd, state["m"], state["u"], grads)
+        return {"m": m, "u": u}, upds
+
+
+class AMSGrad(Updater):
+    """Ref: AMSGradUpdater.java — Adam with a max over past v."""
+
+    name = "amsgrad"
+
+    def __init__(self, learning_rate=1e-3, beta1=0.9, beta2=0.999, epsilon=1e-8):
+        super().__init__(learning_rate)
+        self.beta1 = float(beta1)
+        self.beta2 = float(beta2)
+        self.epsilon = float(epsilon)
+
+    def init_state(self, params):
+        z = _zeros_like_tree(params)
+        return {"m": z, "v": _zeros_like_tree(params), "vhat": _zeros_like_tree(params)}
+
+    def apply(self, state, grads, step):
+        step = jnp.asarray(step)
+        lr = self.lr(step)
+        t = step.astype(jnp.float32) + 1.0
+        b1, b2 = self.beta1, self.beta2
+        bc = jnp.sqrt(1.0 - jnp.power(b2, t)) / (1.0 - jnp.power(b1, t))
+
+        def upd(m, v, vh, g):
+            m_new = b1 * m + (1 - b1) * g
+            v_new = b2 * v + (1 - b2) * jnp.square(g)
+            vh_new = jnp.maximum(vh, v_new)
+            return m_new, v_new, vh_new, lr * bc * m_new / (jnp.sqrt(vh_new) + self.epsilon)
+
+        m, v, vhat, upds = _unzip(4, upd, state["m"], state["v"], state["vhat"], grads)
+        return {"m": m, "v": v, "vhat": vhat}, upds
+
+
+class Nadam(Updater):
+    """Ref: NadamUpdater.java — Adam with Nesterov momentum."""
+
+    name = "nadam"
+
+    def __init__(self, learning_rate=1e-3, beta1=0.9, beta2=0.999, epsilon=1e-8):
+        super().__init__(learning_rate)
+        self.beta1 = float(beta1)
+        self.beta2 = float(beta2)
+        self.epsilon = float(epsilon)
+
+    def init_state(self, params):
+        return {"m": _zeros_like_tree(params), "v": _zeros_like_tree(params)}
+
+    def apply(self, state, grads, step):
+        step = jnp.asarray(step)
+        lr = self.lr(step)
+        t = step.astype(jnp.float32) + 1.0
+        b1, b2 = self.beta1, self.beta2
+        one_minus_b1t = 1.0 - jnp.power(b1, t)
+
+        def upd(m, v, g):
+            m_new = b1 * m + (1 - b1) * g
+            v_new = b2 * v + (1 - b2) * jnp.square(g)
+            m_bar = b1 * m_new / one_minus_b1t + (1 - b1) * g / one_minus_b1t
+            # ref NadamUpdater.java divides by sqrt(raw v) + eps (no v bias correction)
+            return m_new, v_new, lr * m_bar / (jnp.sqrt(v_new) + self.epsilon)
+
+        m, v, upds = _unzip(3, upd, state["m"], state["v"], grads)
+        return {"m": m, "v": v}, upds
+
+
+_REGISTRY: Dict[str, type] = {c.name: c for c in
+                              [Sgd, NoOp, Nesterovs, AdaGrad, RmsProp, AdaDelta,
+                               Adam, AdaMax, AMSGrad, Nadam]}
+
+
+def get(spec) -> Updater:
+    if isinstance(spec, Updater):
+        return spec
+    if isinstance(spec, dict):
+        d = dict(spec)
+        name = d.pop("@class")
+        lr = d.pop("learning_rate", None)
+        kwargs = dict(d)
+        if lr is not None:
+            if isinstance(lr, dict):
+                lr = schedules.get(lr)
+            kwargs["learning_rate"] = lr
+        return _REGISTRY[name](**kwargs)
+    name = str(spec).lower()
+    if name not in _REGISTRY:
+        raise ValueError(f"Unknown updater: {spec!r}. Known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]()
+
+
+def names():
+    return sorted(_REGISTRY)

@@ -1,0 +1,178 @@
+"""Learning-rate schedules.
+
+Ref: nd4j-api `org/nd4j/linalg/schedule/` — ISchedule impls
+(ExponentialSchedule, InverseSchedule, MapSchedule, PolySchedule,
+SigmoidSchedule, StepSchedule) with ScheduleType {ITERATION, EPOCH}.
+
+TPU-first: schedules are pure functions of a traced step counter so the
+whole training step stays inside one jit program (no host round-trip to
+update the LR between steps, unlike the reference's Java-side applySchedules).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Schedule:
+    name = "schedule"
+
+    def __call__(self, step):
+        """step: traced int32/int64 scalar (iteration or epoch per scheduleType)."""
+        raise NotImplementedError
+
+    def to_json(self):
+        d = {"@class": self.name}
+        d.update({k: v for k, v in self.__dict__.items() if not k.startswith("_")})
+        return d
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.__dict__ == other.__dict__
+
+    def __hash__(self):
+        return hash((type(self).__name__, tuple(sorted(self.__dict__.items(), key=lambda kv: kv[0]))))
+
+
+class FixedSchedule(Schedule):
+    name = "fixed"
+
+    def __init__(self, value: float):
+        self.value = float(value)
+
+    def __call__(self, step):
+        step = jnp.asarray(step)
+        return jnp.asarray(self.value, jnp.float32)
+
+
+class ExponentialSchedule(Schedule):
+    """lr = initial * gamma^step (ref: ExponentialSchedule.java)."""
+
+    name = "exponential"
+
+    def __init__(self, initial_value: float, gamma: float):
+        self.initial_value = float(initial_value)
+        self.gamma = float(gamma)
+
+    def __call__(self, step):
+        step = jnp.asarray(step)
+        return self.initial_value * jnp.power(self.gamma, step.astype(jnp.float32))
+
+
+class InverseSchedule(Schedule):
+    """lr = initial / (1 + gamma*step)^power (ref: InverseSchedule.java)."""
+
+    name = "inverse"
+
+    def __init__(self, initial_value: float, gamma: float, power: float):
+        self.initial_value = float(initial_value)
+        self.gamma = float(gamma)
+        self.power = float(power)
+
+    def __call__(self, step):
+        step = jnp.asarray(step)
+        return self.initial_value / jnp.power(1.0 + self.gamma * step.astype(jnp.float32), self.power)
+
+
+class PolySchedule(Schedule):
+    """lr = initial * (1 - step/maxStep)^power (ref: PolySchedule.java)."""
+
+    name = "poly"
+
+    def __init__(self, initial_value: float, power: float, max_iter: int):
+        self.initial_value = float(initial_value)
+        self.power = float(power)
+        self.max_iter = int(max_iter)
+
+    def __call__(self, step):
+        step = jnp.asarray(step)
+        frac = jnp.clip(step.astype(jnp.float32) / self.max_iter, 0.0, 1.0)
+        return self.initial_value * jnp.power(1.0 - frac, self.power)
+
+
+class SigmoidSchedule(Schedule):
+    """lr = initial / (1 + exp(gamma*(step - stepSize))) (ref: SigmoidSchedule.java)."""
+
+    name = "sigmoid"
+
+    def __init__(self, initial_value: float, gamma: float, step_size: int):
+        self.initial_value = float(initial_value)
+        self.gamma = float(gamma)
+        self.step_size = int(step_size)
+
+    def __call__(self, step):
+        step = jnp.asarray(step)
+        return self.initial_value / (1.0 + jnp.exp(self.gamma * (step.astype(jnp.float32) - self.step_size)))
+
+
+class StepSchedule(Schedule):
+    """lr = initial * decay^floor(step/stepSize) (ref: StepSchedule.java)."""
+
+    name = "step"
+
+    def __init__(self, initial_value: float, decay_rate: float, step_size: int):
+        self.initial_value = float(initial_value)
+        self.decay_rate = float(decay_rate)
+        self.step_size = int(step_size)
+
+    def __call__(self, step):
+        step = jnp.asarray(step)
+        return self.initial_value * jnp.power(self.decay_rate,
+                                              jnp.floor(step.astype(jnp.float32) / self.step_size))
+
+
+class MapSchedule(Schedule):
+    """Piecewise-constant map {step: lr} (ref: MapSchedule.java). Lowered to
+    a chain of wheres so it stays jit-compatible."""
+
+    name = "map"
+
+    def __init__(self, values: dict):
+        self.values = {int(k): float(v) for k, v in values.items()}
+        if 0 not in self.values:
+            raise ValueError("MapSchedule must define a value for step 0")
+
+    def __call__(self, step):
+        step = jnp.asarray(step)
+        keys = sorted(self.values)
+        out = jnp.asarray(self.values[keys[0]], jnp.float32)
+        for k in keys[1:]:
+            out = jnp.where(step >= k, self.values[k], out)
+        return out
+
+    def __hash__(self):
+        return hash(tuple(sorted(self.values.items())))
+
+
+class WarmupCosineSchedule(Schedule):
+    """Linear warmup then cosine decay — not in the 2019 reference but the
+    standard TPU-era schedule; provided for BERT/ResNet parity runs."""
+
+    name = "warmupcosine"
+
+    def __init__(self, peak: float, warmup_steps: int, total_steps: int, floor: float = 0.0):
+        self.peak = float(peak)
+        self.warmup_steps = int(warmup_steps)
+        self.total_steps = int(total_steps)
+        self.floor = float(floor)
+
+    def __call__(self, step):
+        s = jnp.asarray(step).astype(jnp.float32)
+        warm = self.peak * s / jnp.maximum(self.warmup_steps, 1)
+        frac = jnp.clip((s - self.warmup_steps) / jnp.maximum(self.total_steps - self.warmup_steps, 1), 0.0, 1.0)
+        cos = self.floor + (self.peak - self.floor) * 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+        return jnp.where(s < self.warmup_steps, warm, cos)
+
+
+_REGISTRY = {c.name: c for c in
+             [FixedSchedule, ExponentialSchedule, InverseSchedule, PolySchedule,
+              SigmoidSchedule, StepSchedule, MapSchedule, WarmupCosineSchedule]}
+
+
+def get(spec):
+    if isinstance(spec, Schedule):
+        return spec
+    if isinstance(spec, (int, float)):
+        return FixedSchedule(spec)
+    if isinstance(spec, dict):
+        d = dict(spec)
+        return _REGISTRY[d.pop("@class")](**d)
+    raise ValueError(f"Unknown schedule spec: {spec!r}")

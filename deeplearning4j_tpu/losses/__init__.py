@@ -1,0 +1,377 @@
+"""Loss-function catalog — the reference's 17+ loss impls.
+
+Ref: nd4j-api `org/nd4j/linalg/lossfunctions/impl/Loss*.java` and the
+`ILossFunction` SPI (`lossfunctions/ILossFunction.java`: computeScore /
+computeScoreArray / computeGradient).
+
+Design: each loss takes (labels, preout, activation, mask) where `preout`
+is the layer pre-activation and `activation` the output activation — the
+same contract as the reference's ILossFunction. This lets softmax/sigmoid
+cross-entropies fuse the activation for numerical stability (the reference
+special-cases this in LossMCXENT/LossBinaryXENT; we use logsumexp forms).
+`computeGradient` is unnecessary: JAX differentiates `score`.
+
+All reductions follow the reference: `score_array` returns one score per
+example (sum over output dims), `score` averages over the minibatch.
+Per-output weight vectors are supported where the reference supports them.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..activations import Activation, Identity, Sigmoid, Softmax, get as get_activation
+
+_EPS = 1e-7
+
+
+def _apply_mask(per_out: jnp.ndarray, mask: Optional[jnp.ndarray]) -> jnp.ndarray:
+    if mask is None:
+        return per_out
+    mask = mask.astype(per_out.dtype)
+    if mask.ndim == per_out.ndim - 1:
+        mask = mask[..., None]
+    return per_out * mask
+
+
+def _sum_per_example(per_out: jnp.ndarray) -> jnp.ndarray:
+    """Sum everything but the leading (example) axis."""
+    return per_out.reshape(per_out.shape[0], -1).sum(axis=-1)
+
+
+class LossFunction:
+    """Base loss. Stateless, hashable, JSON-serializable by name."""
+
+    name: str = "loss"
+
+    def __init__(self, weights=None):
+        self.weights = None if weights is None else jnp.asarray(weights)
+
+    # -- core contract -------------------------------------------------
+    def per_output(self, labels, preout, activation: Activation) -> jnp.ndarray:
+        """Unreduced loss, same shape as labels."""
+        raise NotImplementedError
+
+    def score_array(self, labels, preout, activation: Activation = Identity(),
+                    mask=None) -> jnp.ndarray:
+        per = self.per_output(labels, preout, activation)
+        if self.weights is not None:
+            per = per * self.weights
+        per = _apply_mask(per, mask)
+        return _sum_per_example(per)
+
+    def score(self, labels, preout, activation: Activation = Identity(),
+              mask=None, average: bool = True) -> jnp.ndarray:
+        s = self.score_array(labels, preout, activation, mask).sum()
+        if average:
+            n = labels.shape[0] if mask is None else jnp.maximum(
+                mask.reshape(mask.shape[0], -1).max(axis=-1).sum(), 1)
+            s = s / n
+        return s
+
+    # -- serde ---------------------------------------------------------
+    def to_json(self) -> dict:
+        d = {"@class": self.name}
+        for k, v in self.__dict__.items():
+            if k == "weights":
+                if v is not None:
+                    d["weights"] = [float(w) for w in v]
+            else:
+                d[k] = v
+        return d
+
+    def __eq__(self, other):
+        if type(self) is not type(other):
+            return False
+        a, b = dict(self.__dict__), dict(other.__dict__)
+        wa, wb = a.pop("weights", None), b.pop("weights", None)
+        if (wa is None) != (wb is None):
+            return False
+        if wa is not None and not jnp.array_equal(wa, wb):
+            return False
+        return a == b
+
+    def __hash__(self):
+        return hash(type(self).__name__)
+
+    def __repr__(self):
+        return f"Loss({self.name})"
+
+
+class LossMSE(LossFunction):
+    """Mean squared error — per-output (y-yhat)^2 / nOut (ref: LossMSE =
+    LossL2 / nOut)."""
+
+    name = "mse"
+
+    def per_output(self, labels, preout, activation):
+        out = activation(preout)
+        n_out = labels.shape[-1]
+        return jnp.square(labels - out) / n_out
+
+
+class LossL2(LossFunction):
+    name = "l2"
+
+    def per_output(self, labels, preout, activation):
+        return jnp.square(labels - activation(preout))
+
+
+class LossMAE(LossFunction):
+    name = "mae"
+
+    def per_output(self, labels, preout, activation):
+        n_out = labels.shape[-1]
+        return jnp.abs(labels - activation(preout)) / n_out
+
+
+class LossL1(LossFunction):
+    name = "l1"
+
+    def per_output(self, labels, preout, activation):
+        return jnp.abs(labels - activation(preout))
+
+
+class LossMAPE(LossFunction):
+    name = "mape"
+
+    def per_output(self, labels, preout, activation):
+        n_out = labels.shape[-1]
+        return 100.0 / n_out * jnp.abs((labels - activation(preout)) /
+                                       jnp.where(jnp.abs(labels) < _EPS, _EPS, labels))
+
+
+class LossMSLE(LossFunction):
+    name = "msle"
+
+    def per_output(self, labels, preout, activation):
+        out = activation(preout)
+        n_out = labels.shape[-1]
+        return jnp.square(jnp.log1p(jnp.maximum(out, -1 + _EPS)) -
+                          jnp.log1p(jnp.maximum(labels, -1 + _EPS))) / n_out
+
+
+class LossMCXENT(LossFunction):
+    """Multi-class cross entropy. With a Softmax output activation this is
+    computed in fused log-softmax form (stable); otherwise -sum(y*log(yhat))
+    with clipping, matching the reference's softmaxClipEps behavior."""
+
+    name = "mcxent"
+
+    def __init__(self, weights=None, clip_eps: float = 1e-10):
+        super().__init__(weights)
+        self.clip_eps = float(clip_eps)
+
+    def per_output(self, labels, preout, activation):
+        if isinstance(activation, Softmax):
+            logp = jax.nn.log_softmax(preout, axis=-1)
+            return -(labels * logp)
+        out = jnp.clip(activation(preout), self.clip_eps, 1.0 - self.clip_eps)
+        return -(labels * jnp.log(out))
+
+
+class LossNegativeLogLikelihood(LossMCXENT):
+    """Ref: LossNegativeLogLikelihood extends LossMCXENT."""
+
+    name = "negativeloglikelihood"
+
+
+class LossBinaryXENT(LossFunction):
+    """Binary cross entropy; fused sigmoid form when the output activation
+    is Sigmoid (ref: LossBinaryXENT with clipping eps 1e-5)."""
+
+    name = "binaryxent"
+
+    def __init__(self, weights=None, clip_eps: float = 1e-5):
+        super().__init__(weights)
+        self.clip_eps = float(clip_eps)
+
+    def per_output(self, labels, preout, activation):
+        if isinstance(activation, Sigmoid):
+            # stable: max(x,0) - x*y + log(1+exp(-|x|))
+            x = preout
+            return jnp.maximum(x, 0) - x * labels + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        out = jnp.clip(activation(preout), self.clip_eps, 1.0 - self.clip_eps)
+        return -(labels * jnp.log(out) + (1.0 - labels) * jnp.log1p(-out))
+
+
+class LossXENT(LossBinaryXENT):
+    name = "xent"
+
+
+class LossHinge(LossFunction):
+    name = "hinge"
+
+    def per_output(self, labels, preout, activation):
+        # labels in {-1, +1}
+        return jnp.maximum(0.0, 1.0 - labels * activation(preout))
+
+
+class LossSquaredHinge(LossFunction):
+    name = "squaredhinge"
+
+    def per_output(self, labels, preout, activation):
+        return jnp.square(jnp.maximum(0.0, 1.0 - labels * activation(preout)))
+
+
+class LossKLD(LossFunction):
+    name = "kld"
+
+    def per_output(self, labels, preout, activation):
+        out = jnp.clip(activation(preout), _EPS, 1.0 - _EPS)
+        lab = jnp.clip(labels, _EPS, 1.0)
+        return lab * (jnp.log(lab) - jnp.log(out))
+
+
+class LossPoisson(LossFunction):
+    name = "poisson"
+
+    def per_output(self, labels, preout, activation):
+        out = jnp.maximum(activation(preout), _EPS)
+        return out - labels * jnp.log(out)
+
+
+class LossCosineProximity(LossFunction):
+    """Ref: LossCosineProximity — score per example is -cos(labels, out)."""
+
+    name = "cosineproximity"
+
+    def per_output(self, labels, preout, activation):
+        out = activation(preout)
+        ln = jnp.linalg.norm(labels, axis=-1, keepdims=True)
+        on = jnp.linalg.norm(out, axis=-1, keepdims=True)
+        cos = (labels * out) / jnp.maximum(ln * on, _EPS)
+        return -cos
+
+
+class LossFMeasure(LossFunction):
+    """Differentiable (soft) F-beta for binary problems (ref: LossFMeasure,
+    beta default 1). Computed over the whole minibatch — score_array
+    distributes the batch score evenly (the reference does the same:
+    computeScoreArray divides by n)."""
+
+    name = "fmeasure"
+
+    def __init__(self, beta: float = 1.0):
+        super().__init__(None)
+        self.beta = float(beta)
+
+    def _batch_score(self, labels, preout, activation):
+        out = activation(preout)
+        if labels.shape[-1] == 2:  # two-column one-hot form
+            y, p = labels[..., 1], out[..., 1]
+        else:
+            y, p = labels[..., 0], out[..., 0]
+        tp = jnp.sum(y * p)
+        fp = jnp.sum((1 - y) * p)
+        fn = jnp.sum(y * (1 - p))
+        b2 = self.beta ** 2
+        num = (1 + b2) * tp
+        den = (1 + b2) * tp + b2 * fn + fp
+        # ref LossFMeasure.computeScore: score is 0 when num and den are both 0
+        return jnp.where(den < _EPS, 0.0, 1.0 - num / jnp.maximum(den, _EPS))
+
+    def score_array(self, labels, preout, activation=Identity(), mask=None):
+        n = labels.shape[0]
+        s = self._batch_score(labels, preout, activation)
+        return jnp.full((n,), s / n)
+
+    def score(self, labels, preout, activation=Identity(), mask=None, average=True):
+        # F-measure is a whole-batch score (ref computeScore); score_array
+        # spreads it per-example, so don't divide by n a second time here.
+        return self._batch_score(labels, preout, activation)
+
+
+class LossMultiLabel(LossFunction):
+    """Rank loss over positive/negative label pairs (ref: LossMultiLabel —
+    exp(negative - positive) pairwise, normalized)."""
+
+    name = "multilabel"
+
+    def score_array(self, labels, preout, activation=Identity(), mask=None):
+        out = activation(preout)
+        pos = labels > 0.5
+        # pairwise differences out_j - out_i for (i positive, j negative)
+        diff = out[:, None, :] - out[:, :, None]     # [n, out_i, out_j]
+        pair = pos[:, :, None] & (~pos[:, None, :])  # positive i, negative j
+        cnt = jnp.maximum(pair.reshape(labels.shape[0], -1).sum(-1), 1)
+        val = jnp.where(pair, jnp.exp(diff), 0.0)
+        per = val.reshape(labels.shape[0], -1).sum(-1) / cnt
+        if mask is not None:
+            per = per * mask.reshape(mask.shape[0], -1).max(-1)
+        return per
+
+
+class LossWasserstein(LossFunction):
+    """Ref: LossWasserstein — mean(labels * preout) per example."""
+
+    name = "wasserstein"
+
+    def per_output(self, labels, preout, activation):
+        return labels * activation(preout) / labels.shape[-1]
+
+
+class LossMixtureDensity(LossFunction):
+    """Mixture-density network loss (ref: LossMixtureDensity). preout packs
+    [alpha | sigma | mu] for `mixtures` gaussians over `labels_width` dims;
+    negative log of the gaussian mixture likelihood."""
+
+    name = "mixturedensity"
+
+    def __init__(self, mixtures: int, labels_width: int):
+        super().__init__(None)
+        self.mixtures = int(mixtures)
+        self.labels_width = int(labels_width)
+
+    def score_array(self, labels, preout, activation=Identity(), mask=None):
+        m, w = self.mixtures, self.labels_width
+        alpha = jax.nn.log_softmax(preout[..., :m], axis=-1)
+        sigma = jnp.exp(preout[..., m:2 * m])
+        mu = preout[..., 2 * m:2 * m + m * w].reshape(*preout.shape[:-1], m, w)
+        lab = labels[..., None, :]  # [..., 1, w]
+        log_norm = -0.5 * w * jnp.log(2 * jnp.pi) - w * jnp.log(sigma)
+        sq = -0.5 * jnp.sum(jnp.square(lab - mu), axis=-1) / jnp.square(sigma)
+        log_like = jax.scipy.special.logsumexp(alpha + log_norm + sq, axis=-1)
+        per = -log_like
+        if per.ndim > 1:
+            per = _sum_per_example(per)
+        if mask is not None:
+            per = per * mask.reshape(mask.shape[0], -1).max(-1)
+        return per
+
+
+_REGISTRY = {}
+for _cls in list(globals().values()):
+    if isinstance(_cls, type) and issubclass(_cls, LossFunction) and _cls is not LossFunction:
+        _REGISTRY[_cls.name] = _cls
+
+# Reference `LossFunctions.LossFunction` enum aliases
+_ALIASES = {
+    "squared_loss": "l2",
+    "reconstruction_crossentropy": "binaryxent",
+    "cosine_proximity": "cosineproximity",
+    "mean_absolute_error": "mae",
+    "mean_squared_logarithmic_error": "msle",
+    "mean_absolute_percentage_error": "mape",
+    "kl_divergence": "kld",
+}
+
+
+def get(spec) -> LossFunction:
+    if isinstance(spec, LossFunction):
+        return spec
+    if isinstance(spec, dict):
+        d = dict(spec)
+        name = d.pop("@class")
+        return _REGISTRY[name](**d)
+    name = str(spec).lower()
+    name = _ALIASES.get(name, name)
+    if name not in _REGISTRY:
+        raise ValueError(f"Unknown loss: {spec!r}. Known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]()
+
+
+def names():
+    return sorted(_REGISTRY)

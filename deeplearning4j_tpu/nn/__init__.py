@@ -1,0 +1,9 @@
+"""Neural-network framework (ref: deeplearning4j-nn).
+
+- :mod:`.conf`   — configuration DSL (NeuralNetConfiguration builder, JSON round-trip)
+- :mod:`.layers` — layer catalog (Dense, Conv, Subsampling, BatchNorm, LSTM, ...)
+- :mod:`.multilayer` — MultiLayerNetwork (sequential stack + fit/evaluate)
+- :mod:`.graph` — ComputationGraph (arbitrary DAG)
+"""
+from .conf import NeuralNetConfiguration, MultiLayerConfiguration  # noqa: F401
+from .multilayer import MultiLayerNetwork  # noqa: F401

@@ -1,0 +1,254 @@
+"""Configuration DSL.
+
+Ref: deeplearning4j-nn `nn/conf/NeuralNetConfiguration.java` (builder at
+:~400, ListBuilder), `MultiLayerConfiguration.java` (JSON round-trip via
+Jackson — here: plain-JSON `to_json`/`from_json`).
+
+The builder mirrors the reference's fluent surface:
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(123)
+            .updater(Adam(1e-3))
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=500, activation="relu"))
+            .layer(OutputLayer(n_out=10, loss="mcxent", activation="softmax"))
+            .input_type_convolutional(28, 28, 1)
+            .build())
+    model = MultiLayerNetwork(conf)
+
+Workspace/cache modes from the reference are accepted and recorded for API
+parity but are no-ops: XLA owns memory planning on TPU (SURVEY.md §7 hard
+part 6).
+"""
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Sequence, Tuple
+
+from ... import learning as U
+from ..layers import Layer, from_json as layer_from_json
+
+Shape = Tuple[int, ...]
+
+
+class InputType:
+    """Ref: `nn/conf/inputs/InputType.java` — feedForward / recurrent /
+    convolutional (here NHWC) / convolutionalFlat."""
+
+    def __init__(self, kind: str, shape: Shape):
+        self.kind = kind
+        self.shape = tuple(int(s) for s in shape)
+
+    @staticmethod
+    def feed_forward(size: int) -> "InputType":
+        return InputType("ff", (size,))
+
+    @staticmethod
+    def recurrent(size: int, timesteps: Optional[int] = None) -> "InputType":
+        return InputType("rnn", (timesteps or -1, size))
+
+    @staticmethod
+    def convolutional(height: int, width: int, channels: int) -> "InputType":
+        return InputType("cnn", (height, width, channels))  # NHWC
+
+    @staticmethod
+    def convolutional_flat(height: int, width: int, channels: int) -> "InputType":
+        return InputType("cnnflat", (height, width, channels))
+
+    def to_json(self):
+        return {"kind": self.kind, "shape": list(self.shape)}
+
+    @staticmethod
+    def from_json(d):
+        return InputType(d["kind"], tuple(d["shape"]))
+
+
+class MultiLayerConfiguration:
+    """Ref: `nn/conf/MultiLayerConfiguration.java`."""
+
+    def __init__(self, layers: List[Layer], seed: int = 12345,
+                 updater=None, defaults: Optional[dict] = None,
+                 input_type: Optional[InputType] = None,
+                 tbptt_fwd_length: int = 0, tbptt_bwd_length: int = 0,
+                 max_grad_norm: Optional[float] = None,
+                 grad_clip_value: Optional[float] = None,
+                 dtype: str = "float"):
+        self.layers = layers
+        self.seed = int(seed)
+        self.updater = U.get(updater) if updater is not None else U.Sgd(0.1)
+        self.defaults = defaults or {}
+        self.input_type = input_type
+        self.tbptt_fwd_length = tbptt_fwd_length
+        self.tbptt_bwd_length = tbptt_bwd_length
+        self.max_grad_norm = max_grad_norm      # GradientNormalization.ClipL2PerLayer analog
+        self.grad_clip_value = grad_clip_value  # ClipElementWiseAbsoluteValue analog
+        self.dtype = dtype
+
+    # -- serde (the JSON round-trip property that powers golden-file tests
+    # and Keras import in the reference) ---------------------------------
+    def to_json(self) -> str:
+        return json.dumps({
+            "seed": self.seed,
+            "updater": self.updater.to_json(),
+            "defaults": {k: (v.to_json() if hasattr(v, "to_json") else v)
+                         for k, v in self.defaults.items()},
+            "input_type": self.input_type.to_json() if self.input_type else None,
+            "tbptt_fwd_length": self.tbptt_fwd_length,
+            "tbptt_bwd_length": self.tbptt_bwd_length,
+            "max_grad_norm": self.max_grad_norm,
+            "grad_clip_value": self.grad_clip_value,
+            "dtype": self.dtype,
+            "layers": [l.to_json() for l in self.layers],
+        }, indent=2)
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        d = json.loads(s)
+        defaults = d.get("defaults", {})
+        if isinstance(defaults.get("updater"), dict):
+            defaults["updater"] = U.get(defaults["updater"])
+        return MultiLayerConfiguration(
+            layers=[layer_from_json(ld) for ld in d["layers"]],
+            seed=d.get("seed", 12345),
+            updater=U.get(d["updater"]) if d.get("updater") else None,
+            defaults=defaults,
+            input_type=InputType.from_json(d["input_type"]) if d.get("input_type") else None,
+            tbptt_fwd_length=d.get("tbptt_fwd_length", 0),
+            tbptt_bwd_length=d.get("tbptt_bwd_length", 0),
+            max_grad_norm=d.get("max_grad_norm"),
+            grad_clip_value=d.get("grad_clip_value"),
+            dtype=d.get("dtype", "float"),
+        )
+
+
+class ListBuilder:
+    """Ref: NeuralNetConfiguration.ListBuilder."""
+
+    def __init__(self, base: "NeuralNetConfiguration"):
+        self._base = base
+        self._layers: List[Layer] = []
+        self._input_type: Optional[InputType] = None
+        self._tbptt = (0, 0)
+
+    def layer(self, layer: Layer) -> "ListBuilder":
+        self._layers.append(layer)
+        return self
+
+    def input_type(self, it: InputType) -> "ListBuilder":
+        self._input_type = it
+        return self
+
+    def input_type_feed_forward(self, size: int) -> "ListBuilder":
+        return self.input_type(InputType.feed_forward(size))
+
+    def input_type_convolutional(self, h: int, w: int, c: int) -> "ListBuilder":
+        return self.input_type(InputType.convolutional(h, w, c))
+
+    def input_type_recurrent(self, size: int, timesteps: Optional[int] = None) -> "ListBuilder":
+        return self.input_type(InputType.recurrent(size, timesteps))
+
+    def tbptt(self, fwd: int, bwd: Optional[int] = None) -> "ListBuilder":
+        self._tbptt = (fwd, bwd if bwd is not None else fwd)
+        return self
+
+    def build(self) -> MultiLayerConfiguration:
+        b = self._base
+        return MultiLayerConfiguration(
+            layers=self._layers, seed=b._seed, updater=b._updater,
+            defaults=b._defaults(), input_type=self._input_type,
+            tbptt_fwd_length=self._tbptt[0], tbptt_bwd_length=self._tbptt[1],
+            max_grad_norm=b._max_grad_norm, grad_clip_value=b._grad_clip_value,
+            dtype=b._dtype)
+
+
+class NeuralNetConfiguration:
+    """Fluent builder. Ref: `nn/conf/NeuralNetConfiguration.Builder`."""
+
+    def __init__(self):
+        self._seed = 12345
+        self._updater = None
+        self._weight_init = None
+        self._activation = None
+        self._l1 = 0.0
+        self._l2 = 0.0
+        self._dropout = 0.0
+        self._max_grad_norm = None
+        self._grad_clip_value = None
+        self._dtype = "float"
+
+    @staticmethod
+    def builder() -> "NeuralNetConfiguration":
+        return NeuralNetConfiguration()
+
+    def seed(self, s: int):
+        self._seed = int(s)
+        return self
+
+    def updater(self, u):
+        self._updater = U.get(u)
+        return self
+
+    def weight_init(self, w: str):
+        self._weight_init = w
+        return self
+
+    def activation(self, a):
+        self._activation = a
+        return self
+
+    def l1(self, v: float):
+        self._l1 = float(v)
+        return self
+
+    def l2(self, v: float):
+        self._l2 = float(v)
+        return self
+
+    def dropout(self, v: float):
+        self._dropout = float(v)
+        return self
+
+    def gradient_normalization(self, max_norm: Optional[float] = None,
+                               clip_value: Optional[float] = None):
+        """Ref: GradientNormalization enum — ClipL2PerLayer → max_norm,
+        ClipElementWiseAbsoluteValue → clip_value."""
+        self._max_grad_norm = max_norm
+        self._grad_clip_value = clip_value
+        return self
+
+    def data_type(self, dt: str):
+        self._dtype = dt
+        return self
+
+    # accepted-for-parity no-ops (XLA owns memory on TPU)
+    def training_workspace_mode(self, mode):
+        return self
+
+    def inference_workspace_mode(self, mode):
+        return self
+
+    def cache_mode(self, mode):
+        return self
+
+    def cudnn_algo_mode(self, mode):
+        return self
+
+    def _defaults(self) -> dict:
+        d = {}
+        if self._weight_init is not None:
+            d["weight_init"] = self._weight_init
+        if self._activation is not None:
+            d["activation"] = self._activation
+        if self._updater is not None:
+            d["updater"] = self._updater
+        if self._l1:
+            d["l1"] = self._l1
+        if self._l2:
+            d["l2"] = self._l2
+        if self._dropout:
+            d["dropout"] = self._dropout
+        return d
+
+    def list(self) -> ListBuilder:
+        return ListBuilder(self)

@@ -1,0 +1,663 @@
+"""Layer catalog — config + runtime in one class per layer.
+
+Ref: deeplearning4j-nn `nn/conf/layers/*.java` (configs) + `nn/layers/**`
+(runtimes). The reference splits config and runtime classes; TPU-first we
+fuse them: a Layer is a pure-functional module with
+  - ``build(input_shape, defaults)``   resolve shapes/defaults (ref: setNIn)
+  - ``init_params(rng, dtype)``        -> params dict
+  - ``init_state()``                   -> state dict (e.g. BN running stats)
+  - ``apply(params, x, state, train, rng)`` -> (out, new_state)
+  - ``output_shape(input_shape)``
+Shapes exclude the batch dimension. Data layouts are TPU-native:
+NHWC for images (XLA TPU's preferred conv layout — the reference is NCHW),
+[B, T, C] for sequences (reference is [B, C, T]).
+
+Forward math is jnp/lax only; backprop comes from JAX autodiff (the
+reference hand-writes backpropGradient per layer, e.g.
+`nn/layers/BaseLayer.java:73-108`). XLA fuses bias+activation into the
+matmul/conv epilogue, so the MXU sees large fused GEMMs.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ... import activations as A
+from ... import losses as L
+from ... import learning as U
+from ...weightinit import init_weights
+
+Shape = Tuple[int, ...]
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+class Layer:
+    """Base layer config+runtime. Ref: `nn/conf/layers/Layer.java` +
+    `nn/api/Layer.java:38`."""
+
+    kind = "layer"
+
+    def __init__(self, name: Optional[str] = None, dropout: Optional[float] = None,
+                 activation=None, weight_init: Optional[str] = None,
+                 bias_init: float = 0.0, updater=None,
+                 l1: Optional[float] = None, l2: Optional[float] = None,
+                 l1_bias: Optional[float] = None, l2_bias: Optional[float] = None):
+        # None means "unset — inherit the conf-level default at build()";
+        # an explicit 0.0 opts out of a nonzero global default (the
+        # reference distinguishes unset from set-to-zero the same way).
+        self.name = name
+        self.dropout = None if dropout is None else float(dropout)
+        self.activation = A.get(activation) if activation is not None else None
+        self.weight_init = weight_init
+        self.bias_init = float(bias_init)
+        self.updater = U.get(updater) if updater is not None else None
+        self.l1 = None if l1 is None else float(l1)
+        self.l2 = None if l2 is None else float(l2)
+        self.l1_bias = None if l1_bias is None else float(l1_bias)
+        self.l2_bias = None if l2_bias is None else float(l2_bias)
+        self.input_shape: Optional[Shape] = None
+        self._built = False
+
+    # -- lifecycle -----------------------------------------------------
+    def build(self, input_shape: Shape, defaults: Optional[dict] = None):
+        """Resolve input shape + inherit unset defaults (ref: the conf
+        builder's layer defaults + InputTypeUtil shape inference)."""
+        defaults = defaults or {}
+        if self.activation is None:
+            self.activation = A.get(defaults.get("activation", "identity"))
+        if self.weight_init is None:
+            self.weight_init = defaults.get("weight_init", "xavier")
+        if self.updater is None and defaults.get("updater") is not None:
+            self.updater = U.get(defaults["updater"])
+        if self.l1 is None:
+            self.l1 = defaults.get("l1", 0.0)
+        if self.l2 is None:
+            self.l2 = defaults.get("l2", 0.0)
+        if self.l1_bias is None:
+            self.l1_bias = defaults.get("l1_bias", 0.0)
+        if self.l2_bias is None:
+            self.l2_bias = defaults.get("l2_bias", 0.0)
+        if self.dropout is None:
+            self.dropout = defaults.get("dropout", 0.0)
+        self.input_shape = tuple(input_shape)
+        self._built = True
+
+    def init_params(self, rng: jax.Array, dtype=jnp.float32) -> Dict[str, jnp.ndarray]:
+        return {}
+
+    def init_state(self) -> Dict[str, jnp.ndarray]:
+        return {}
+
+    def apply(self, params, x, state, train: bool, rng: Optional[jax.Array]):
+        raise NotImplementedError
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        return tuple(input_shape)
+
+    # -- helpers -------------------------------------------------------
+    def _maybe_dropout(self, x, train, rng):
+        """Inverted dropout, applied to the layer INPUT (reference semantics:
+        `dropOut` in BaseLayer applies to input activations)."""
+        if not train or not self.dropout or rng is None:
+            return x
+        keep = 1.0 - self.dropout
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0)
+
+    @property
+    def has_params(self) -> bool:
+        return bool(self.param_shapes())
+
+    def param_shapes(self) -> Dict[str, Shape]:
+        return {}
+
+    def n_params(self) -> int:
+        return sum(int(math.prod(s)) for s in self.param_shapes().values())
+
+    # -- serde ---------------------------------------------------------
+    _JSON_FIELDS = ("name", "dropout", "weight_init", "bias_init", "l1", "l2",
+                    "l1_bias", "l2_bias")
+
+    def to_json(self) -> dict:
+        d: Dict[str, Any] = {"@class": self.kind}
+        for f in self._JSON_FIELDS:
+            v = getattr(self, f, None)
+            if v is not None:
+                d[f] = v
+        if self.activation is not None:
+            d["activation"] = self.activation.to_json()
+        if self.updater is not None:
+            d["updater"] = self.updater.to_json()
+        d.update(self._extra_json())
+        return d
+
+    def _extra_json(self) -> dict:
+        return {}
+
+    def __repr__(self):
+        return f"{type(self).__name__}(name={self.name})"
+
+
+class DenseLayer(Layer):
+    """Fully connected. Ref config: `nn/conf/layers/DenseLayer.java`;
+    runtime math: `nn/layers/BaseLayer.preOutputWithPreNorm`
+    (`nn/layers/BaseLayer.java:296-318`, z = x·W + b)."""
+
+    kind = "dense"
+
+    def __init__(self, n_out: int = None, n_in: Optional[int] = None,
+                 has_bias: bool = True, **kw):
+        super().__init__(**kw)
+        self.n_in = n_in
+        self.n_out = int(n_out)
+        self.has_bias = bool(has_bias)
+
+    def build(self, input_shape, defaults=None):
+        super().build(input_shape, defaults)
+        # CNN input feeding a dense layer flattens — the equivalent of the
+        # reference's auto-added CnnToFeedForwardPreProcessor
+        # (ref: nn/conf/preprocessor/CnnToFeedForwardPreProcessor.java).
+        # Rank-2 [T, C] sequence input stays unflattened: dense applies
+        # per-timestep (ref: RnnToFeedForwardPreProcessor semantics).
+        self._flatten_input = len(input_shape) == 3
+        if self.n_in is None:
+            self.n_in = int(math.prod(input_shape)) if self._flatten_input \
+                else int(input_shape[-1])
+
+    def param_shapes(self):
+        sh = {"W": (self.n_in, self.n_out)}
+        if self.has_bias:
+            sh["b"] = (self.n_out,)
+        return sh
+
+    def init_params(self, rng, dtype=jnp.float32):
+        kW, = jax.random.split(rng, 1)
+        p = {"W": init_weights(kW, (self.n_in, self.n_out), self.n_in, self.n_out,
+                               self.weight_init, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def pre_output(self, params, x, train: bool = False, rng=None):
+        """Shared preactivation primitive — both apply() (inference/forward)
+        and OutputLayer.compute_loss (training loss) route through here so
+        the flatten/dropout/matmul/bias logic cannot diverge."""
+        if getattr(self, "_flatten_input", False) and x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        x = self._maybe_dropout(x, train, rng)
+        z = x @ params["W"]
+        if self.has_bias:
+            z = z + params["b"]
+        return z
+
+    def apply(self, params, x, state, train, rng):
+        return self.activation(self.pre_output(params, x, train, rng)), state
+
+    def output_shape(self, input_shape):
+        if len(input_shape) == 3:  # flattened CNN input
+            return (self.n_out,)
+        return tuple(input_shape[:-1]) + (self.n_out,)
+
+    def _extra_json(self):
+        return {"n_out": self.n_out, "n_in": self.n_in, "has_bias": self.has_bias}
+
+
+class OutputLayer(DenseLayer):
+    """Dense + loss head. Ref: `nn/conf/layers/OutputLayer.java` /
+    `nn/layers/BaseOutputLayer.java`."""
+
+    kind = "output"
+
+    def __init__(self, n_out: int = None, loss="mcxent", **kw):
+        kw.setdefault("activation", "softmax")
+        super().__init__(n_out=n_out, **kw)
+        self.loss = L.get(loss)
+
+    def compute_loss(self, params, x, labels, mask=None, train: bool = False,
+                     rng=None):
+        return self.loss.score(labels, self.pre_output(params, x, train, rng),
+                               self.activation, mask)
+
+    def _extra_json(self):
+        d = super()._extra_json()
+        d["loss"] = self.loss.to_json()
+        return d
+
+
+class LossLayer(Layer):
+    """Loss on raw input, no params. Ref: `nn/conf/layers/LossLayer.java`."""
+
+    kind = "loss"
+
+    def __init__(self, loss="mcxent", **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        self.loss = L.get(loss)
+
+    def apply(self, params, x, state, train, rng):
+        return self.activation(x), state
+
+    def compute_loss(self, params, x, labels, mask=None, train: bool = False,
+                     rng=None):
+        x = self._maybe_dropout(x, train, rng)
+        return self.loss.score(labels, x, self.activation, mask)
+
+    def _extra_json(self):
+        return {"loss": self.loss.to_json()}
+
+
+class ActivationLayer(Layer):
+    """Ref: `nn/conf/layers/ActivationLayer.java`."""
+
+    kind = "activation"
+
+    def apply(self, params, x, state, train, rng):
+        return self.activation(x), state
+
+
+class DropoutLayer(Layer):
+    """Ref: `nn/conf/layers/DropoutLayer.java`."""
+
+    kind = "dropoutlayer"
+
+    def __init__(self, dropout: Optional[float] = 0.5, **kw):
+        super().__init__(dropout=dropout, **kw)
+
+    def build(self, input_shape, defaults=None):
+        d = dict(defaults or {})
+        d["activation"] = d.get("activation", "identity")
+        super().build(input_shape, d)
+
+    def apply(self, params, x, state, train, rng):
+        return self._maybe_dropout(x, train, rng), state
+
+
+class ConvolutionLayer(Layer):
+    """2D convolution, NHWC. Ref: `nn/conf/layers/ConvolutionLayer.java`;
+    runtime `nn/layers/convolution/ConvolutionLayer.java` (im2col+gemm on
+    CPU, cudnn on GPU). Here: `lax.conv_general_dilated`, which XLA maps
+    straight onto the MXU."""
+
+    kind = "conv2d"
+
+    def __init__(self, n_out: int = None, kernel=(3, 3), stride=(1, 1),
+                 padding="same", dilation=(1, 1), n_in: Optional[int] = None,
+                 has_bias: bool = True, groups: int = 1, **kw):
+        super().__init__(**kw)
+        self.n_out = int(n_out)
+        self.kernel = _pair(kernel)
+        self.stride = _pair(stride)
+        self.dilation = _pair(dilation)
+        self.padding = padding  # "same" | "valid" | ((top,bot),(l,r))
+        self.n_in = n_in
+        self.has_bias = bool(has_bias)
+        self.groups = int(groups)
+
+    def build(self, input_shape, defaults=None):
+        super().build(input_shape, defaults)
+        if self.n_in is None:
+            self.n_in = int(input_shape[-1])
+
+    def _pad(self):
+        if isinstance(self.padding, str):
+            return self.padding.upper()
+        return tuple(tuple(int(x) for x in p) for p in self.padding)
+
+    def param_shapes(self):
+        kh, kw_ = self.kernel
+        sh = {"W": (kh, kw_, self.n_in // self.groups, self.n_out)}
+        if self.has_bias:
+            sh["b"] = (self.n_out,)
+        return sh
+
+    def init_params(self, rng, dtype=jnp.float32):
+        kh, kw_ = self.kernel
+        fan_in = kh * kw_ * (self.n_in // self.groups)
+        fan_out = kh * kw_ * self.n_out
+        p = {"W": init_weights(rng, (kh, kw_, self.n_in // self.groups, self.n_out),
+                               fan_in, fan_out, self.weight_init, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def apply(self, params, x, state, train, rng):
+        x = self._maybe_dropout(x, train, rng)
+        z = lax.conv_general_dilated(
+            x, params["W"], window_strides=self.stride, padding=self._pad(),
+            rhs_dilation=self.dilation, feature_group_count=self.groups,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation(z), state
+
+    def output_shape(self, input_shape):
+        h, w, _ = input_shape
+        kh, kw_ = self.kernel
+        sh, sw = self.stride
+        dh, dw = self.dilation
+        ekh, ekw = (kh - 1) * dh + 1, (kw_ - 1) * dw + 1
+        if isinstance(self.padding, str) and self.padding.lower() == "same":
+            oh, ow = -(-h // sh), -(-w // sw)
+        elif isinstance(self.padding, str):  # valid
+            oh, ow = (h - ekh) // sh + 1, (w - ekw) // sw + 1
+        else:
+            (pt, pb), (pl, pr) = self.padding
+            oh = (h + pt + pb - ekh) // sh + 1
+            ow = (w + pl + pr - ekw) // sw + 1
+        return (oh, ow, self.n_out)
+
+    def _extra_json(self):
+        return {"n_out": self.n_out, "n_in": self.n_in, "kernel": list(self.kernel),
+                "stride": list(self.stride), "padding": self.padding,
+                "dilation": list(self.dilation), "has_bias": self.has_bias,
+                "groups": self.groups}
+
+
+class SubsamplingLayer(Layer):
+    """Pooling (max/avg/pnorm). Ref: `nn/conf/layers/SubsamplingLayer.java`."""
+
+    kind = "subsampling"
+
+    def __init__(self, kernel=(2, 2), stride=(2, 2), padding="valid",
+                 pooling="max", pnorm: int = 2, **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        self.kernel = _pair(kernel)
+        self.stride = _pair(stride)
+        self.padding = padding
+        self.pooling = pooling
+        self.pnorm = int(pnorm)
+
+    def _pad(self):
+        if isinstance(self.padding, str):
+            return self.padding.upper()
+        return ((0, 0),) + tuple(tuple(int(x) for x in p) for p in self.padding) + ((0, 0),)
+
+    def apply(self, params, x, state, train, rng):
+        kh, kw_ = self.kernel
+        sh, sw = self.stride
+        window = (1, kh, kw_, 1)
+        strides = (1, sh, sw, 1)
+        if self.pooling == "max":
+            z = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, self._pad())
+        elif self.pooling == "avg":
+            s = lax.reduce_window(x, 0.0, lax.add, window, strides, self._pad())
+            ones = jnp.ones_like(x)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, self._pad())
+            z = s / cnt
+        elif self.pooling == "pnorm":
+            p = float(self.pnorm)
+            s = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window, strides, self._pad())
+            z = s ** (1.0 / p)
+        else:
+            raise ValueError(f"unknown pooling {self.pooling!r}")
+        return z, state
+
+    def output_shape(self, input_shape):
+        h, w, c = input_shape
+        kh, kw_ = self.kernel
+        sh, sw = self.stride
+        if isinstance(self.padding, str) and self.padding.lower() == "same":
+            return (-(-h // sh), -(-w // sw), c)
+        if isinstance(self.padding, str):
+            return ((h - kh) // sh + 1, (w - kw_) // sw + 1, c)
+        (pt, pb), (pl, pr) = self.padding
+        return ((h + pt + pb - kh) // sh + 1, (w + pl + pr - kw_) // sw + 1, c)
+
+    def _extra_json(self):
+        return {"kernel": list(self.kernel), "stride": list(self.stride),
+                "padding": self.padding, "pooling": self.pooling, "pnorm": self.pnorm}
+
+
+class BatchNormalization(Layer):
+    """Ref: `nn/conf/layers/BatchNormalization.java` (decay 0.9 default) /
+    `nn/layers/normalization/BatchNormalization.java`. Works on the last
+    (channel/feature) axis for both NC and NHWC inputs."""
+
+    kind = "batchnorm"
+
+    def __init__(self, decay: float = 0.9, eps: float = 1e-5,
+                 gamma_init: float = 1.0, beta_init: float = 0.0,
+                 lock_gamma_beta: bool = False, **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        self.decay = float(decay)
+        self.eps = float(eps)
+        self.gamma_init = float(gamma_init)
+        self.beta_init = float(beta_init)
+        self.lock_gamma_beta = bool(lock_gamma_beta)
+        self.n_feat: Optional[int] = None
+
+    def build(self, input_shape, defaults=None):
+        super().build(input_shape, defaults)
+        self.n_feat = int(input_shape[-1])
+
+    def param_shapes(self):
+        if self.lock_gamma_beta:
+            return {}
+        return {"gamma": (self.n_feat,), "beta": (self.n_feat,)}
+
+    def init_params(self, rng, dtype=jnp.float32):
+        if self.lock_gamma_beta:
+            return {}
+        return {"gamma": jnp.full((self.n_feat,), self.gamma_init, dtype),
+                "beta": jnp.full((self.n_feat,), self.beta_init, dtype)}
+
+    def init_state(self):
+        return {"mean": jnp.zeros((self.n_feat,), jnp.float32),
+                "var": jnp.ones((self.n_feat,), jnp.float32)}
+
+    def apply(self, params, x, state, train, rng):
+        axes = tuple(range(x.ndim - 1))
+        if train:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            new_state = {
+                "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
+                "var": self.decay * state["var"] + (1 - self.decay) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        xn = (x - mean) / jnp.sqrt(var + self.eps)
+        if not self.lock_gamma_beta:
+            xn = xn * params["gamma"] + params["beta"]
+        return self.activation(xn), new_state
+
+    def _extra_json(self):
+        return {"decay": self.decay, "eps": self.eps,
+                "gamma_init": self.gamma_init, "beta_init": self.beta_init,
+                "lock_gamma_beta": self.lock_gamma_beta}
+
+
+class EmbeddingLayer(Layer):
+    """Index -> vector lookup. Ref: `nn/conf/layers/EmbeddingLayer.java`
+    (input: [B] or [B,1] int indices)."""
+
+    kind = "embedding"
+
+    def __init__(self, n_in: int = None, n_out: int = None, has_bias: bool = False, **kw):
+        super().__init__(**kw)
+        self.n_in = int(n_in)
+        self.n_out = int(n_out)
+        self.has_bias = bool(has_bias)
+
+    def param_shapes(self):
+        sh = {"W": (self.n_in, self.n_out)}
+        if self.has_bias:
+            sh["b"] = (self.n_out,)
+        return sh
+
+    def init_params(self, rng, dtype=jnp.float32):
+        p = {"W": init_weights(rng, (self.n_in, self.n_out), self.n_in, self.n_out,
+                               self.weight_init, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def apply(self, params, x, state, train, rng):
+        idx = x.astype(jnp.int32)
+        if idx.ndim > 1 and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+        z = params["W"][idx]
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation(z), state
+
+    def output_shape(self, input_shape):
+        if input_shape and input_shape[-1] == 1:
+            return tuple(input_shape[:-1]) + (self.n_out,)
+        return tuple(input_shape) + (self.n_out,)
+
+    def _extra_json(self):
+        return {"n_in": self.n_in, "n_out": self.n_out, "has_bias": self.has_bias}
+
+
+class GlobalPoolingLayer(Layer):
+    """Pool over all spatial/time dims. Ref:
+    `nn/conf/layers/GlobalPoolingLayer.java` (MAX/AVG/SUM/PNORM,
+    collapseDimensions)."""
+
+    kind = "globalpool"
+
+    def __init__(self, pooling: str = "avg", pnorm: int = 2, **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        self.pooling = pooling
+        self.pnorm = int(pnorm)
+
+    def apply(self, params, x, state, train, rng):
+        axes = tuple(range(1, x.ndim - 1))  # all but batch & channel
+        if self.pooling == "max":
+            z = jnp.max(x, axis=axes)
+        elif self.pooling == "avg":
+            z = jnp.mean(x, axis=axes)
+        elif self.pooling == "sum":
+            z = jnp.sum(x, axis=axes)
+        elif self.pooling == "pnorm":
+            p = float(self.pnorm)
+            z = jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1.0 / p)
+        else:
+            raise ValueError(self.pooling)
+        return z, state
+
+    def output_shape(self, input_shape):
+        return (input_shape[-1],)
+
+    def _extra_json(self):
+        return {"pooling": self.pooling, "pnorm": self.pnorm}
+
+
+class LocalResponseNormalization(Layer):
+    """Ref: `nn/conf/layers/LocalResponseNormalization.java` (k=2, n=5,
+    alpha=1e-4, beta=0.75 defaults)."""
+
+    kind = "lrn"
+
+    def __init__(self, k: float = 2.0, n: int = 5, alpha: float = 1e-4,
+                 beta: float = 0.75, **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        self.k = float(k)
+        self.n = int(n)
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+
+    def apply(self, params, x, state, train, rng):
+        # sum of squares over a window of n channels (last axis)
+        half = self.n // 2
+        sq = jnp.square(x)
+        padded = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half, half)])
+        windows = [padded[..., i:i + x.shape[-1]] for i in range(self.n)]
+        ssum = sum(windows)
+        denom = jnp.power(self.k + self.alpha * ssum, self.beta)
+        return x / denom, state
+
+    def _extra_json(self):
+        return {"k": self.k, "n": self.n, "alpha": self.alpha, "beta": self.beta}
+
+
+class ZeroPaddingLayer(Layer):
+    """Ref: `nn/conf/layers/ZeroPaddingLayer.java` (NHWC here)."""
+
+    kind = "zeropad"
+
+    def __init__(self, padding=((1, 1), (1, 1)), **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        if isinstance(padding, int):
+            padding = ((padding, padding), (padding, padding))
+        self.padding = tuple(tuple(int(x) for x in p) for p in padding)
+
+    def apply(self, params, x, state, train, rng):
+        (pt, pb), (pl, pr) = self.padding
+        return jnp.pad(x, ((0, 0), (pt, pb), (pl, pr), (0, 0))), state
+
+    def output_shape(self, input_shape):
+        h, w, c = input_shape
+        (pt, pb), (pl, pr) = self.padding
+        return (h + pt + pb, w + pl + pr, c)
+
+    def _extra_json(self):
+        return {"padding": [list(p) for p in self.padding]}
+
+
+class Upsampling2D(Layer):
+    """Nearest-neighbour upsampling. Ref: `nn/conf/layers/Upsampling2D.java`."""
+
+    kind = "upsampling2d"
+
+    def __init__(self, size=(2, 2), **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        self.size = _pair(size)
+
+    def apply(self, params, x, state, train, rng):
+        sh, sw = self.size
+        z = jnp.repeat(jnp.repeat(x, sh, axis=1), sw, axis=2)
+        return z, state
+
+    def output_shape(self, input_shape):
+        h, w, c = input_shape
+        return (h * self.size[0], w * self.size[1], c)
+
+    def _extra_json(self):
+        return {"size": list(self.size)}
+
+
+REGISTRY: Dict[str, type] = {}
+for _cls in list(globals().values()):
+    if isinstance(_cls, type) and issubclass(_cls, Layer) and _cls is not Layer:
+        REGISTRY[_cls.kind] = _cls
+
+
+def from_json(d: dict) -> Layer:
+    d = dict(d)
+    kind = d.pop("@class")
+    cls = REGISTRY[kind]
+    if "activation" in d and isinstance(d["activation"], dict):
+        d["activation"] = A.get(d["activation"])
+    if "updater" in d and isinstance(d["updater"], dict):
+        d["updater"] = U.get(d["updater"])
+    if "loss" in d and isinstance(d["loss"], dict):
+        d["loss"] = L.get(d["loss"])
+    if "kernel" in d:
+        d["kernel"] = tuple(d["kernel"])
+    if "stride" in d:
+        d["stride"] = tuple(d["stride"])
+    if "dilation" in d:
+        d["dilation"] = tuple(d["dilation"])
+    if "size" in d:
+        d["size"] = tuple(d["size"])
+    if "padding" in d and isinstance(d["padding"], list):
+        d["padding"] = tuple(tuple(p) for p in d["padding"])
+    return cls(**d)

@@ -1,0 +1,344 @@
+"""MultiLayerNetwork — sequential layer stack with fit/evaluate.
+
+Ref: deeplearning4j-nn `nn/multilayer/MultiLayerNetwork.java` (fit :1571,
+feedForward, calcBackpropGradients :1760, score, evaluate) and the Solver
+chain `optimize/solvers/{BaseOptimizer,StochasticGradientDescent}.java`.
+
+TPU-first redesign: the whole optimize step — forward, loss, backward,
+regularization, clipping, updater — is ONE jit-compiled pure function
+(params, opt_state, net_state, step, batch) -> (params, opt_state,
+net_state, loss). The reference's Solver/StepFunction/updater-view
+machinery collapses into this function; XLA fuses and schedules it onto
+the MXU. Listeners observe from the host between steps.
+"""
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .conf import MultiLayerConfiguration
+from .layers import Layer
+
+Params = Dict[str, Any]
+
+
+def _clip_grads(grads, max_norm, clip_value):
+    """Ref: GradientNormalization — per-layer L2 clip and elementwise clip."""
+    if clip_value:
+        grads = jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, -clip_value, clip_value), grads)
+    if max_norm:
+        def clip_layer(g):
+            leaves = jax.tree_util.tree_leaves(g)
+            norm = jnp.sqrt(sum(jnp.sum(jnp.square(l)) for l in leaves) + 1e-12)
+            scale = jnp.minimum(1.0, max_norm / norm)
+            return jax.tree_util.tree_map(lambda l: l * scale, g)
+        grads = {k: clip_layer(g) for k, g in grads.items()}
+    return grads
+
+
+class MultiLayerNetwork:
+    """Sequential network. Public surface mirrors the reference class."""
+
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layers: List[Layer] = conf.layers
+        self._params: Optional[Params] = None
+        self._net_state: Optional[Params] = None
+        self._opt_state: Optional[Any] = None
+        self._updaters: Optional[List] = None
+        self._step = 0
+        self._epoch = 0
+        self.listeners: List = []
+        self._last_loss = None
+        self._rng = jax.random.PRNGKey(conf.seed)
+        self._jit_step = None
+        self._jit_forward = {}
+        self._input_kind = conf.input_type.kind if conf.input_type else "ff"
+        self._input_shape = conf.input_type.shape if conf.input_type else None
+
+    # -- init ----------------------------------------------------------
+    def init(self, dtype=jnp.float32) -> "MultiLayerNetwork":
+        """Build layer shapes + params (ref: MultiLayerNetwork.init())."""
+        if self._input_shape is None:
+            raise ValueError("Configuration needs an input_type to init()")
+        shape = tuple(self._input_shape)
+        if self._input_kind == "cnnflat":
+            pass  # layers see the unflattened NHWC shape
+        defaults = self.conf.defaults
+        keys = jax.random.split(self._rng, len(self.layers) + 1)
+        self._rng = keys[0]
+        params: Params = {}
+        state: Params = {}
+        self._layer_keys = []
+        for i, layer in enumerate(self.layers):
+            layer.build(shape, defaults)
+            key = f"layer_{i}" + (f"_{layer.name}" if layer.name else "")
+            self._layer_keys.append(key)
+            p = layer.init_params(keys[i + 1], dtype)
+            if p:
+                params[key] = p
+            s = layer.init_state()
+            if s:
+                state[key] = s
+            shape = layer.output_shape(shape)
+        self._params = params
+        self._net_state = state
+        # per-layer updaters (ref: layer-level IUpdater overrides the global)
+        self._updaters = [l.updater if l.updater is not None else self.conf.updater
+                          for l in self.layers]
+        self._opt_state = {
+            self._layer_keys[i]: self._updaters[i].init_state(params[self._layer_keys[i]])
+            for i in range(len(self.layers)) if self._layer_keys[i] in params
+        }
+        self._layers_meta = {
+            self._layer_keys[i]: {"l1": l.l1, "l2": l.l2,
+                                  "l1_bias": l.l1_bias, "l2_bias": l.l2_bias}
+            for i, l in enumerate(self.layers)
+        }
+        self._step = 0
+        return self
+
+    # -- forward -------------------------------------------------------
+    def _reshape_input(self, x):
+        if self._input_kind == "cnnflat":
+            h, w, c = self._input_shape
+            return x.reshape(x.shape[0], h, w, c)
+        return x
+
+    def _forward(self, params, net_state, x, train: bool, rng, upto: Optional[int] = None):
+        """Run layers [0, upto). Returns (activation, new_state)."""
+        upto = len(self.layers) if upto is None else upto
+        new_state = dict(net_state)
+        act = x
+        if rng is not None:
+            layer_rngs = jax.random.split(rng, max(upto, 1))
+        for i in range(upto):
+            layer = self.layers[i]
+            key = self._layer_keys[i]
+            p = params.get(key, {})
+            s = net_state.get(key, {})
+            r = layer_rngs[i] if rng is not None else None
+            act, s2 = layer.apply(p, act, s, train, r)
+            if s:
+                new_state[key] = s2
+        return act, new_state
+
+    def _loss_fn(self, params, net_state, x, y, mask, train: bool, rng):
+        """Data loss + L1/L2 score terms (ref: BaseLayer.calcRegularizationScore)."""
+        r_fwd = r_out = None
+        if rng is not None:
+            r_fwd, r_out = jax.random.split(rng)
+        feats, new_state = self._forward(params, net_state, x, train, r_fwd,
+                                         upto=len(self.layers) - 1)
+        out_layer = self.layers[-1]
+        out_key = self._layer_keys[-1]
+        data_loss = out_layer.compute_loss(params.get(out_key, {}), feats, y, mask,
+                                           train=train, rng=r_out)
+        reg = 0.0
+        for key, meta in self._layers_meta.items():
+            if key not in params:
+                continue
+            for pname, w in params[key].items():
+                is_bias = pname in ("b", "beta")
+                l1 = meta["l1_bias"] if is_bias else meta["l1"]
+                l2 = meta["l2_bias"] if is_bias else meta["l2"]
+                if l2:
+                    reg = reg + 0.5 * l2 * jnp.sum(jnp.square(w))
+                if l1:
+                    reg = reg + l1 * jnp.sum(jnp.abs(w))
+        return data_loss + reg, new_state
+
+    # -- the one true train step (jitted) ------------------------------
+    def _make_step_fn(self):
+        """The raw (un-jitted) pure train-step function — also consumed by
+        parallel.ParallelWrapper, which jits it with mesh shardings."""
+        updaters = self._updaters
+        layer_keys = self._layer_keys
+        max_norm = self.conf.max_grad_norm
+        clip_value = self.conf.grad_clip_value
+
+        def step_fn(params, opt_state, net_state, step, x, y, mask, rng):
+            # NOTE: _loss_fn includes the L1/L2 penalty terms, so these
+            # grads already carry l2*W + l1*sign(W) (ref semantics:
+            # BaseMultiLayerUpdater.preApply adds them to the gradient,
+            # and the score includes calcRegularizationScore).
+            (loss, new_net_state), grads = jax.value_and_grad(
+                lambda p: self._loss_fn(p, net_state, x, y, mask, True, rng),
+                has_aux=True)(params)
+            grads = _clip_grads(grads, max_norm, clip_value)
+            new_opt = {}
+            new_params = {}
+            for i, key in enumerate(layer_keys):
+                if key not in params:
+                    continue
+                st, upd = updaters[i].apply(opt_state[key], grads[key], step)
+                new_opt[key] = st
+                new_params[key] = jax.tree_util.tree_map(
+                    lambda p, u: p - u, params[key], upd)
+            return new_params, new_opt, new_net_state, loss
+
+        return step_fn
+
+    def _make_step(self):
+        return jax.jit(self._make_step_fn(), donate_argnums=(0, 1, 2))
+
+    # -- public API ----------------------------------------------------
+    def fit(self, data, labels=None, epochs: int = 1, mask=None):
+        """Train. `data` is a DataSetIterator-like (yields (x, y) or DataSet)
+        or a raw array with `labels` (ref: MultiLayerNetwork.fit overloads)."""
+        if self._params is None:
+            self.init()
+        if self._jit_step is None:
+            self._jit_step = self._make_step()
+        if labels is not None:
+            batches = [(data, labels, mask)]
+            iterator = None
+        else:
+            iterator = data
+            if not hasattr(iterator, "reset") and not isinstance(iterator, (list, tuple)):
+                # a plain generator exhausts after one epoch and would
+                # silently yield nothing on later epochs — materialize it
+                iterator = list(iterator)
+        for _ in range(epochs):
+            if iterator is not None:
+                batches = ((b[0], b[1], b[2] if len(b) > 2 else None)
+                           for b in (self._unpack(it) for it in iterator))
+            for x, y, m in batches:
+                x = self._reshape_input(jnp.asarray(x))
+                y = jnp.asarray(y)
+                t0 = time.perf_counter()
+                self._rng, sub = jax.random.split(self._rng)
+                self._params, self._opt_state, self._net_state, loss = self._jit_step(
+                    self._params, self._opt_state, self._net_state,
+                    jnp.asarray(self._step), x, y,
+                    None if m is None else jnp.asarray(m), sub)
+                self._step += 1
+                # keep the loss on device: converting forces a host sync and
+                # defeats async dispatch; listeners that read .score_ pay the
+                # sync only at their reporting frequency
+                self._last_loss = loss
+                dur = time.perf_counter() - t0
+                for lst in self.listeners:
+                    lst.iteration_done(self, self._step, self._epoch)
+                    if hasattr(lst, "on_timing"):
+                        lst.on_timing(self, dur, x.shape[0])
+            self._epoch += 1
+            for lst in self.listeners:
+                if hasattr(lst, "on_epoch_end"):
+                    lst.on_epoch_end(self)
+        return self
+
+    @staticmethod
+    def _unpack(item):
+        if isinstance(item, tuple):
+            return item
+        # DataSet-like
+        return (item.features, item.labels,
+                getattr(item, "labels_mask", None))
+
+    def output(self, x, train: bool = False):
+        """Inference forward pass (ref: MultiLayerNetwork.output)."""
+        if self._params is None:
+            self.init()
+        x = self._reshape_input(jnp.asarray(x))
+        key = ("out", train)
+        if key not in self._jit_forward:
+            def fwd(params, net_state, x):
+                act, _ = self._forward(params, net_state, x, train, None)
+                return act
+            self._jit_forward[key] = jax.jit(fwd)
+        return self._jit_forward[key](self._params, self._net_state, x)
+
+    def feed_forward(self, x, train: bool = False):
+        """All layer activations (ref: feedForward returns the list)."""
+        x = self._reshape_input(jnp.asarray(x))
+        acts = [x]
+        act = x
+        for i in range(len(self.layers)):
+            act, _ = self.layers[i].apply(
+                self._params.get(self._layer_keys[i], {}), act,
+                self._net_state.get(self._layer_keys[i], {}), train, None)
+            acts.append(act)
+        return acts
+
+    @property
+    def score_(self) -> float:
+        """Last minibatch loss (host-syncs on read)."""
+        return float("nan") if self._last_loss is None else float(self._last_loss)
+
+    def score(self, x=None, y=None, mask=None) -> float:
+        """Loss on a dataset, or last minibatch score (ref: score())."""
+        if x is None:
+            return self.score_
+        x = self._reshape_input(jnp.asarray(x))
+        loss, _ = self._loss_fn(self._params, self._net_state, x, jnp.asarray(y),
+                                mask, False, None)
+        return float(loss)
+
+    def evaluate(self, iterator):
+        """Classification evaluation (ref: MultiLayerNetwork.evaluate)."""
+        from ..eval import Evaluation
+        ev = Evaluation()
+        for item in iterator:
+            if isinstance(item, tuple):
+                x, y, *rest = item
+                m = rest[0] if rest else None
+            else:
+                x, y = item.features, item.labels
+                m = getattr(item, "labels_mask", None)
+            out = self.output(x)
+            ev.eval(np.asarray(y), np.asarray(out),
+                    None if m is None else np.asarray(m))
+        return ev
+
+    # -- introspection (ref: summary(), numParams(), params()) ---------
+    def num_params(self) -> int:
+        return sum(int(x.size) for x in jax.tree_util.tree_leaves(self._params))
+
+    def params(self) -> Params:
+        return self._params
+
+    def set_params(self, params: Params):
+        self._params = params
+
+    def get_updater_state(self):
+        return self._opt_state
+
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def add_listeners(self, *listeners):
+        self.listeners.extend(listeners)
+        return self
+
+    def summary(self) -> str:
+        if self._params is None:
+            self.init()
+        lines = ["=" * 70,
+                 f"{'idx':<4}{'layer':<22}{'out shape':<20}{'params':<10}",
+                 "-" * 70]
+        shape = tuple(self._input_shape)
+        for i, l in enumerate(self.layers):
+            out = l.output_shape(shape) if l._built else "?"
+            lines.append(f"{i:<4}{type(l).__name__:<22}{str(out):<20}{l.n_params():<10}")
+            shape = out if isinstance(out, tuple) else shape
+        lines.append("-" * 70)
+        lines.append(f"Total params: {self.num_params()}")
+        lines.append("=" * 70)
+        return "\n".join(lines)
+
+    def clone(self) -> "MultiLayerNetwork":
+        from copy import deepcopy
+        m = MultiLayerNetwork(MultiLayerConfiguration.from_json(self.conf.to_json()))
+        if self._params is not None:
+            m.init()
+            m._params = deepcopy(self._params)
+            m._net_state = deepcopy(self._net_state)
+        return m

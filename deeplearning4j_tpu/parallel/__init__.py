@@ -1,0 +1,148 @@
+"""Distributed training (ref: L6 — deeplearning4j-scaleout + nd4j parameter
+server).
+
+The reference's data plane is an Aeron UDP mesh pushing threshold-compressed
+gradients between JVMs (`SharedTrainingWrapper.java:79`,
+`EncodingHandler.java:51`, `MeshOrganizer.java:48`). TPU-native redesign
+(SURVEY.md §2.4, §5.8): sharding annotations over a `jax.sharding.Mesh` and
+XLA collectives over ICI — the compiler schedules the all-reduce; no
+user-space mesh, chunking, or dedup is needed on-slice. The capabilities
+map:
+
+- ParallelWrapper (single-host multi-device DP)  → :class:`ParallelWrapper`
+  (one jit over a Mesh; workers = devices, averaging = psum-by-construction)
+- SharedTrainingMaster / gradient sharing        → sync all-reduce inside
+  the compiled step (ICI makes Strom-2015 async compression unnecessary
+  on-slice; threshold+residual encoding survives as a DCN option in
+  :mod:`.compression`)
+- MeshOrganizer topology                          → :func:`make_mesh` device
+  mesh axes ("data", "model")
+- DummyTransport loopback tests                   → virtual CPU mesh via
+  --xla_force_host_platform_device_count (tests/conftest.py)
+- ParallelInference                               → :class:`ParallelInference`
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(devices: Optional[Sequence] = None, data: Optional[int] = None,
+              model: int = 1) -> Mesh:
+    """Build a 2D ("data", "model") device mesh. Defaults to all devices on
+    the data axis (pure DP). Ref-capability analogue: MeshOrganizer builds
+    the reference's update-propagation topology; here the mesh is the
+    sharding topology XLA compiles collectives for."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if data is None:
+        data = n // model
+    if data * model != n:
+        raise ValueError(f"data({data}) * model({model}) != device count ({n})")
+    arr = np.asarray(devices).reshape(data, model)
+    return Mesh(arr, ("data", "model"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P("data"))
+
+
+class ParallelWrapper:
+    """Data-parallel training driver (ref: `ParallelWrapper.java:77-91`,
+    modes AVERAGING / SHARED_GRADIENTS).
+
+    Both reference modes collapse into one compiled SPMD program: the batch
+    is sharded over the mesh's "data" axis, params/optimizer state are
+    replicated, and XLA inserts the gradient all-reduce over ICI.
+    AVERAGING-vs-SHARED_GRADIENTS (average params after N steps vs share
+    every gradient) is a non-choice here — the compiled step IS exact
+    synchronous gradient sharing at every step, with none of the staleness
+    the reference's async path tolerates."""
+
+    def __init__(self, model, mesh: Optional[Mesh] = None,
+                 prefetch_buffer: int = 2, workers: Optional[int] = None):
+        self.model = model
+        if mesh is None:
+            devs = jax.devices()[:workers] if workers else None
+            mesh = make_mesh(devs)
+        self.mesh = mesh
+        self.prefetch_buffer = prefetch_buffer
+        self._sharded_step = None
+
+    @property
+    def num_workers(self) -> int:
+        return int(self.mesh.shape["data"])
+
+    def _build_step(self):
+        m = self.model
+        if m._params is None:
+            m.init()
+        repl = replicated(self.mesh)
+        data = batch_sharded(self.mesh)
+        self._sharded_step = jax.jit(
+            m._make_step_fn(),
+            in_shardings=(repl, repl, repl, repl, data, data, None, repl),
+            out_shardings=(repl, repl, repl, None),
+            donate_argnums=(0, 1, 2),
+        )
+
+    def fit(self, iterator, epochs: int = 1):
+        """Train data-parallel. Batches must be divisible by the data-axis
+        size (ref ParallelWrapper splits the batch across workers the same
+        way). Delegates to MultiLayerNetwork.fit with the sharded step
+        installed, so iterator unpacking, listeners (incl. on_timing), and
+        epoch accounting behave identically to single-device training."""
+        m = self.model
+        if m._params is None:
+            m.init()
+        if self._sharded_step is None:
+            self._build_step()
+        from ..datasets import AsyncDataSetIterator, DataSetIterator
+        if (self.prefetch_buffer and isinstance(iterator, DataSetIterator)
+                and not isinstance(iterator, AsyncDataSetIterator)):
+            iterator = AsyncDataSetIterator(iterator, prefetch=self.prefetch_buffer)
+        prev_step = m._jit_step
+        m._jit_step = self._sharded_step
+        try:
+            with self.mesh:
+                m.fit(iterator, epochs=epochs)
+        finally:
+            m._jit_step = prev_step
+        return m
+
+
+class ParallelInference:
+    """Sharded batched inference (ref: `ParallelInference.java:55` —
+    BATCHED mode queues requests and runs them as one device batch; here
+    the batch is sharded over the mesh and XLA splits the work)."""
+
+    def __init__(self, model, mesh: Optional[Mesh] = None):
+        self.model = model
+        self.mesh = mesh or make_mesh()
+        self._jit_out = None
+
+    def output(self, x):
+        m = self.model
+        if m._params is None:
+            m.init()
+        if self._jit_out is None:
+            repl = replicated(self.mesh)
+            data = batch_sharded(self.mesh)
+
+            def fwd(params, net_state, x):
+                act, _ = m._forward(params, net_state, x, False, None)
+                return act
+
+            self._jit_out = jax.jit(fwd, in_shardings=(repl, repl, data),
+                                    out_shardings=data)
+        with self.mesh:
+            return self._jit_out(m._params, m._net_state,
+                                 m._reshape_input(jnp.asarray(x)))

@@ -1,0 +1,1 @@
+"""Utilities (ref: deeplearning4j-nn `util/` — ModelSerializer etc.)."""

@@ -1,0 +1,100 @@
+"""Model persistence.
+
+Ref: deeplearning4j-nn `util/ModelSerializer.java` — zip archive of
+{configuration.json, coefficients (flattened params), updaterState,
+normalizer}. Same completeness bar here (SURVEY.md §5.4): config JSON +
+params + updater state + step counter round-trip exactly.
+
+Format: a zip holding `configuration.json`, `params.npz` (one entry per
+flattened pytree path), `updater.npz`, `meta.json`. Orbax-style sharded
+async checkpointing for the distributed path lives in
+`deeplearning4j_tpu.parallel.checkpoint`; this is the single-host format.
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import zipfile
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def _flatten_tree(tree) -> Dict[str, np.ndarray]:
+    flat = {}
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+    for path, leaf in leaves_with_paths:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+def _unflatten_like(template, flat: Dict[str, np.ndarray]):
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in leaves_with_paths:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        arr = flat[key]
+        leaves.append(arr.astype(np.asarray(leaf).dtype).reshape(np.asarray(leaf).shape))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _npz_bytes(arrs: Dict[str, np.ndarray]) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **arrs)
+    return buf.getvalue()
+
+
+class ModelSerializer:
+    """Ref: ModelSerializer.writeModel / restoreMultiLayerNetwork."""
+
+    @staticmethod
+    def write_model(model, path: str, save_updater: bool = True,
+                    normalizer=None):
+        meta = {
+            "step": model._step,
+            "epoch": model._epoch,
+            "model_type": type(model).__name__,
+            "format_version": 1,
+        }
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+            z.writestr("configuration.json", model.conf.to_json())
+            z.writestr("params.npz", _npz_bytes(_flatten_tree(model._params)))
+            if model._net_state:
+                z.writestr("state.npz", _npz_bytes(_flatten_tree(model._net_state)))
+            if save_updater and model._opt_state is not None:
+                z.writestr("updater.npz", _npz_bytes(_flatten_tree(model._opt_state)))
+            if normalizer is not None:
+                z.writestr("normalizer.json", json.dumps(normalizer))
+            z.writestr("meta.json", json.dumps(meta))
+
+    @staticmethod
+    def restore_multi_layer_network(path: str, load_updater: bool = True):
+        from ..nn.conf import MultiLayerConfiguration
+        from ..nn.multilayer import MultiLayerNetwork
+        with zipfile.ZipFile(path) as z:
+            conf = MultiLayerConfiguration.from_json(
+                z.read("configuration.json").decode())
+            model = MultiLayerNetwork(conf).init()
+            params_flat = dict(np.load(io.BytesIO(z.read("params.npz"))))
+            model._params = _unflatten_like(model._params, params_flat)
+            names = z.namelist()
+            if "state.npz" in names and model._net_state:
+                model._net_state = _unflatten_like(
+                    model._net_state, dict(np.load(io.BytesIO(z.read("state.npz")))))
+            if load_updater and "updater.npz" in names:
+                model._opt_state = _unflatten_like(
+                    model._opt_state, dict(np.load(io.BytesIO(z.read("updater.npz")))))
+            meta = json.loads(z.read("meta.json").decode())
+            model._step = meta.get("step", 0)
+            model._epoch = meta.get("epoch", 0)
+        return model
+
+    @staticmethod
+    def restore_normalizer(path: str) -> Optional[dict]:
+        with zipfile.ZipFile(path) as z:
+            if "normalizer.json" in z.namelist():
+                return json.loads(z.read("normalizer.json").decode())
+        return None

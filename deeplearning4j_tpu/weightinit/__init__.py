@@ -1,0 +1,104 @@
+"""Weight initialization schemes.
+
+Ref: deeplearning4j-nn `org/deeplearning4j/nn/weights/WeightInit.java` enum +
+`WeightInitUtil.java` (fanIn/fanOut based scaling), and nd4j `weightinit/impl/`.
+
+TPU-first: all draws go through jax.random with explicit keys (counter-based
+PRNG), so initialization is deterministic and reproducible across meshes —
+unlike the reference's stateful NativeRandom.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def init_weights(key: jax.Array, shape: Sequence[int], fan_in: float, fan_out: float,
+                 scheme: str = "xavier", dtype=jnp.float32,
+                 distribution: Optional[dict] = None) -> jnp.ndarray:
+    """Create a weight array per the named scheme.
+
+    Scheme names match the reference WeightInit enum (lowercased).
+    `distribution` is used by the DISTRIBUTION scheme:
+    {"type": "normal"|"uniform"|"truncated_normal"|"constant", ...params}.
+    """
+    shape = tuple(int(s) for s in shape)
+    s = scheme.lower()
+    if s == "zero":
+        return jnp.zeros(shape, dtype)
+    if s == "ones":
+        return jnp.ones(shape, dtype)
+    if s == "constant":
+        return jnp.full(shape, (distribution or {}).get("value", 0.0), dtype)
+    if s == "normal" or s == "lecun_normal":
+        # ref: N(0, 1/sqrt(fanIn))
+        return jax.random.normal(key, shape, dtype) / math.sqrt(max(fan_in, 1.0))
+    if s == "uniform":
+        a = 1.0 / math.sqrt(max(fan_in, 1.0))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s == "xavier":
+        # ref WeightInitUtil: N(0, 2/(fanIn+fanOut))
+        std = math.sqrt(2.0 / max(fan_in + fan_out, 1.0))
+        return std * jax.random.normal(key, shape, dtype)
+    if s == "xavier_uniform":
+        a = math.sqrt(6.0 / max(fan_in + fan_out, 1.0))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s == "xavier_fan_in":
+        std = math.sqrt(1.0 / max(fan_in, 1.0))
+        return std * jax.random.normal(key, shape, dtype)
+    if s == "xavier_legacy":
+        std = math.sqrt(1.0 / max(fan_in + fan_out, 1.0))
+        return std * jax.random.normal(key, shape, dtype)
+    if s == "relu":
+        # He init: N(0, 2/fanIn)
+        std = math.sqrt(2.0 / max(fan_in, 1.0))
+        return std * jax.random.normal(key, shape, dtype)
+    if s == "relu_uniform":
+        a = math.sqrt(6.0 / max(fan_in, 1.0))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s == "sigmoid_uniform":
+        a = 4.0 * math.sqrt(6.0 / max(fan_in + fan_out, 1.0))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s == "lecun_uniform":
+        a = math.sqrt(3.0 / max(fan_in, 1.0))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s in ("var_scaling_normal_fan_in", "var_scaling_normal_fan_out",
+             "var_scaling_normal_fan_avg", "var_scaling_uniform_fan_in",
+             "var_scaling_uniform_fan_out", "var_scaling_uniform_fan_avg"):
+        fan = {"in": fan_in, "out": fan_out, "avg": (fan_in + fan_out) / 2.0}[s.rsplit("_", 1)[-1]]
+        if "normal" in s:
+            std = math.sqrt(1.0 / max(fan, 1.0))
+            return std * jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype)
+        a = math.sqrt(3.0 / max(fan, 1.0))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s == "identity":
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError("identity init requires square 2d shape")
+        return jnp.eye(shape[0], dtype=dtype)
+    if s == "distribution":
+        d = distribution or {}
+        t = d.get("type", "normal")
+        if t == "normal" or t == "gaussian":
+            return d.get("mean", 0.0) + d.get("std", 1.0) * jax.random.normal(key, shape, dtype)
+        if t == "uniform":
+            return jax.random.uniform(key, shape, dtype, d.get("lower", -1.0), d.get("upper", 1.0))
+        if t == "truncated_normal":
+            return d.get("mean", 0.0) + d.get("std", 1.0) * jax.random.truncated_normal(
+                key, -2.0, 2.0, shape, dtype)
+        if t == "constant":
+            return jnp.full(shape, d.get("value", 0.0), dtype)
+        raise ValueError(f"Unknown distribution type {t!r}")
+    raise ValueError(f"Unknown weight init scheme: {scheme!r}")
+
+
+SCHEMES = [
+    "zero", "ones", "constant", "normal", "lecun_normal", "uniform", "xavier",
+    "xavier_uniform", "xavier_fan_in", "xavier_legacy", "relu", "relu_uniform",
+    "sigmoid_uniform", "lecun_uniform", "identity", "distribution",
+    "var_scaling_normal_fan_in", "var_scaling_normal_fan_out",
+    "var_scaling_normal_fan_avg", "var_scaling_uniform_fan_in",
+    "var_scaling_uniform_fan_out", "var_scaling_uniform_fan_avg",
+]

@@ -1,0 +1,50 @@
+"""Test configuration.
+
+Mirrors the reference's distributed-test philosophy (SURVEY.md §4.2): tests
+run on a virtual 8-device CPU mesh via
+`--xla_force_host_platform_device_count=8`, the TPU analogue of
+DummyTransport / Spark local[n] — multi-chip semantics validated in one
+process with no real hardware.
+
+Axon note: this image's sitecustomize registers the axon (TPU-tunnel) PJRT
+plugin whenever PALLAS_AXON_POOL_IPS is set, and that registration forces
+jax_platforms="axon,cpu" at the config level — so merely setting
+JAX_PLATFORMS=cpu cannot keep tests off the (single-chip, single-client)
+TPU tunnel. We re-exec the interpreter once with the sentinel scrubbed to
+get a hermetic CPU-only jax. This also keeps the test suite runnable while
+a bench/train process owns the TPU.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+# Persistent compilation cache: caching XLA executables across runs cuts
+# wall-clock on repeat runs.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/.cache/jax_test_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.2")
+
+import jax  # noqa: E402
+
+# The image's sitecustomize registers the axon (TPU-tunnel) PJRT plugin and
+# forces jax_platforms="axon,cpu" at the CONFIG level, which overrides the
+# env var. Flip it back before any backend is created so the suite runs on
+# the hermetic 8-device CPU mesh (and never touches the single-client TPU
+# tunnel, which would serialize/hang concurrent test+bench processes).
+jax.config.update("jax_platforms", "cpu")
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(0)
+
+
+@pytest.fixture
+def np_rng():
+    return np.random.RandomState(12345)

@@ -1,0 +1,81 @@
+"""Activation catalog tests (ref test model: nd4j-tests ActivationJson /
+opvalidation transform tests)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import activations as A
+
+
+ALL_SIMPLE = [
+    "identity", "sigmoid", "tanh", "relu", "relu6", "leakyrelu", "elu", "selu",
+    "gelu", "swish", "softmax", "softplus", "softsign", "hardsigmoid",
+    "hardtanh", "cube", "rationaltanh", "rectifiedtanh", "thresholdedrelu",
+    "prelu", "mish",
+]
+
+
+def test_catalog_size():
+    # reference has 21 activation impls
+    assert len(A.names()) >= 21
+
+
+@pytest.mark.parametrize("name", ALL_SIMPLE)
+def test_forward_finite_and_shape(name, rng):
+    act = A.get(name)
+    x = jax.random.normal(rng, (4, 7)) * 3.0
+    y = act(x)
+    assert y.shape == x.shape
+    assert bool(jnp.all(jnp.isfinite(y)))
+
+
+@pytest.mark.parametrize("name", ALL_SIMPLE)
+def test_differentiable(name, rng):
+    act = A.get(name)
+    x = jax.random.normal(rng, (5,)) + 0.1
+    g = jax.grad(lambda v: act(v).sum())(x)
+    assert bool(jnp.all(jnp.isfinite(g)))
+
+
+def test_known_values():
+    x = jnp.array([-2.0, -0.5, 0.0, 0.5, 2.0])
+    np.testing.assert_allclose(A.get("relu")(x), [0, 0, 0, 0.5, 2.0], atol=1e-6)
+    np.testing.assert_allclose(A.get("hardtanh")(x), [-1, -0.5, 0, 0.5, 1.0], atol=1e-6)
+    np.testing.assert_allclose(A.get("cube")(x), x ** 3, atol=1e-5)
+    np.testing.assert_allclose(A.get("hardsigmoid")(x), [0.1, 0.4, 0.5, 0.6, 0.9], atol=1e-6)
+    np.testing.assert_allclose(A.get("thresholdedrelu")(x), [0, 0, 0, 0, 2.0], atol=1e-6)
+    # relu6
+    np.testing.assert_allclose(A.get("relu6")(jnp.array([7.0, 3.0, -1.0])), [6.0, 3.0, 0.0], atol=1e-6)
+
+
+def test_softmax_rows_sum_to_one(rng):
+    y = A.get("softmax")(jax.random.normal(rng, (3, 9)))
+    np.testing.assert_allclose(y.sum(axis=-1), np.ones(3), atol=1e-6)
+
+
+def test_rrelu_train_vs_eval(rng):
+    act = A.RReLU()
+    x = jnp.array([-1.0, 1.0])
+    # eval deterministic: mean slope
+    y = act(x)
+    np.testing.assert_allclose(y, [-(act.l + act.u) / 2, 1.0], atol=1e-6)
+    # train stochastic within [l, u]
+    yt = act(x, rng=rng, train=True)
+    assert -act.u <= float(yt[0]) <= -act.l
+
+
+def test_prelu_alpha():
+    x = jnp.array([-2.0, 2.0])
+    y = A.PReLU.apply_with_alpha(x, jnp.array(0.25))
+    np.testing.assert_allclose(y, [-0.5, 2.0], atol=1e-6)
+
+
+def test_json_roundtrip():
+    for name in ALL_SIMPLE:
+        act = A.get(name)
+        act2 = A.get(act.to_json())
+        assert act == act2
+    # parameterized
+    act = A.LeakyReLU(alpha=0.3)
+    assert A.get(act.to_json()) == act

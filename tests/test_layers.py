@@ -1,0 +1,206 @@
+"""Layer catalog tests (ref model: deeplearning4j-core layer tests +
+gradientcheck/GradientCheckUtil central-difference checks)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.layers import (
+    ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
+    DropoutLayer, EmbeddingLayer, GlobalPoolingLayer,
+    LocalResponseNormalization, LossLayer, OutputLayer, SubsamplingLayer,
+    Upsampling2D, ZeroPaddingLayer, REGISTRY, from_json,
+)
+
+
+def _init(layer, input_shape, rng, defaults=None):
+    layer.build(input_shape, defaults or {"weight_init": "xavier", "activation": "relu"})
+    return layer.init_params(rng), layer.init_state()
+
+
+def test_dense_forward_shape(rng):
+    layer = DenseLayer(n_out=16)
+    p, s = _init(layer, (8,), rng)
+    x = jax.random.normal(rng, (4, 8))
+    y, _ = layer.apply(p, x, s, False, None)
+    assert y.shape == (4, 16)
+    assert layer.output_shape((8,)) == (16,)
+    assert layer.n_params() == 8 * 16 + 16
+
+
+def test_dense_math(rng):
+    layer = DenseLayer(n_out=3, activation="identity", weight_init="ones", bias_init=1.0)
+    p, s = _init(layer, (2,), rng)
+    y, _ = layer.apply(p, jnp.array([[1.0, 2.0]]), s, False, None)
+    np.testing.assert_allclose(y, [[4.0, 4.0, 4.0]], atol=1e-6)
+
+
+def test_conv2d_shapes(rng):
+    layer = ConvolutionLayer(n_out=8, kernel=(3, 3), stride=(1, 1), padding="same")
+    p, s = _init(layer, (28, 28, 1), rng)
+    x = jax.random.normal(rng, (2, 28, 28, 1))
+    y, _ = layer.apply(p, x, s, False, None)
+    assert y.shape == (2, 28, 28, 8)
+    assert layer.output_shape((28, 28, 1)) == (28, 28, 8)
+
+    layer2 = ConvolutionLayer(n_out=4, kernel=(5, 5), stride=(2, 2), padding="valid")
+    p2, s2 = _init(layer2, (28, 28, 1), rng)
+    y2, _ = layer2.apply(p2, x, s2, False, None)
+    assert y2.shape == (2, 12, 12, 4)
+    assert layer2.output_shape((28, 28, 1)) == (12, 12, 4)
+
+
+def test_conv2d_known_value(rng):
+    # 1x1 kernel of ones on a single channel = identity
+    layer = ConvolutionLayer(n_out=1, kernel=(1, 1), padding="valid",
+                             weight_init="ones", activation="identity", has_bias=False)
+    p, s = _init(layer, (4, 4, 1), rng, {"activation": "identity", "weight_init": "ones"})
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    y, _ = layer.apply(p, x, s, False, None)
+    np.testing.assert_allclose(y, x, atol=1e-6)
+
+
+def test_subsampling_max_avg(rng):
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    mx = SubsamplingLayer(kernel=(2, 2), stride=(2, 2), pooling="max")
+    mx.build((4, 4, 1), {})
+    y, _ = mx.apply({}, x, {}, False, None)
+    np.testing.assert_allclose(y[0, :, :, 0], [[5, 7], [13, 15]], atol=1e-6)
+    av = SubsamplingLayer(kernel=(2, 2), stride=(2, 2), pooling="avg")
+    av.build((4, 4, 1), {})
+    y, _ = av.apply({}, x, {}, False, None)
+    np.testing.assert_allclose(y[0, :, :, 0], [[2.5, 4.5], [10.5, 12.5]], atol=1e-6)
+    assert mx.output_shape((4, 4, 1)) == (2, 2, 1)
+
+
+def test_batchnorm_train_and_eval(rng):
+    layer = BatchNormalization()
+    p, s = _init(layer, (6,), rng)
+    x = jax.random.normal(rng, (64, 6)) * 3.0 + 2.0
+    y, s2 = layer.apply(p, x, s, True, None)
+    # train output normalized
+    np.testing.assert_allclose(np.asarray(y.mean(0)), np.zeros(6), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(y.std(0)), np.ones(6), atol=1e-2)
+    # running stats moved toward batch stats
+    assert not np.allclose(np.asarray(s2["mean"]), 0.0)
+    # eval uses running stats (state unchanged)
+    y2, s3 = layer.apply(p, x, s2, False, None)
+    assert s3 is s2
+
+
+def test_dropout_train_eval(rng):
+    layer = DropoutLayer(dropout=0.5)
+    layer.build((10,), {})
+    x = jnp.ones((8, 10))
+    y_eval, _ = layer.apply({}, x, {}, False, rng)
+    np.testing.assert_allclose(y_eval, x)  # no-op at inference
+    y_train, _ = layer.apply({}, x, {}, True, rng)
+    vals = np.unique(np.asarray(y_train))
+    assert set(np.round(vals, 4)).issubset({0.0, 2.0})  # inverted dropout scaling
+
+
+def test_embedding(rng):
+    layer = EmbeddingLayer(n_in=20, n_out=5, activation="identity")
+    p, s = _init(layer, (1,), rng, {"activation": "identity", "weight_init": "normal"})
+    idx = jnp.array([[3], [7]])
+    y, _ = layer.apply(p, idx, s, False, None)
+    assert y.shape == (2, 5)
+    np.testing.assert_allclose(y[0], p["W"][3], atol=1e-6)
+
+
+def test_global_pooling(rng):
+    x = jax.random.normal(rng, (2, 5, 5, 3))
+    for mode, ref in [("avg", x.mean((1, 2))), ("max", x.max((1, 2))), ("sum", x.sum((1, 2)))]:
+        g = GlobalPoolingLayer(pooling=mode)
+        g.build((5, 5, 3), {})
+        y, _ = g.apply({}, x, {}, False, None)
+        np.testing.assert_allclose(y, ref, atol=1e-5)
+    assert g.output_shape((5, 5, 3)) == (3,)
+
+
+def test_lrn_shape(rng):
+    layer = LocalResponseNormalization()
+    layer.build((4, 4, 8), {})
+    x = jax.random.normal(rng, (2, 4, 4, 8))
+    y, _ = layer.apply({}, x, {}, False, None)
+    assert y.shape == x.shape
+    assert bool(jnp.all(jnp.abs(y) <= jnp.abs(x) + 1e-6))  # LRN only shrinks
+
+
+def test_zeropad_upsample(rng):
+    zp = ZeroPaddingLayer(padding=((1, 2), (3, 4)))
+    zp.build((4, 4, 2), {})
+    x = jnp.ones((1, 4, 4, 2))
+    y, _ = zp.apply({}, x, {}, False, None)
+    assert y.shape == (1, 7, 11, 2)
+    assert zp.output_shape((4, 4, 2)) == (7, 11, 2)
+    up = Upsampling2D(size=(2, 3))
+    up.build((4, 4, 2), {})
+    y, _ = up.apply({}, x, {}, False, None)
+    assert y.shape == (1, 8, 12, 2)
+
+
+def test_layer_json_roundtrip(rng):
+    layers = [
+        DenseLayer(n_out=8, n_in=4, activation="relu", dropout=0.1, l2=1e-4),
+        OutputLayer(n_out=3, n_in=8, loss="mcxent"),
+        ConvolutionLayer(n_out=8, n_in=1, kernel=(5, 5), stride=(2, 2), padding="valid"),
+        SubsamplingLayer(kernel=(2, 2), pooling="avg"),
+        BatchNormalization(decay=0.95),
+        EmbeddingLayer(n_in=10, n_out=4),
+        GlobalPoolingLayer(pooling="max"),
+        ZeroPaddingLayer(padding=((1, 1), (2, 2))),
+        Upsampling2D(size=(2, 2)),
+        LocalResponseNormalization(n=3),
+    ]
+    for l in layers:
+        d = l.to_json()
+        l2_ = from_json(d)
+        assert l2_.to_json() == d, type(l).__name__
+
+
+@pytest.mark.parametrize("layer_fn,in_shape", [
+    (lambda: DenseLayer(n_out=7, activation="tanh"), (5,)),
+    (lambda: ConvolutionLayer(n_out=3, kernel=(3, 3), padding="same", activation="sigmoid"), (6, 6, 2)),
+    (lambda: BatchNormalization(), (5,)),
+    (lambda: EmbeddingLayer(n_in=11, n_out=6, activation="identity"), (1,)),
+])
+def test_numeric_gradient_check(layer_fn, in_shape, rng):
+    """Central-difference gradient check (ref: GradientCheckUtil.checkGradients,
+    `nn/gradientcheck/GradientCheckUtil.java:129`) on the layer's params."""
+    layer = layer_fn()
+    k1, k2 = jax.random.split(rng)
+    p, s = _init(layer, in_shape, k1, {"weight_init": "xavier", "activation": None})
+    if isinstance(layer, EmbeddingLayer):
+        x = jax.random.randint(k2, (3, 1), 0, 11)
+    else:
+        x = jax.random.normal(k2, (3,) + in_shape)
+
+    def loss(p):
+        y, _ = layer.apply(p, x, s, True, None)
+        return jnp.sum(jnp.square(y))
+
+    g = jax.grad(loss)(p)
+    eps = 1e-3
+    for pname in p:
+        flatp = np.asarray(p[pname], np.float64).ravel()
+        flatg = np.asarray(g[pname]).ravel()
+        for idx in range(0, len(flatp), max(1, len(flatp) // 5)):
+            pp = dict(p)
+            vec = flatp.copy()
+            vec[idx] += eps
+            pp[pname] = jnp.asarray(vec.reshape(p[pname].shape), jnp.float32)
+            up = float(loss(pp))
+            vec[idx] -= 2 * eps
+            pp[pname] = jnp.asarray(vec.reshape(p[pname].shape), jnp.float32)
+            down = float(loss(pp))
+            numeric = (up - down) / (2 * eps)
+            assert abs(numeric - flatg[idx]) < 1e-1 + 0.05 * abs(numeric), (
+                f"{type(layer).__name__}.{pname}[{idx}]: {numeric} vs {flatg[idx]}")
+
+
+def test_registry_has_core_layers():
+    for kind in ["dense", "output", "conv2d", "subsampling", "batchnorm",
+                 "embedding", "globalpool", "dropoutlayer", "activation",
+                 "loss", "lrn", "zeropad", "upsampling2d"]:
+        assert kind in REGISTRY

@@ -1,0 +1,104 @@
+"""Loss catalog tests (ref: nd4j-tests LossFunctionGradientCheck /
+LossFunctionJson)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import losses as L
+from deeplearning4j_tpu.activations import Identity, Sigmoid, Softmax
+
+
+def test_catalog_size():
+    # reference has 17 loss impls (we add xent alias + wasserstein)
+    assert len(L.names()) >= 17
+
+
+def test_mse_value():
+    labels = jnp.array([[1.0, 2.0], [3.0, 4.0]])
+    preds = jnp.array([[1.5, 2.0], [2.0, 4.0]])
+    # per-example: sum((y-yhat)^2)/nOut
+    expect = np.array([(0.25 + 0) / 2, (1.0 + 0) / 2])
+    np.testing.assert_allclose(L.LossMSE().score_array(labels, preds), expect, atol=1e-6)
+    np.testing.assert_allclose(L.LossMSE().score(labels, preds), expect.mean(), atol=1e-6)
+
+
+def test_mcxent_fused_matches_unfused(rng):
+    k1, k2 = jax.random.split(rng)
+    preout = jax.random.normal(k1, (6, 5))
+    labels = jax.nn.one_hot(jax.random.randint(k2, (6,), 0, 5), 5)
+    fused = L.LossMCXENT().score(labels, preout, Softmax())
+    manual = -jnp.mean(jnp.sum(labels * jnp.log(jax.nn.softmax(preout)), axis=-1))
+    np.testing.assert_allclose(fused, manual, atol=1e-5)
+
+
+def test_binaryxent_fused_matches_unfused(rng):
+    preout = jax.random.normal(rng, (4, 3))
+    labels = (jax.random.uniform(jax.random.PRNGKey(1), (4, 3)) > 0.5).astype(jnp.float32)
+    fused = L.LossBinaryXENT().score(labels, preout, Sigmoid())
+    p = jax.nn.sigmoid(preout)
+    manual = -jnp.mean(jnp.sum(labels * jnp.log(p) + (1 - labels) * jnp.log(1 - p), axis=-1))
+    np.testing.assert_allclose(fused, manual, atol=1e-4)
+
+
+def test_masking():
+    labels = jnp.ones((2, 3))
+    preds = jnp.zeros((2, 3))
+    mask = jnp.array([1.0, 0.0])
+    sa = L.LossL2().score_array(labels, preds, Identity(), mask)
+    np.testing.assert_allclose(sa, [3.0, 0.0], atol=1e-6)
+    # average divides by number of unmasked examples
+    np.testing.assert_allclose(L.LossL2().score(labels, preds, Identity(), mask), 3.0, atol=1e-6)
+
+
+def test_weighted_loss():
+    labels = jnp.ones((1, 2))
+    preds = jnp.zeros((1, 2))
+    lf = L.LossL2(weights=[1.0, 3.0])
+    np.testing.assert_allclose(lf.score_array(labels, preds), [4.0], atol=1e-6)
+
+
+@pytest.mark.parametrize("name", [n for n in L.names() if n not in ("mixturedensity",)])
+def test_all_losses_finite_and_differentiable(name, rng):
+    lf = L.get(name)
+    k1, k2 = jax.random.split(rng)
+    preout = jax.random.normal(k1, (4, 6)) * 0.5
+    if name in ("mcxent", "negativeloglikelihood", "kld"):
+        labels = jax.nn.one_hot(jax.random.randint(k2, (4,), 0, 6), 6)
+        act = Softmax()
+    elif name in ("binaryxent", "xent", "multilabel", "fmeasure"):
+        labels = (jax.random.uniform(k2, (4, 6)) > 0.5).astype(jnp.float32)
+        act = Sigmoid()
+    elif name in ("hinge", "squaredhinge", "wasserstein"):
+        labels = jnp.sign(jax.random.normal(k2, (4, 6)))
+        act = Identity()
+    elif name in ("poisson", "msle", "mape"):
+        labels = jax.random.uniform(k2, (4, 6)) + 0.5
+        act = Sigmoid()
+        preout = jnp.abs(preout) + 0.1
+    else:
+        labels = jax.random.normal(k2, (4, 6))
+        act = Identity()
+    s = lf.score(labels, preout, act)
+    assert np.isfinite(float(s)), name
+    g = jax.grad(lambda p: lf.score(labels, p, act))(preout)
+    assert bool(jnp.all(jnp.isfinite(g))), name
+
+
+def test_mixture_density():
+    lf = L.LossMixtureDensity(mixtures=3, labels_width=2)
+    preout = jnp.zeros((5, 3 + 3 + 6))
+    labels = jnp.zeros((5, 2))
+    s = lf.score(labels, preout)
+    assert np.isfinite(float(s))
+    g = jax.grad(lambda p: lf.score(labels, p))(preout)
+    assert bool(jnp.all(jnp.isfinite(g)))
+
+
+def test_json_roundtrip():
+    for name in L.names():
+        if name == "mixturedensity":
+            lf = L.LossMixtureDensity(mixtures=2, labels_width=3)
+        else:
+            lf = L.get(name)
+        assert L.get(lf.to_json()) == lf

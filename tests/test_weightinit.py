@@ -1,0 +1,44 @@
+"""Weight init tests (ref: deeplearning4j-core WeightInitUtilTest / LegacyWeightInitTest)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import weightinit as W
+
+
+@pytest.mark.parametrize("scheme", [s for s in W.SCHEMES if s != "identity"])
+def test_all_schemes_shape_and_finite(scheme, rng):
+    w = W.init_weights(rng, (64, 32), fan_in=64, fan_out=32, scheme=scheme,
+                       distribution={"type": "normal", "mean": 0, "std": 1})
+    assert w.shape == (64, 32)
+    assert bool(jnp.all(jnp.isfinite(w)))
+
+
+def test_zero_ones():
+    k = jax.random.PRNGKey(0)
+    assert float(W.init_weights(k, (3, 3), 3, 3, "zero").sum()) == 0.0
+    assert float(W.init_weights(k, (3, 3), 3, 3, "ones").sum()) == 9.0
+
+
+def test_identity():
+    k = jax.random.PRNGKey(0)
+    np.testing.assert_allclose(W.init_weights(k, (4, 4), 4, 4, "identity"), np.eye(4))
+
+
+def test_xavier_variance(rng):
+    w = W.init_weights(rng, (1000, 500), 1000, 500, "xavier")
+    expect_std = np.sqrt(2.0 / 1500)
+    assert abs(float(w.std()) - expect_std) < 0.1 * expect_std
+
+
+def test_relu_variance(rng):
+    w = W.init_weights(rng, (1000, 500), 1000, 500, "relu")
+    expect_std = np.sqrt(2.0 / 1000)
+    assert abs(float(w.std()) - expect_std) < 0.1 * expect_std
+
+
+def test_deterministic(rng):
+    a = W.init_weights(rng, (8, 8), 8, 8, "xavier")
+    b = W.init_weights(rng, (8, 8), 8, 8, "xavier")
+    np.testing.assert_array_equal(a, b)
