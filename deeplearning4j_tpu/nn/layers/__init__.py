@@ -73,7 +73,7 @@ class Layer:
         builder's layer defaults + InputTypeUtil shape inference)."""
         defaults = defaults or {}
         if self.activation is None:
-            self.activation = A.get(defaults.get("activation", "identity"))
+            self.activation = A.get(defaults.get("activation") or "identity")
         if self.weight_init is None:
             self.weight_init = defaults.get("weight_init", "xavier")
         if self.updater is None and defaults.get("updater") is not None:
@@ -640,6 +640,12 @@ for _cls in list(globals().values()):
         REGISTRY[_cls.kind] = _cls
 
 
+def register(cls: type) -> type:
+    """Register a Layer subclass for JSON round-trip (submodules call this)."""
+    REGISTRY[cls.kind] = cls
+    return cls
+
+
 def from_json(d: dict) -> Layer:
     d = dict(d)
     kind = d.pop("@class")
@@ -650,14 +656,29 @@ def from_json(d: dict) -> Layer:
         d["updater"] = U.get(d["updater"])
     if "loss" in d and isinstance(d["loss"], dict):
         d["loss"] = L.get(d["loss"])
-    if "kernel" in d:
+    if isinstance(d.get("kernel"), list):
         d["kernel"] = tuple(d["kernel"])
-    if "stride" in d:
+    if isinstance(d.get("stride"), list):
         d["stride"] = tuple(d["stride"])
-    if "dilation" in d:
+    if isinstance(d.get("dilation"), list):
         d["dilation"] = tuple(d["dilation"])
-    if "size" in d:
+    if isinstance(d.get("size"), list):
         d["size"] = tuple(d["size"])
     if "padding" in d and isinstance(d["padding"], list):
         d["padding"] = tuple(tuple(p) for p in d["padding"])
     return cls(**d)
+
+
+# -- submodule layer catalogs (registered on import) -------------------
+from .recurrent import (BaseRecurrentLayer, Bidirectional,  # noqa: E402
+                        EmbeddingSequenceLayer, GravesBidirectionalLSTM,
+                        GravesLSTM, LastTimeStep, LSTM, MaskZeroLayer,
+                        RepeatVector, RnnLossLayer, RnnOutputLayer, SimpleRnn)
+
+for _cls in (LSTM, GravesLSTM, SimpleRnn, Bidirectional,
+             GravesBidirectionalLSTM, LastTimeStep, MaskZeroLayer,
+             EmbeddingSequenceLayer, RnnOutputLayer, RnnLossLayer,
+             RepeatVector):
+    register(_cls)
+
+from . import convolutional  # noqa: E402,F401  (registers conv-family layers)

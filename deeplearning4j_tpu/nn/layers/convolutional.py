@@ -1,0 +1,990 @@
+"""Conv-family layer breadth — 1D/3D convs, separable/depthwise/transpose
+convs, crops, pads, space<->depth, locally-connected, PReLU, frozen.
+
+Ref: deeplearning4j-nn `nn/conf/layers/{Convolution1DLayer,Convolution3D,
+Deconvolution2D,SeparableConvolution2D,DepthwiseConvolution2D,
+Subsampling1DLayer,Subsampling3DLayer,Upsampling1D,Upsampling3D,
+SpaceToDepthLayer,SpaceToBatchLayer,ZeroPadding1DLayer,ZeroPadding3DLayer,
+LocallyConnected1D,LocallyConnected2D,PReLULayer}.java`,
+`nn/conf/layers/convolutional/Cropping{1D,2D,3D}.java`,
+`nn/conf/layers/misc/{ElementWiseMultiplicationLayer,FrozenLayer}.java`.
+
+Layouts are TPU-native: 1D sequences [B, T, C] ("NWC"), 2D images
+[B, H, W, C] (NHWC), 3D volumes [B, D, H, W, C] (NDHWC) — the reference
+is channels-first everywhere. All convolutions lower to
+`lax.conv_general_dilated`, which XLA tiles onto the MXU.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...weightinit import init_weights
+from . import ConvolutionLayer, Layer, SubsamplingLayer, _pair, register
+
+
+def _tri(v) -> Tuple[int, int, int]:
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * 3
+
+
+def _conv_out(size, k, s, d, padding):
+    ek = (k - 1) * d + 1
+    if isinstance(padding, str) and padding.lower() == "same":
+        return -(-size // s)
+    return (size - ek) // s + 1
+
+
+@register
+class Convolution1D(Layer):
+    """1D conv over [B, T, C]. Ref: `nn/conf/layers/Convolution1DLayer.java`
+    (runtime `nn/layers/convolution/Convolution1DLayer.java` reshapes to 2D;
+    here it is a first-class rank-3 conv)."""
+
+    kind = "conv1d"
+
+    def __init__(self, n_out: int = None, kernel: int = 3, stride: int = 1,
+                 padding="same", dilation: int = 1, n_in: Optional[int] = None,
+                 has_bias: bool = True, **kw):
+        super().__init__(**kw)
+        self.n_out = int(n_out)
+        self.kernel = int(kernel if not isinstance(kernel, (tuple, list)) else kernel[0])
+        self.stride = int(stride if not isinstance(stride, (tuple, list)) else stride[0])
+        self.dilation = int(dilation if not isinstance(dilation, (tuple, list)) else dilation[0])
+        self.padding = padding
+        self.n_in = n_in
+        self.has_bias = bool(has_bias)
+
+    def build(self, input_shape, defaults=None):
+        super().build(input_shape, defaults)
+        if self.n_in is None:
+            self.n_in = int(input_shape[-1])
+
+    def param_shapes(self):
+        sh = {"W": (self.kernel, self.n_in, self.n_out)}
+        if self.has_bias:
+            sh["b"] = (self.n_out,)
+        return sh
+
+    def init_params(self, rng, dtype=jnp.float32):
+        fan_in = self.kernel * self.n_in
+        fan_out = self.kernel * self.n_out
+        p = {"W": init_weights(rng, (self.kernel, self.n_in, self.n_out),
+                               fan_in, fan_out, self.weight_init, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def _pad(self):
+        if isinstance(self.padding, str):
+            return self.padding.upper()
+        p = self.padding
+        if isinstance(p, int):
+            return ((p, p),)
+        return (tuple(int(x) for x in p),)
+
+    def apply(self, params, x, state, train, rng):
+        x = self._maybe_dropout(x, train, rng)
+        z = lax.conv_general_dilated(
+            x, params["W"], window_strides=(self.stride,), padding=self._pad(),
+            rhs_dilation=(self.dilation,),
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation(z), state
+
+    def output_shape(self, input_shape):
+        t = input_shape[0]
+        if t is None or t < 0:
+            return (t, self.n_out)
+        if isinstance(self.padding, str):
+            ot = _conv_out(t, self.kernel, self.stride, self.dilation, self.padding)
+        else:
+            p = self.padding if isinstance(self.padding, int) else sum(self.padding)
+            tot = 2 * p if isinstance(self.padding, int) else p
+            ek = (self.kernel - 1) * self.dilation + 1
+            ot = (t + tot - ek) // self.stride + 1
+        return (ot, self.n_out)
+
+    def _extra_json(self):
+        return {"n_out": self.n_out, "n_in": self.n_in, "kernel": self.kernel,
+                "stride": self.stride, "padding": self.padding,
+                "dilation": self.dilation, "has_bias": self.has_bias}
+
+
+@register
+class Convolution3D(Layer):
+    """3D conv over [B, D, H, W, C]. Ref: `nn/conf/layers/Convolution3D.java`."""
+
+    kind = "conv3d"
+
+    def __init__(self, n_out: int = None, kernel=(3, 3, 3), stride=(1, 1, 1),
+                 padding="same", dilation=(1, 1, 1), n_in: Optional[int] = None,
+                 has_bias: bool = True, **kw):
+        super().__init__(**kw)
+        self.n_out = int(n_out)
+        self.kernel = _tri(kernel)
+        self.stride = _tri(stride)
+        self.dilation = _tri(dilation)
+        self.padding = padding
+        self.n_in = n_in
+        self.has_bias = bool(has_bias)
+
+    def build(self, input_shape, defaults=None):
+        super().build(input_shape, defaults)
+        if self.n_in is None:
+            self.n_in = int(input_shape[-1])
+
+    def param_shapes(self):
+        kd, kh, kw_ = self.kernel
+        sh = {"W": (kd, kh, kw_, self.n_in, self.n_out)}
+        if self.has_bias:
+            sh["b"] = (self.n_out,)
+        return sh
+
+    def init_params(self, rng, dtype=jnp.float32):
+        kd, kh, kw_ = self.kernel
+        fan_in = kd * kh * kw_ * self.n_in
+        fan_out = kd * kh * kw_ * self.n_out
+        p = {"W": init_weights(rng, (kd, kh, kw_, self.n_in, self.n_out),
+                               fan_in, fan_out, self.weight_init, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def _pad(self):
+        if isinstance(self.padding, str):
+            return self.padding.upper()
+        return tuple(tuple(int(x) for x in p) for p in self.padding)
+
+    def apply(self, params, x, state, train, rng):
+        x = self._maybe_dropout(x, train, rng)
+        z = lax.conv_general_dilated(
+            x, params["W"], window_strides=self.stride, padding=self._pad(),
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation(z), state
+
+    def output_shape(self, input_shape):
+        dims = input_shape[:3]
+        out = tuple(_conv_out(dims[i], self.kernel[i], self.stride[i],
+                              self.dilation[i],
+                              self.padding if isinstance(self.padding, str) else "valid")
+                    for i in range(3))
+        return out + (self.n_out,)
+
+    def _extra_json(self):
+        return {"n_out": self.n_out, "n_in": self.n_in,
+                "kernel": list(self.kernel), "stride": list(self.stride),
+                "padding": self.padding, "dilation": list(self.dilation),
+                "has_bias": self.has_bias}
+
+
+@register
+class Deconvolution2D(Layer):
+    """Transposed conv (fractionally-strided). Ref:
+    `nn/conf/layers/Deconvolution2D.java`. Lowered to `lax.conv_transpose`."""
+
+    kind = "deconv2d"
+
+    def __init__(self, n_out: int = None, kernel=(2, 2), stride=(2, 2),
+                 padding="valid", n_in: Optional[int] = None,
+                 has_bias: bool = True, **kw):
+        super().__init__(**kw)
+        self.n_out = int(n_out)
+        self.kernel = _pair(kernel)
+        self.stride = _pair(stride)
+        self.padding = padding
+        self.n_in = n_in
+        self.has_bias = bool(has_bias)
+
+    def build(self, input_shape, defaults=None):
+        super().build(input_shape, defaults)
+        if self.n_in is None:
+            self.n_in = int(input_shape[-1])
+
+    def param_shapes(self):
+        kh, kw_ = self.kernel
+        sh = {"W": (kh, kw_, self.n_in, self.n_out)}
+        if self.has_bias:
+            sh["b"] = (self.n_out,)
+        return sh
+
+    def init_params(self, rng, dtype=jnp.float32):
+        kh, kw_ = self.kernel
+        fan_in = kh * kw_ * self.n_in
+        fan_out = kh * kw_ * self.n_out
+        p = {"W": init_weights(rng, (kh, kw_, self.n_in, self.n_out),
+                               fan_in, fan_out, self.weight_init, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def _pad(self):
+        if isinstance(self.padding, str):
+            return self.padding.upper()
+        return tuple(tuple(int(x) for x in p) for p in self.padding)
+
+    def apply(self, params, x, state, train, rng):
+        x = self._maybe_dropout(x, train, rng)
+        z = lax.conv_transpose(
+            x, params["W"], strides=self.stride, padding=self._pad(),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation(z), state
+
+    def output_shape(self, input_shape):
+        h, w, _ = input_shape
+        kh, kw_ = self.kernel
+        sh, sw = self.stride
+        if isinstance(self.padding, str) and self.padding.lower() == "same":
+            return (h * sh, w * sw, self.n_out)
+        return ((h - 1) * sh + kh, (w - 1) * sw + kw_, self.n_out)
+
+    def _extra_json(self):
+        return {"n_out": self.n_out, "n_in": self.n_in,
+                "kernel": list(self.kernel), "stride": list(self.stride),
+                "padding": self.padding, "has_bias": self.has_bias}
+
+
+@register
+class DepthwiseConvolution2D(Layer):
+    """Per-channel spatial conv with a depth multiplier. Ref:
+    `nn/conf/layers/DepthwiseConvolution2D.java`."""
+
+    kind = "depthwiseconv2d"
+
+    def __init__(self, depth_multiplier: int = 1, kernel=(3, 3), stride=(1, 1),
+                 padding="same", dilation=(1, 1), n_in: Optional[int] = None,
+                 has_bias: bool = True, **kw):
+        super().__init__(**kw)
+        self.depth_multiplier = int(depth_multiplier)
+        self.kernel = _pair(kernel)
+        self.stride = _pair(stride)
+        self.dilation = _pair(dilation)
+        self.padding = padding
+        self.n_in = n_in
+        self.has_bias = bool(has_bias)
+
+    def build(self, input_shape, defaults=None):
+        super().build(input_shape, defaults)
+        if self.n_in is None:
+            self.n_in = int(input_shape[-1])
+        self.n_out = self.n_in * self.depth_multiplier
+
+    def param_shapes(self):
+        kh, kw_ = self.kernel
+        sh = {"W": (kh, kw_, 1, self.n_out)}
+        if self.has_bias:
+            sh["b"] = (self.n_out,)
+        return sh
+
+    def init_params(self, rng, dtype=jnp.float32):
+        kh, kw_ = self.kernel
+        fan_in = kh * kw_
+        fan_out = kh * kw_ * self.depth_multiplier
+        p = {"W": init_weights(rng, (kh, kw_, 1, self.n_out), fan_in, fan_out,
+                               self.weight_init, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def _pad(self):
+        if isinstance(self.padding, str):
+            return self.padding.upper()
+        return tuple(tuple(int(x) for x in p) for p in self.padding)
+
+    def apply(self, params, x, state, train, rng):
+        x = self._maybe_dropout(x, train, rng)
+        z = lax.conv_general_dilated(
+            x, params["W"], window_strides=self.stride, padding=self._pad(),
+            rhs_dilation=self.dilation, feature_group_count=self.n_in,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation(z), state
+
+    def output_shape(self, input_shape):
+        h, w, _ = input_shape
+        pad = self.padding if isinstance(self.padding, str) else "valid"
+        return (_conv_out(h, self.kernel[0], self.stride[0], self.dilation[0], pad),
+                _conv_out(w, self.kernel[1], self.stride[1], self.dilation[1], pad),
+                self.n_out)
+
+    def _extra_json(self):
+        return {"depth_multiplier": self.depth_multiplier, "n_in": self.n_in,
+                "kernel": list(self.kernel), "stride": list(self.stride),
+                "padding": self.padding, "dilation": list(self.dilation),
+                "has_bias": self.has_bias}
+
+
+@register
+class SeparableConvolution2D(Layer):
+    """Depthwise + pointwise. Ref: `nn/conf/layers/SeparableConvolution2D.java`."""
+
+    kind = "sepconv2d"
+
+    def __init__(self, n_out: int = None, kernel=(3, 3), stride=(1, 1),
+                 padding="same", dilation=(1, 1), depth_multiplier: int = 1,
+                 n_in: Optional[int] = None, has_bias: bool = True, **kw):
+        super().__init__(**kw)
+        self.n_out = int(n_out)
+        self.kernel = _pair(kernel)
+        self.stride = _pair(stride)
+        self.dilation = _pair(dilation)
+        self.padding = padding
+        self.depth_multiplier = int(depth_multiplier)
+        self.n_in = n_in
+        self.has_bias = bool(has_bias)
+
+    def build(self, input_shape, defaults=None):
+        super().build(input_shape, defaults)
+        if self.n_in is None:
+            self.n_in = int(input_shape[-1])
+
+    def param_shapes(self):
+        kh, kw_ = self.kernel
+        mid = self.n_in * self.depth_multiplier
+        sh = {"dW": (kh, kw_, 1, mid), "pW": (1, 1, mid, self.n_out)}
+        if self.has_bias:
+            sh["b"] = (self.n_out,)
+        return sh
+
+    def init_params(self, rng, dtype=jnp.float32):
+        kd, kp = jax.random.split(rng)
+        kh, kw_ = self.kernel
+        mid = self.n_in * self.depth_multiplier
+        p = {"dW": init_weights(kd, (kh, kw_, 1, mid), kh * kw_,
+                                kh * kw_ * self.depth_multiplier,
+                                self.weight_init, dtype),
+             "pW": init_weights(kp, (1, 1, mid, self.n_out), mid, self.n_out,
+                                self.weight_init, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def _pad(self):
+        if isinstance(self.padding, str):
+            return self.padding.upper()
+        return tuple(tuple(int(x) for x in p) for p in self.padding)
+
+    def apply(self, params, x, state, train, rng):
+        x = self._maybe_dropout(x, train, rng)
+        z = lax.conv_general_dilated(
+            x, params["dW"], window_strides=self.stride, padding=self._pad(),
+            rhs_dilation=self.dilation, feature_group_count=self.n_in,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        z = lax.conv_general_dilated(
+            z, params["pW"], window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation(z), state
+
+    def output_shape(self, input_shape):
+        h, w, _ = input_shape
+        pad = self.padding if isinstance(self.padding, str) else "valid"
+        return (_conv_out(h, self.kernel[0], self.stride[0], self.dilation[0], pad),
+                _conv_out(w, self.kernel[1], self.stride[1], self.dilation[1], pad),
+                self.n_out)
+
+    def _extra_json(self):
+        return {"n_out": self.n_out, "n_in": self.n_in,
+                "kernel": list(self.kernel), "stride": list(self.stride),
+                "padding": self.padding, "dilation": list(self.dilation),
+                "depth_multiplier": self.depth_multiplier,
+                "has_bias": self.has_bias}
+
+
+@register
+class Subsampling1DLayer(Layer):
+    """1D pooling over [B, T, C]. Ref: `nn/conf/layers/Subsampling1DLayer.java`."""
+
+    kind = "subsampling1d"
+
+    def __init__(self, kernel: int = 2, stride: int = 2, padding="valid",
+                 pooling: str = "max", **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        self.kernel = int(kernel)
+        self.stride = int(stride)
+        self.padding = padding
+        self.pooling = pooling
+
+    def apply(self, params, x, state, train, rng):
+        pad = self.padding.upper() if isinstance(self.padding, str) else \
+            ((0, 0), tuple(self.padding), (0, 0))
+        window = (1, self.kernel, 1)
+        strides = (1, self.stride, 1)
+        if self.pooling == "max":
+            z = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pad)
+        else:
+            s = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+            c = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window,
+                                  strides, pad)
+            z = s / c
+        return z, state
+
+    def output_shape(self, input_shape):
+        t, c = input_shape
+        if isinstance(self.padding, str) and self.padding.lower() == "same":
+            return (-(-t // self.stride), c)
+        return ((t - self.kernel) // self.stride + 1, c)
+
+    def _extra_json(self):
+        return {"kernel": self.kernel, "stride": self.stride,
+                "padding": self.padding, "pooling": self.pooling}
+
+
+@register
+class Subsampling3DLayer(Layer):
+    """3D pooling over [B, D, H, W, C]. Ref: `nn/conf/layers/Subsampling3DLayer.java`."""
+
+    kind = "subsampling3d"
+
+    def __init__(self, kernel=(2, 2, 2), stride=(2, 2, 2), padding="valid",
+                 pooling: str = "max", **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        self.kernel = _tri(kernel)
+        self.stride = _tri(stride)
+        self.padding = padding
+        self.pooling = pooling
+
+    def apply(self, params, x, state, train, rng):
+        pad = self.padding.upper() if isinstance(self.padding, str) else \
+            ((0, 0),) + tuple(tuple(p) for p in self.padding) + ((0, 0),)
+        window = (1,) + self.kernel + (1,)
+        strides = (1,) + self.stride + (1,)
+        if self.pooling == "max":
+            z = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pad)
+        else:
+            s = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+            c = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window,
+                                  strides, pad)
+            z = s / c
+        return z, state
+
+    def output_shape(self, input_shape):
+        d, h, w, c = input_shape
+        if isinstance(self.padding, str) and self.padding.lower() == "same":
+            return tuple(-(-v // s) for v, s in zip((d, h, w), self.stride)) + (c,)
+        return tuple((v - k) // s + 1 for v, k, s in
+                     zip((d, h, w), self.kernel, self.stride)) + (c,)
+
+    def _extra_json(self):
+        return {"kernel": list(self.kernel), "stride": list(self.stride),
+                "padding": self.padding, "pooling": self.pooling}
+
+
+@register
+class Upsampling1D(Layer):
+    """Ref: `nn/conf/layers/Upsampling1D.java`."""
+
+    kind = "upsampling1d"
+
+    def __init__(self, size: int = 2, **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        self.size = int(size)
+
+    def apply(self, params, x, state, train, rng):
+        return jnp.repeat(x, self.size, axis=1), state
+
+    def output_shape(self, input_shape):
+        t, c = input_shape
+        return (t * self.size, c)
+
+    def _extra_json(self):
+        return {"size": self.size}
+
+
+@register
+class Upsampling3D(Layer):
+    """Ref: `nn/conf/layers/Upsampling3D.java`."""
+
+    kind = "upsampling3d"
+
+    def __init__(self, size=(2, 2, 2), **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        self.size = _tri(size)
+
+    def apply(self, params, x, state, train, rng):
+        z = x
+        for axis, s in zip((1, 2, 3), self.size):
+            z = jnp.repeat(z, s, axis=axis)
+        return z, state
+
+    def output_shape(self, input_shape):
+        d, h, w, c = input_shape
+        return (d * self.size[0], h * self.size[1], w * self.size[2], c)
+
+    def _extra_json(self):
+        return {"size": list(self.size)}
+
+
+@register
+class Cropping1D(Layer):
+    """Ref: `nn/conf/layers/convolutional/Cropping1D.java`."""
+
+    kind = "cropping1d"
+
+    def __init__(self, cropping=(0, 0), **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        if isinstance(cropping, int):
+            cropping = (cropping, cropping)
+        self.cropping = tuple(int(x) for x in cropping)
+
+    def apply(self, params, x, state, train, rng):
+        a, b = self.cropping
+        return x[:, a:x.shape[1] - b if b else None, :], state
+
+    def output_shape(self, input_shape):
+        t, c = input_shape
+        return (t - sum(self.cropping), c)
+
+    def _extra_json(self):
+        return {"cropping": list(self.cropping)}
+
+
+@register
+class Cropping2D(Layer):
+    """Ref: `nn/conf/layers/convolutional/Cropping2D.java`."""
+
+    kind = "cropping2d"
+
+    def __init__(self, cropping=((0, 0), (0, 0)), **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        if isinstance(cropping, int):
+            cropping = ((cropping, cropping), (cropping, cropping))
+        self.cropping = tuple(tuple(int(x) for x in p) for p in cropping)
+
+    def apply(self, params, x, state, train, rng):
+        (t, b), (l, r) = self.cropping
+        return x[:, t:x.shape[1] - b if b else None,
+                 l:x.shape[2] - r if r else None, :], state
+
+    def output_shape(self, input_shape):
+        h, w, c = input_shape
+        (t, b), (l, r) = self.cropping
+        return (h - t - b, w - l - r, c)
+
+    def _extra_json(self):
+        return {"cropping": [list(p) for p in self.cropping]}
+
+
+@register
+class Cropping3D(Layer):
+    """Ref: `nn/conf/layers/convolutional/Cropping3D.java`."""
+
+    kind = "cropping3d"
+
+    def __init__(self, cropping=((0, 0), (0, 0), (0, 0)), **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        if isinstance(cropping, int):
+            cropping = ((cropping,) * 2,) * 3
+        self.cropping = tuple(tuple(int(x) for x in p) for p in cropping)
+
+    def apply(self, params, x, state, train, rng):
+        (d0, d1), (h0, h1), (w0, w1) = self.cropping
+        return x[:, d0:x.shape[1] - d1 if d1 else None,
+                 h0:x.shape[2] - h1 if h1 else None,
+                 w0:x.shape[3] - w1 if w1 else None, :], state
+
+    def output_shape(self, input_shape):
+        d, h, w, c = input_shape
+        (d0, d1), (h0, h1), (w0, w1) = self.cropping
+        return (d - d0 - d1, h - h0 - h1, w - w0 - w1, c)
+
+    def _extra_json(self):
+        return {"cropping": [list(p) for p in self.cropping]}
+
+
+@register
+class ZeroPadding1DLayer(Layer):
+    """Ref: `nn/conf/layers/ZeroPadding1DLayer.java`."""
+
+    kind = "zeropad1d"
+
+    def __init__(self, padding=(1, 1), **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        if isinstance(padding, int):
+            padding = (padding, padding)
+        self.padding = tuple(int(x) for x in padding)
+
+    def apply(self, params, x, state, train, rng):
+        a, b = self.padding
+        return jnp.pad(x, ((0, 0), (a, b), (0, 0))), state
+
+    def output_shape(self, input_shape):
+        t, c = input_shape
+        return (t + sum(self.padding), c)
+
+    def _extra_json(self):
+        return {"padding": list(self.padding)}
+
+
+@register
+class ZeroPadding3DLayer(Layer):
+    """Ref: `nn/conf/layers/ZeroPadding3DLayer.java`."""
+
+    kind = "zeropad3d"
+
+    def __init__(self, padding=((1, 1), (1, 1), (1, 1)), **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        if isinstance(padding, int):
+            padding = ((padding,) * 2,) * 3
+        self.padding = tuple(tuple(int(x) for x in p) for p in padding)
+
+    def apply(self, params, x, state, train, rng):
+        (d0, d1), (h0, h1), (w0, w1) = self.padding
+        return jnp.pad(x, ((0, 0), (d0, d1), (h0, h1), (w0, w1), (0, 0))), state
+
+    def output_shape(self, input_shape):
+        d, h, w, c = input_shape
+        (d0, d1), (h0, h1), (w0, w1) = self.padding
+        return (d + d0 + d1, h + h0 + h1, w + w0 + w1, c)
+
+    def _extra_json(self):
+        return {"padding": [list(p) for p in self.padding]}
+
+
+@register
+class SpaceToDepthLayer(Layer):
+    """Ref: `nn/conf/layers/SpaceToDepthLayer.java`."""
+
+    kind = "spacetodepth"
+
+    def __init__(self, block_size: int = 2, **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        self.block_size = int(block_size)
+
+    def apply(self, params, x, state, train, rng):
+        B, H, W, C = x.shape
+        s = self.block_size
+        z = x.reshape(B, H // s, s, W // s, s, C)
+        z = z.transpose(0, 1, 3, 2, 4, 5).reshape(B, H // s, W // s, C * s * s)
+        return z, state
+
+    def output_shape(self, input_shape):
+        h, w, c = input_shape
+        s = self.block_size
+        return (h // s, w // s, c * s * s)
+
+    def _extra_json(self):
+        return {"block_size": self.block_size}
+
+
+@register
+class DepthToSpaceLayer(Layer):
+    """Inverse of SpaceToDepth (libnd4j `depth_to_space` op —
+    `include/ops/declarable/headers/parity_ops.h`)."""
+
+    kind = "depthtospace"
+
+    def __init__(self, block_size: int = 2, **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        self.block_size = int(block_size)
+
+    def apply(self, params, x, state, train, rng):
+        B, H, W, C = x.shape
+        s = self.block_size
+        z = x.reshape(B, H, W, s, s, C // (s * s))
+        z = z.transpose(0, 1, 3, 2, 4, 5).reshape(B, H * s, W * s, C // (s * s))
+        return z, state
+
+    def output_shape(self, input_shape):
+        h, w, c = input_shape
+        s = self.block_size
+        return (h * s, w * s, c // (s * s))
+
+    def _extra_json(self):
+        return {"block_size": self.block_size}
+
+
+@register
+class SpaceToBatchLayer(Layer):
+    """Ref: `nn/conf/layers/SpaceToBatchLayer.java`."""
+
+    kind = "spacetobatch"
+
+    def __init__(self, blocks=(2, 2), padding=((0, 0), (0, 0)), **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        self.blocks = _pair(blocks)
+        self.padding = tuple(tuple(int(x) for x in p) for p in padding)
+
+    def apply(self, params, x, state, train, rng):
+        (pt, pb), (pl, pr) = self.padding
+        x = jnp.pad(x, ((0, 0), (pt, pb), (pl, pr), (0, 0)))
+        B, H, W, C = x.shape
+        bh, bw = self.blocks
+        z = x.reshape(B, H // bh, bh, W // bw, bw, C)
+        z = z.transpose(2, 4, 0, 1, 3, 5).reshape(B * bh * bw, H // bh, W // bw, C)
+        return z, state
+
+    def output_shape(self, input_shape):
+        h, w, c = input_shape
+        (pt, pb), (pl, pr) = self.padding
+        return ((h + pt + pb) // self.blocks[0],
+                (w + pl + pr) // self.blocks[1], c)
+
+    def _extra_json(self):
+        return {"blocks": list(self.blocks),
+                "padding": [list(p) for p in self.padding]}
+
+
+@register
+class PReLULayer(Layer):
+    """Parametric ReLU with learned per-channel alpha. Ref:
+    `nn/conf/layers/PReLULayer.java`."""
+
+    kind = "prelu"
+
+    def __init__(self, alpha_init: float = 0.0, shared_axes=None, **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        self.alpha_init = float(alpha_init)
+        self.shared_axes = shared_axes
+
+    def build(self, input_shape, defaults=None):
+        super().build(input_shape, defaults)
+        shape = list(input_shape)
+        if self.shared_axes:
+            for ax in self.shared_axes:  # 1-based feature axes (ref parity)
+                shape[ax - 1] = 1
+        self._alpha_shape = tuple(shape)
+
+    def param_shapes(self):
+        return {"alpha": self._alpha_shape}
+
+    def init_params(self, rng, dtype=jnp.float32):
+        return {"alpha": jnp.full(self._alpha_shape, self.alpha_init, dtype)}
+
+    def apply(self, params, x, state, train, rng):
+        a = params["alpha"]
+        return jnp.where(x >= 0, x, a * x), state
+
+    def _extra_json(self):
+        return {"alpha_init": self.alpha_init, "shared_axes": self.shared_axes}
+
+
+@register
+class ElementWiseMultiplicationLayer(Layer):
+    """out = activation(x * w + b) with learned elementwise w. Ref:
+    `nn/conf/layers/misc/ElementWiseMultiplicationLayer.java`."""
+
+    kind = "elementwisemult"
+
+    def __init__(self, n_out: Optional[int] = None, **kw):
+        super().__init__(**kw)
+        self.n_out = n_out
+
+    def build(self, input_shape, defaults=None):
+        super().build(input_shape, defaults)
+        self.n_out = int(input_shape[-1])
+
+    def param_shapes(self):
+        return {"w": (self.n_out,), "b": (self.n_out,)}
+
+    def init_params(self, rng, dtype=jnp.float32):
+        return {"w": jnp.ones((self.n_out,), dtype),
+                "b": jnp.full((self.n_out,), self.bias_init, dtype)}
+
+    def apply(self, params, x, state, train, rng):
+        return self.activation(x * params["w"] + params["b"]), state
+
+    def _extra_json(self):
+        return {"n_out": self.n_out}
+
+
+@register
+class LocallyConnected2D(Layer):
+    """Conv with untied (per-position) weights. Ref:
+    `nn/conf/layers/LocallyConnected2D.java` (samediff-defined in the
+    reference). Implemented via patch extraction + per-position einsum —
+    one big batched matmul for the MXU. Weight layout: [oh*ow,
+    C*kh*kw, n_out] where the patch axis is channel-major (C, kh, kw) —
+    the feature order `lax.conv_general_dilated_patches` emits."""
+
+    kind = "locallyconnected2d"
+
+    def __init__(self, n_out: int = None, kernel=(2, 2), stride=(1, 1),
+                 n_in: Optional[int] = None, has_bias: bool = True, **kw):
+        super().__init__(**kw)
+        self.n_out = int(n_out)
+        self.kernel = _pair(kernel)
+        self.stride = _pair(stride)
+        self.n_in = n_in
+        self.has_bias = bool(has_bias)
+
+    def build(self, input_shape, defaults=None):
+        super().build(input_shape, defaults)
+        if self.n_in is None:
+            self.n_in = int(input_shape[-1])
+        h, w, _ = input_shape
+        self._oh = (h - self.kernel[0]) // self.stride[0] + 1
+        self._ow = (w - self.kernel[1]) // self.stride[1] + 1
+
+    def param_shapes(self):
+        kh, kw_ = self.kernel
+        sh = {"W": (self._oh * self._ow, kh * kw_ * self.n_in, self.n_out)}
+        if self.has_bias:
+            sh["b"] = (self._oh, self._ow, self.n_out)
+        return sh
+
+    def init_params(self, rng, dtype=jnp.float32):
+        kh, kw_ = self.kernel
+        fan_in = kh * kw_ * self.n_in
+        p = {"W": init_weights(rng, (self._oh * self._ow, fan_in, self.n_out),
+                               fan_in, self.n_out, self.weight_init, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self._oh, self._ow, self.n_out),
+                              self.bias_init, dtype)
+        return p
+
+    def apply(self, params, x, state, train, rng):
+        x = self._maybe_dropout(x, train, rng)
+        kh, kw_ = self.kernel
+        sh, sw = self.stride
+        B = x.shape[0]
+        patches = lax.conv_general_dilated_patches(
+            x, (kh, kw_), (sh, sw), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))  # [B, oh, ow, kh*kw*C]
+        P = patches.reshape(B, self._oh * self._ow, -1)
+        z = jnp.einsum("bpk,pko->bpo", P, params["W"])
+        z = z.reshape(B, self._oh, self._ow, self.n_out)
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation(z), state
+
+    def output_shape(self, input_shape):
+        return (self._oh, self._ow, self.n_out)
+
+    def _extra_json(self):
+        return {"n_out": self.n_out, "n_in": self.n_in,
+                "kernel": list(self.kernel), "stride": list(self.stride),
+                "has_bias": self.has_bias}
+
+
+@register
+class LocallyConnected1D(Layer):
+    """Ref: `nn/conf/layers/LocallyConnected1D.java`."""
+
+    kind = "locallyconnected1d"
+
+    def __init__(self, n_out: int = None, kernel: int = 2, stride: int = 1,
+                 n_in: Optional[int] = None, has_bias: bool = True, **kw):
+        super().__init__(**kw)
+        self.n_out = int(n_out)
+        self.kernel = int(kernel)
+        self.stride = int(stride)
+        self.n_in = n_in
+        self.has_bias = bool(has_bias)
+
+    def build(self, input_shape, defaults=None):
+        super().build(input_shape, defaults)
+        if self.n_in is None:
+            self.n_in = int(input_shape[-1])
+        self._ot = (input_shape[0] - self.kernel) // self.stride + 1
+
+    def param_shapes(self):
+        sh = {"W": (self._ot, self.kernel * self.n_in, self.n_out)}
+        if self.has_bias:
+            sh["b"] = (self._ot, self.n_out)
+        return sh
+
+    def init_params(self, rng, dtype=jnp.float32):
+        fan_in = self.kernel * self.n_in
+        p = {"W": init_weights(rng, (self._ot, fan_in, self.n_out), fan_in,
+                               self.n_out, self.weight_init, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self._ot, self.n_out), self.bias_init, dtype)
+        return p
+
+    def apply(self, params, x, state, train, rng):
+        x = self._maybe_dropout(x, train, rng)
+        B = x.shape[0]
+        patches = lax.conv_general_dilated_patches(
+            x, (self.kernel,), (self.stride,), "VALID",
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        P = patches.reshape(B, self._ot, -1)
+        z = jnp.einsum("btk,tko->bto", P, params["W"])
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation(z), state
+
+    def output_shape(self, input_shape):
+        return (self._ot, self.n_out)
+
+    def _extra_json(self):
+        return {"n_out": self.n_out, "n_in": self.n_in, "kernel": self.kernel,
+                "stride": self.stride, "has_bias": self.has_bias}
+
+
+@register
+class FrozenLayer(Layer):
+    """Wrapper that blocks gradient flow into the wrapped layer's params.
+    Ref: `nn/conf/layers/misc/FrozenLayer.java` (used by TransferLearning).
+    Implemented with `lax.stop_gradient` on the params — the updater then
+    sees zero gradients, params stay fixed."""
+
+    kind = "frozen"
+
+    def __init__(self, layer=None, **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        if isinstance(layer, dict):
+            from . import from_json
+            layer = from_json(layer)
+        self.layer = layer
+
+    @property
+    def is_rnn(self):
+        return getattr(self.layer, "is_rnn", False)
+
+    def build(self, input_shape, defaults=None):
+        super().build(input_shape, defaults)
+        self.layer.build(input_shape, defaults)
+
+    def param_shapes(self):
+        return self.layer.param_shapes()
+
+    def init_params(self, rng, dtype=jnp.float32):
+        return self.layer.init_params(rng, dtype)
+
+    def init_state(self):
+        return self.layer.init_state()
+
+    def init_carry(self, batch, dtype=jnp.float32):
+        return self.layer.init_carry(batch, dtype)
+
+    def apply(self, params, x, state, train, rng):
+        params = jax.tree_util.tree_map(lax.stop_gradient, params)
+        return self.layer.apply(params, x, state, False, rng)
+
+    def apply_seq(self, params, x, state, train, rng, carry, mask):
+        params = jax.tree_util.tree_map(lax.stop_gradient, params)
+        return self.layer.apply_seq(params, x, state, False, rng, carry, mask)
+
+    def output_shape(self, input_shape):
+        return self.layer.output_shape(input_shape)
+
+    def _extra_json(self):
+        return {"layer": self.layer.to_json()}

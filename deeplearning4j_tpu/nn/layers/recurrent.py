@@ -1,0 +1,592 @@
+"""Recurrent layers — TPU-first scan-based RNNs.
+
+Ref: deeplearning4j-nn `nn/conf/layers/{LSTM,GravesLSTM,AbstractLSTM,
+BaseRecurrentLayer,RnnOutputLayer,RnnLossLayer}.java`,
+`nn/conf/layers/recurrent/{SimpleRnn,Bidirectional,LastTimeStep}.java`,
+runtime `nn/layers/recurrent/{LSTM,GravesLSTM,SimpleRnn,
+BidirectionalLayer,LastTimeStepLayer,MaskZeroLayer}.java` and
+`LSTMHelpers.java` (the hand-written fwd/bwd math).
+
+TPU-first redesign:
+  - Layout is [B, T, C] (batch, time, channel) — the reference is
+    [B, C, T]. Time-major only inside the scan.
+  - The input projection for ALL timesteps is hoisted out of the
+    recurrence as ONE [B*T, C] x [C, 4H] matmul (MXU-sized), so the
+    `lax.scan` body only carries the small [B,H] x [H,4H] recurrent
+    matmul + elementwise gate math, which XLA fuses.
+  - Backprop through time comes from JAX autodiff of the scan (the
+    reference hand-writes BPTT in LSTMHelpers.backpropGradientHelper).
+  - Masking (variable-length sequences): mask [B, T] with 1=real step.
+    Masked steps hold the carried state and emit zeros, matching the
+    reference's mask semantics in LSTMHelpers (state held, output
+    zeroed by the mask when applied).
+  - Stateful truncated-BPTT / rnnTimeStep carry is explicit: every
+    recurrent layer implements `init_carry(batch)` / `apply_seq(...,
+    carry, mask)`; the network threads carries functionally.
+
+Gate layout in the fused 4H axis is [i | f | g | o] (input, forget,
+cell-candidate, output) — chosen to match Keras HDF5 kernel layout so the
+Keras importer maps weights without reordering.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ... import activations as A
+from ... import losses as L
+from ...weightinit import init_weights
+from . import DenseLayer, Layer, LossLayer, Shape
+
+
+class BaseRecurrentLayer(Layer):
+    """Ref: `nn/conf/layers/BaseRecurrentLayer.java`."""
+
+    is_rnn = True
+
+    def __init__(self, n_out: int = None, n_in: Optional[int] = None, **kw):
+        super().__init__(**kw)
+        self.n_in = n_in
+        self.n_out = int(n_out)
+
+    def build(self, input_shape, defaults=None):
+        super().build(input_shape, defaults)
+        if self.n_in is None:
+            self.n_in = int(input_shape[-1])
+
+    def output_shape(self, input_shape):
+        return (input_shape[0], self.n_out)
+
+    # -- carry protocol -------------------------------------------------
+    def init_carry(self, batch: int, dtype=jnp.float32):
+        raise NotImplementedError
+
+    def apply_seq(self, params, x, state, train, rng, carry, mask):
+        """x: [B, T, C]; carry: layer-specific pytree; mask: [B, T] or None.
+        Returns (out [B, T, H], new_layer_state, new_carry)."""
+        raise NotImplementedError
+
+    def apply(self, params, x, state, train, rng):
+        out, st, _ = self.apply_seq(params, x, state, train, rng,
+                                    self.init_carry(x.shape[0], x.dtype), None)
+        return out, st
+
+    def _extra_json(self):
+        return {"n_out": self.n_out, "n_in": self.n_in}
+
+
+def _mask_step(mask_t, new_val, old_val):
+    """Hold the carried state where mask==0 (ended sequences)."""
+    m = mask_t[:, None]
+    return jnp.where(m > 0, new_val, old_val)
+
+
+class LSTM(BaseRecurrentLayer):
+    """Standard (non-peephole) LSTM. Ref: `nn/conf/layers/LSTM.java` +
+    `nn/layers/recurrent/LSTMHelpers.activateHelper` (forward math);
+    forget-gate bias init default 1.0 (`AbstractLSTM.Builder`)."""
+
+    kind = "lstm"
+
+    def __init__(self, n_out: int = None, forget_gate_bias_init: float = 1.0,
+                 gate_activation="sigmoid", **kw):
+        kw.setdefault("activation", "tanh")
+        super().__init__(n_out=n_out, **kw)
+        self.forget_gate_bias_init = float(forget_gate_bias_init)
+        self.gate_activation = A.get(gate_activation)
+
+    def param_shapes(self):
+        return {"W": (self.n_in, 4 * self.n_out),
+                "U": (self.n_out, 4 * self.n_out),
+                "b": (4 * self.n_out,)}
+
+    def init_params(self, rng, dtype=jnp.float32):
+        kW, kU = jax.random.split(rng)
+        H = self.n_out
+        b = np.zeros(4 * H, np.float32)
+        b[H:2 * H] = self.forget_gate_bias_init  # [i|f|g|o] layout
+        return {
+            "W": init_weights(kW, (self.n_in, 4 * H), self.n_in, 4 * H,
+                              self.weight_init, dtype),
+            "U": init_weights(kU, (H, 4 * H), H, 4 * H, self.weight_init, dtype),
+            "b": jnp.asarray(b, dtype),
+        }
+
+    def init_carry(self, batch, dtype=jnp.float32):
+        H = self.n_out
+        return (jnp.zeros((batch, H), dtype), jnp.zeros((batch, H), dtype))
+
+    def _gates(self, z, c_prev):
+        H = self.n_out
+        i = self.gate_activation(z[:, :H])
+        f = self.gate_activation(z[:, H:2 * H])
+        g = self.activation(z[:, 2 * H:3 * H])
+        o = self.gate_activation(z[:, 3 * H:])
+        c = f * c_prev + i * g
+        h = o * self.activation(c)
+        return h, c
+
+    def apply_seq(self, params, x, state, train, rng, carry, mask):
+        x = self._maybe_dropout(x, train, rng)
+        B, T, _ = x.shape
+        # hoisted input projection: one big MXU matmul over all timesteps
+        xz = (x.reshape(B * T, -1) @ params["W"]).reshape(B, T, -1) + params["b"]
+        xz_t = jnp.swapaxes(xz, 0, 1)                       # [T, B, 4H]
+        mask_t = None if mask is None else jnp.swapaxes(
+            mask.astype(x.dtype), 0, 1)                     # [T, B]
+        U = params["U"]
+
+        def step(hc, inp):
+            h_prev, c_prev = hc
+            if mask is None:
+                z_t = inp
+                h, c = self._gates(z_t + h_prev @ U, c_prev)
+                return (h, c), h
+            z_t, m_t = inp
+            h, c = self._gates(z_t + h_prev @ U, c_prev)
+            h = _mask_step(m_t, h, h_prev)
+            c = _mask_step(m_t, c, c_prev)
+            return (h, c), h * m_t[:, None]
+
+        xs = xz_t if mask is None else (xz_t, mask_t)
+        (h, c), out_t = lax.scan(step, carry, xs)
+        return jnp.swapaxes(out_t, 0, 1), state, (h, c)
+
+    def _extra_json(self):
+        d = super()._extra_json()
+        d["forget_gate_bias_init"] = self.forget_gate_bias_init
+        d["gate_activation"] = self.gate_activation.to_json()
+        return d
+
+
+class GravesLSTM(LSTM):
+    """Peephole LSTM (Graves 2013 formulation). Ref:
+    `nn/conf/layers/GravesLSTM.java` / `LSTMHelpers.java` (peephole
+    weights from c_{t-1} into input+forget gates and c_t into output)."""
+
+    kind = "graveslstm"
+
+    def param_shapes(self):
+        sh = super().param_shapes()
+        sh["p"] = (3 * self.n_out,)  # [p_i | p_f | p_o]
+        return sh
+
+    def init_params(self, rng, dtype=jnp.float32):
+        p = super().init_params(rng, dtype)
+        p["p"] = jnp.zeros((3 * self.n_out,), dtype)
+        return p
+
+    def apply_seq(self, params, x, state, train, rng, carry, mask):
+        x = self._maybe_dropout(x, train, rng)
+        B, T, _ = x.shape
+        H = self.n_out
+        xz = (x.reshape(B * T, -1) @ params["W"]).reshape(B, T, -1) + params["b"]
+        xz_t = jnp.swapaxes(xz, 0, 1)
+        mask_t = None if mask is None else jnp.swapaxes(
+            mask.astype(x.dtype), 0, 1)
+        U, peep = params["U"], params["p"]
+        p_i, p_f, p_o = peep[:H], peep[H:2 * H], peep[2 * H:]
+
+        def cell(z, c_prev):
+            i = self.gate_activation(z[:, :H] + c_prev * p_i)
+            f = self.gate_activation(z[:, H:2 * H] + c_prev * p_f)
+            g = self.activation(z[:, 2 * H:3 * H])
+            c = f * c_prev + i * g
+            o = self.gate_activation(z[:, 3 * H:] + c * p_o)
+            h = o * self.activation(c)
+            return h, c
+
+        def step(hc, inp):
+            h_prev, c_prev = hc
+            if mask is None:
+                h, c = cell(inp + h_prev @ U, c_prev)
+                return (h, c), h
+            z_t, m_t = inp
+            h, c = cell(z_t + h_prev @ U, c_prev)
+            h = _mask_step(m_t, h, h_prev)
+            c = _mask_step(m_t, c, c_prev)
+            return (h, c), h * m_t[:, None]
+
+        xs = xz_t if mask is None else (xz_t, mask_t)
+        (h, c), out_t = lax.scan(step, carry, xs)
+        return jnp.swapaxes(out_t, 0, 1), state, (h, c)
+
+
+class SimpleRnn(BaseRecurrentLayer):
+    """Vanilla RNN: h_t = act(x_t·W + h_{t-1}·U + b).
+    Ref: `nn/conf/layers/recurrent/SimpleRnn.java`."""
+
+    kind = "simplernn"
+
+    def __init__(self, n_out: int = None, **kw):
+        kw.setdefault("activation", "tanh")
+        super().__init__(n_out=n_out, **kw)
+
+    def param_shapes(self):
+        return {"W": (self.n_in, self.n_out), "U": (self.n_out, self.n_out),
+                "b": (self.n_out,)}
+
+    def init_params(self, rng, dtype=jnp.float32):
+        kW, kU = jax.random.split(rng)
+        return {"W": init_weights(kW, (self.n_in, self.n_out), self.n_in,
+                                  self.n_out, self.weight_init, dtype),
+                "U": init_weights(kU, (self.n_out, self.n_out), self.n_out,
+                                  self.n_out, self.weight_init, dtype),
+                "b": jnp.full((self.n_out,), self.bias_init, dtype)}
+
+    def init_carry(self, batch, dtype=jnp.float32):
+        return jnp.zeros((batch, self.n_out), dtype)
+
+    def apply_seq(self, params, x, state, train, rng, carry, mask):
+        x = self._maybe_dropout(x, train, rng)
+        B, T, _ = x.shape
+        xz = (x.reshape(B * T, -1) @ params["W"]).reshape(B, T, -1) + params["b"]
+        xz_t = jnp.swapaxes(xz, 0, 1)
+        mask_t = None if mask is None else jnp.swapaxes(
+            mask.astype(x.dtype), 0, 1)
+        U = params["U"]
+
+        def step(h_prev, inp):
+            if mask is None:
+                h = self.activation(inp + h_prev @ U)
+                return h, h
+            z_t, m_t = inp
+            h = self.activation(z_t + h_prev @ U)
+            h = _mask_step(m_t, h, h_prev)
+            return h, h * m_t[:, None]
+
+        xs = xz_t if mask is None else (xz_t, mask_t)
+        h, out_t = lax.scan(step, carry, xs)
+        return jnp.swapaxes(out_t, 0, 1), state, h
+
+
+class Bidirectional(Layer):
+    """Wrapper running a recurrent layer forward + a clone backward over
+    time, merging with CONCAT/ADD/MUL/AVERAGE.
+    Ref: `nn/conf/layers/recurrent/Bidirectional.java` (Mode enum) /
+    `nn/layers/recurrent/BidirectionalLayer.java`."""
+
+    kind = "bidirectional"
+    is_rnn = True
+
+    MODES = ("concat", "add", "mul", "average")
+
+    def __init__(self, layer: BaseRecurrentLayer = None, mode: str = "concat",
+                 **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        if isinstance(layer, dict):
+            from . import from_json
+            layer = from_json(layer)
+        self.layer = layer
+        mode = mode.lower()
+        assert mode in self.MODES, mode
+        self.mode = mode
+        import copy
+        self.layer_bwd = copy.deepcopy(layer)
+
+    @property
+    def n_out(self):
+        return self.layer.n_out * (2 if self.mode == "concat" else 1)
+
+    def build(self, input_shape, defaults=None):
+        super().build(input_shape, defaults)
+        self.layer.build(input_shape, defaults)
+        self.layer_bwd.build(input_shape, defaults)
+
+    def param_shapes(self):
+        fwd = self.layer.param_shapes()
+        return {**{f"f_{k}": v for k, v in fwd.items()},
+                **{f"b_{k}": v for k, v in fwd.items()}}
+
+    def init_params(self, rng, dtype=jnp.float32):
+        kf, kb = jax.random.split(rng)
+        pf = self.layer.init_params(kf, dtype)
+        pb = self.layer_bwd.init_params(kb, dtype)
+        return {**{f"f_{k}": v for k, v in pf.items()},
+                **{f"b_{k}": v for k, v in pb.items()}}
+
+    def init_carry(self, batch, dtype=jnp.float32):
+        return (self.layer.init_carry(batch, dtype),
+                self.layer_bwd.init_carry(batch, dtype))
+
+    @staticmethod
+    def _reverse_seq(x, mask):
+        """Reverse along time, respecting per-sequence lengths when masked
+        (ref: ReverseTimeSeriesVertex semantics used by BidirectionalLayer)."""
+        if mask is None:
+            return jnp.flip(x, axis=1)
+        T = x.shape[1]
+        lengths = jnp.sum(mask > 0, axis=1).astype(jnp.int32)   # [B]
+        idx = jnp.arange(T)[None, :]                            # [1, T]
+        src = lengths[:, None] - 1 - idx                        # reversed pos
+        src = jnp.where(src >= 0, src, idx)                     # padding stays
+        if x.ndim == 3:
+            return jnp.take_along_axis(x, src[:, :, None], axis=1)
+        return jnp.take_along_axis(x, src, axis=1)
+
+    def apply_seq(self, params, x, state, train, rng, carry, mask):
+        pf = {k[2:]: v for k, v in params.items() if k.startswith("f_")}
+        pb = {k[2:]: v for k, v in params.items() if k.startswith("b_")}
+        cf, cb = carry
+        rf = rb = None
+        if rng is not None:
+            rf, rb = jax.random.split(rng)
+        out_f, st, cf2 = self.layer.apply_seq(pf, x, state, train, rf, cf, mask)
+        x_rev = self._reverse_seq(x, mask)
+        out_b, _, cb2 = self.layer_bwd.apply_seq(pb, x_rev, state, train, rb,
+                                                 cb, mask)
+        out_b = self._reverse_seq(out_b, mask)
+        if self.mode == "concat":
+            out = jnp.concatenate([out_f, out_b], axis=-1)
+        elif self.mode == "add":
+            out = out_f + out_b
+        elif self.mode == "mul":
+            out = out_f * out_b
+        else:
+            out = 0.5 * (out_f + out_b)
+        return out, st, (cf2, cb2)
+
+    def apply(self, params, x, state, train, rng):
+        out, st, _ = self.apply_seq(params, x, state, train, rng,
+                                    self.init_carry(x.shape[0], x.dtype), None)
+        return out, st
+
+    def output_shape(self, input_shape):
+        return (input_shape[0], self.n_out)
+
+    def _extra_json(self):
+        return {"layer": self.layer.to_json(), "mode": self.mode}
+
+
+class GravesBidirectionalLSTM(Bidirectional):
+    """Ref: `nn/conf/layers/GravesBidirectionalLSTM.java` — a bidirectional
+    Graves LSTM with ADD-style merge in the reference; kept as a concat by
+    default here with the reference's class name for API parity."""
+
+    kind = "gravesbidirectionallstm"
+
+    def __init__(self, n_out: int = None, mode: str = "add", layer=None, **kw):
+        if layer is not None:  # from_json path: full wrapped-layer dict
+            super().__init__(layer=layer, mode=mode, **kw)
+        else:
+            wrapped_kw = {k: kw.pop(k) for k in ("activation", "weight_init")
+                          if k in kw}
+            super().__init__(layer=GravesLSTM(n_out=n_out, **wrapped_kw),
+                             mode=mode, **kw)
+
+
+class LastTimeStep(Layer):
+    """Wraps an RNN layer, emits only the last (mask-aware) timestep:
+    [B, T, C] -> [B, C]. Ref: `nn/conf/layers/recurrent/LastTimeStep.java` /
+    `nn/layers/recurrent/LastTimeStepLayer.java`."""
+
+    kind = "lasttimestep"
+    is_rnn = True
+
+    def __init__(self, layer=None, **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        if isinstance(layer, dict):
+            from . import from_json
+            layer = from_json(layer)
+        self.layer = layer
+
+    def build(self, input_shape, defaults=None):
+        super().build(input_shape, defaults)
+        self.layer.build(input_shape, defaults)
+
+    def param_shapes(self):
+        return self.layer.param_shapes()
+
+    def init_params(self, rng, dtype=jnp.float32):
+        return self.layer.init_params(rng, dtype)
+
+    def init_carry(self, batch, dtype=jnp.float32):
+        return self.layer.init_carry(batch, dtype)
+
+    def apply_seq(self, params, x, state, train, rng, carry, mask):
+        out, st, c = self.layer.apply_seq(params, x, state, train, rng,
+                                          carry, mask)
+        if mask is None:
+            last = out[:, -1, :]
+        else:
+            lengths = jnp.sum(mask > 0, axis=1).astype(jnp.int32)
+            idx = jnp.maximum(lengths - 1, 0)
+            last = jnp.take_along_axis(out, idx[:, None, None].repeat(
+                out.shape[-1], -1), axis=1)[:, 0, :]
+        return last, st, c
+
+    def apply(self, params, x, state, train, rng):
+        out, st, _ = self.apply_seq(params, x, state, train, rng,
+                                    self.init_carry(x.shape[0], x.dtype), None)
+        return out, st
+
+    def output_shape(self, input_shape):
+        return (self.layer.output_shape(input_shape)[-1],)
+
+    def _extra_json(self):
+        return {"layer": self.layer.to_json()}
+
+
+class MaskZeroLayer(Layer):
+    """Wrapper deriving a mask from all-`mask_value` timesteps before
+    running the wrapped RNN. Ref: `nn/layers/recurrent/MaskZeroLayer.java`."""
+
+    kind = "maskzero"
+    is_rnn = True
+
+    def __init__(self, layer=None, mask_value: float = 0.0, **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        if isinstance(layer, dict):
+            from . import from_json
+            layer = from_json(layer)
+        self.layer = layer
+        self.mask_value = float(mask_value)
+
+    def build(self, input_shape, defaults=None):
+        super().build(input_shape, defaults)
+        self.layer.build(input_shape, defaults)
+
+    def param_shapes(self):
+        return self.layer.param_shapes()
+
+    def init_params(self, rng, dtype=jnp.float32):
+        return self.layer.init_params(rng, dtype)
+
+    def init_carry(self, batch, dtype=jnp.float32):
+        return self.layer.init_carry(batch, dtype)
+
+    def apply_seq(self, params, x, state, train, rng, carry, mask):
+        derived = jnp.any(x != self.mask_value, axis=-1).astype(x.dtype)
+        mask = derived if mask is None else mask * derived
+        return self.layer.apply_seq(params, x, state, train, rng, carry, mask)
+
+    def apply(self, params, x, state, train, rng):
+        out, st, _ = self.apply_seq(params, x, state, train, rng,
+                                    self.init_carry(x.shape[0], x.dtype), None)
+        return out, st
+
+    def output_shape(self, input_shape):
+        return self.layer.output_shape(input_shape)
+
+    def _extra_json(self):
+        return {"layer": self.layer.to_json(), "mask_value": self.mask_value}
+
+
+class EmbeddingSequenceLayer(Layer):
+    """[B, T] int indices -> [B, T, E].
+    Ref: `nn/conf/layers/EmbeddingSequenceLayer.java`."""
+
+    kind = "embeddingseq"
+
+    def __init__(self, n_in: int = None, n_out: int = None,
+                 has_bias: bool = False, **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        self.n_in = int(n_in)
+        self.n_out = int(n_out)
+        self.has_bias = bool(has_bias)
+
+    def param_shapes(self):
+        sh = {"W": (self.n_in, self.n_out)}
+        if self.has_bias:
+            sh["b"] = (self.n_out,)
+        return sh
+
+    def init_params(self, rng, dtype=jnp.float32):
+        p = {"W": init_weights(rng, (self.n_in, self.n_out), self.n_in,
+                               self.n_out, self.weight_init, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def apply(self, params, x, state, train, rng):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 3 and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+        z = params["W"][idx]
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation(z), state
+
+    def output_shape(self, input_shape):
+        t = input_shape[0] if input_shape else -1
+        return (t, self.n_out)
+
+    def _extra_json(self):
+        return {"n_in": self.n_in, "n_out": self.n_out,
+                "has_bias": self.has_bias}
+
+
+class RnnOutputLayer(DenseLayer):
+    """Per-timestep dense + loss over [B, T, O] with label mask [B, T].
+    Ref: `nn/conf/layers/RnnOutputLayer.java` /
+    `nn/layers/recurrent/RnnOutputLayer.java`."""
+
+    kind = "rnnoutput"
+
+    def __init__(self, n_out: int = None, loss="mcxent", **kw):
+        kw.setdefault("activation", "softmax")
+        super().__init__(n_out=n_out, **kw)
+        self.loss = L.get(loss)
+
+    def build(self, input_shape, defaults=None):
+        super().build(input_shape, defaults)
+        self._flatten_input = False  # [T, C] applies per timestep
+
+    def compute_loss(self, params, x, labels, mask=None, train: bool = False,
+                     rng=None):
+        z = self.pre_output(params, x, train, rng)      # [B, T, O]
+        B, T, O = z.shape
+        z2 = z.reshape(B * T, O)
+        y2 = labels.reshape(B * T, O)
+        m2 = None if mask is None else mask.reshape(B * T)
+        return self.loss.score(y2, z2, self.activation, m2)
+
+    def output_shape(self, input_shape):
+        return (input_shape[0], self.n_out)
+
+    def _extra_json(self):
+        d = super()._extra_json()
+        d["loss"] = self.loss.to_json()
+        return d
+
+
+class RnnLossLayer(LossLayer):
+    """Per-timestep loss on raw [B, T, O] input, no params.
+    Ref: `nn/conf/layers/RnnLossLayer.java`."""
+
+    kind = "rnnloss"
+
+    def compute_loss(self, params, x, labels, mask=None, train: bool = False,
+                     rng=None):
+        B, T, O = x.shape
+        m2 = None if mask is None else mask.reshape(B * T)
+        return self.loss.score(labels.reshape(B * T, O), x.reshape(B * T, O),
+                               self.activation, m2)
+
+
+class RepeatVector(Layer):
+    """[B, C] -> [B, n, C]. Ref: `nn/conf/layers/misc/RepeatVector.java`."""
+
+    kind = "repeatvector"
+
+    def __init__(self, n: int = 1, **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        self.n = int(n)
+
+    def apply(self, params, x, state, train, rng):
+        return jnp.repeat(x[:, None, :], self.n, axis=1), state
+
+    def output_shape(self, input_shape):
+        return (self.n, input_shape[-1])
+
+    def _extra_json(self):
+        return {"n": self.n}

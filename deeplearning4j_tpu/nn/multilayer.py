@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from .conf import MultiLayerConfiguration
 from .layers import Layer
@@ -58,6 +59,9 @@ class MultiLayerNetwork:
         self._last_loss = None
         self._rng = jax.random.PRNGKey(conf.seed)
         self._jit_step = None
+        self._tbptt_step = None
+        self._jit_rnn_step = None
+        self._stored_carries = None
         self._jit_forward = {}
         self._input_kind = conf.input_type.kind if conf.input_type else "ff"
         self._input_shape = conf.input_type.shape if conf.input_type else None
@@ -111,10 +115,23 @@ class MultiLayerNetwork:
             return x.reshape(x.shape[0], h, w, c)
         return x
 
-    def _forward(self, params, net_state, x, train: bool, rng, upto: Optional[int] = None):
-        """Run layers [0, upto). Returns (activation, new_state)."""
+    def _init_carries(self, batch: int, dtype=jnp.float32):
+        """Zero RNN carries, one slot per layer (None for stateless layers)."""
+        return [l.init_carry(batch, dtype) if getattr(l, "is_rnn", False) else None
+                for l in self.layers]
+
+    def _forward(self, params, net_state, x, train: bool, rng,
+                 upto: Optional[int] = None, carries=None, fmask=None):
+        """Run layers [0, upto). Returns (activation, new_state, new_carries).
+
+        `carries` holds per-layer RNN state (TBPTT / rnnTimeStep — ref:
+        MultiLayerNetwork.rnnActivateUsingStoredState); `fmask` is the
+        [B, T] feature mask applied while the activation is a sequence
+        (ref: setLayerMaskArrays)."""
         upto = len(self.layers) if upto is None else upto
         new_state = dict(net_state)
+        new_carries = list(carries) if carries is not None else \
+            self._init_carries(x.shape[0], x.dtype)
         act = x
         if rng is not None:
             layer_rngs = jax.random.split(rng, max(upto, 1))
@@ -124,22 +141,35 @@ class MultiLayerNetwork:
             p = params.get(key, {})
             s = net_state.get(key, {})
             r = layer_rngs[i] if rng is not None else None
-            act, s2 = layer.apply(p, act, s, train, r)
+            if getattr(layer, "is_rnn", False):
+                m = fmask if act.ndim == 3 else None
+                act, s2, c2 = layer.apply_seq(p, act, s, train, r,
+                                              new_carries[i], m)
+                new_carries[i] = c2
+            else:
+                act, s2 = layer.apply(p, act, s, train, r)
             if s:
                 new_state[key] = s2
-        return act, new_state
+        return act, new_state, new_carries
 
-    def _loss_fn(self, params, net_state, x, y, mask, train: bool, rng):
-        """Data loss + L1/L2 score terms (ref: BaseLayer.calcRegularizationScore)."""
+    def _loss_fn(self, params, net_state, x, y, mask, train: bool, rng,
+                 carries=None):
+        """Data loss + L1/L2 score terms (ref: BaseLayer.calcRegularizationScore).
+        `mask` doubles as the per-timestep feature+label mask for sequence
+        models (the common DL4J case where both coincide)."""
         r_fwd = r_out = None
         if rng is not None:
             r_fwd, r_out = jax.random.split(rng)
-        feats, new_state = self._forward(params, net_state, x, train, r_fwd,
-                                         upto=len(self.layers) - 1)
+        feats, new_state, new_carries = self._forward(
+            params, net_state, x, train, r_fwd,
+            upto=len(self.layers) - 1, carries=carries, fmask=mask)
         out_layer = self.layers[-1]
         out_key = self._layer_keys[-1]
-        data_loss = out_layer.compute_loss(params.get(out_key, {}), feats, y, mask,
-                                           train=train, rng=r_out)
+        lmask = mask
+        if mask is not None and feats.ndim == 2:
+            lmask = None  # sequence collapsed (e.g. LastTimeStep) — mask spent
+        data_loss = out_layer.compute_loss(params.get(out_key, {}), feats, y,
+                                           lmask, train=train, rng=r_out)
         reg = 0.0
         for key, meta in self._layers_meta.items():
             if key not in params:
@@ -152,7 +182,7 @@ class MultiLayerNetwork:
                     reg = reg + 0.5 * l2 * jnp.sum(jnp.square(w))
                 if l1:
                     reg = reg + l1 * jnp.sum(jnp.abs(w))
-        return data_loss + reg, new_state
+        return data_loss + reg, (new_state, new_carries)
 
     # -- the one true train step (jitted) ------------------------------
     def _make_step_fn(self):
@@ -168,7 +198,7 @@ class MultiLayerNetwork:
             # grads already carry l2*W + l1*sign(W) (ref semantics:
             # BaseMultiLayerUpdater.preApply adds them to the gradient,
             # and the score includes calcRegularizationScore).
-            (loss, new_net_state), grads = jax.value_and_grad(
+            (loss, (new_net_state, _)), grads = jax.value_and_grad(
                 lambda p: self._loss_fn(p, net_state, x, y, mask, True, rng),
                 has_aux=True)(params)
             grads = _clip_grads(grads, max_norm, clip_value)
@@ -188,6 +218,35 @@ class MultiLayerNetwork:
     def _make_step(self):
         return jax.jit(self._make_step_fn(), donate_argnums=(0, 1, 2))
 
+    def _make_tbptt_step(self):
+        """Truncated-BPTT chunk step: like the regular step but threads RNN
+        carries across chunks, gradient-stopped at the boundary (ref:
+        MultiLayerNetwork.doTruncatedBPTT :1637 + rnnActivateUsingStoredState)."""
+        updaters = self._updaters
+        layer_keys = self._layer_keys
+        max_norm = self.conf.max_grad_norm
+        clip_value = self.conf.grad_clip_value
+
+        def step_fn(params, opt_state, net_state, step, x, y, mask, rng, carries):
+            carries = jax.tree_util.tree_map(lax.stop_gradient, carries)
+            (loss, (new_net_state, new_carries)), grads = jax.value_and_grad(
+                lambda p: self._loss_fn(p, net_state, x, y, mask, True, rng,
+                                        carries=carries),
+                has_aux=True)(params)
+            grads = _clip_grads(grads, max_norm, clip_value)
+            new_opt = {}
+            new_params = {}
+            for i, key in enumerate(layer_keys):
+                if key not in params:
+                    continue
+                st, upd = updaters[i].apply(opt_state[key], grads[key], step)
+                new_opt[key] = st
+                new_params[key] = jax.tree_util.tree_map(
+                    lambda p, u: p - u, params[key], upd)
+            return new_params, new_opt, new_net_state, loss, new_carries
+
+        return jax.jit(step_fn, donate_argnums=(0, 1, 2))
+
     # -- public API ----------------------------------------------------
     def fit(self, data, labels=None, epochs: int = 1, mask=None):
         """Train. `data` is a DataSetIterator-like (yields (x, y) or DataSet)
@@ -205,6 +264,7 @@ class MultiLayerNetwork:
                 # a plain generator exhausts after one epoch and would
                 # silently yield nothing on later epochs — materialize it
                 iterator = list(iterator)
+        tbptt = self.conf.tbptt_fwd_length
         for _ in range(epochs):
             if iterator is not None:
                 batches = ((b[0], b[1], b[2] if len(b) > 2 else None)
@@ -214,10 +274,13 @@ class MultiLayerNetwork:
                 y = jnp.asarray(y)
                 t0 = time.perf_counter()
                 self._rng, sub = jax.random.split(self._rng)
-                self._params, self._opt_state, self._net_state, loss = self._jit_step(
-                    self._params, self._opt_state, self._net_state,
-                    jnp.asarray(self._step), x, y,
-                    None if m is None else jnp.asarray(m), sub)
+                if tbptt and x.ndim == 3 and x.shape[1] > tbptt:
+                    loss = self._fit_tbptt(x, y, m, tbptt)
+                else:
+                    self._params, self._opt_state, self._net_state, loss = self._jit_step(
+                        self._params, self._opt_state, self._net_state,
+                        jnp.asarray(self._step), x, y,
+                        None if m is None else jnp.asarray(m), sub)
                 self._step += 1
                 # keep the loss on device: converting forces a host sync and
                 # defeats async dispatch; listeners that read .score_ pay the
@@ -233,6 +296,61 @@ class MultiLayerNetwork:
                 if hasattr(lst, "on_epoch_end"):
                     lst.on_epoch_end(self)
         return self
+
+    def _fit_tbptt(self, x, y, m, tbptt: int):
+        """Chunked fwd/bwd over time with carried (gradient-stopped) RNN
+        state — ref: MultiLayerNetwork.doTruncatedBPTT (:1637): equal
+        fwd/bwd truncation lengths, state carried via stored-state activate.
+        Ragged tails are padded to the chunk length with mask=0 so every
+        chunk hits the same compiled program (XLA: one shape signature)."""
+        if self._tbptt_step is None:
+            self._tbptt_step = self._make_tbptt_step()
+        T = x.shape[1]
+        if m is None:
+            m = jnp.ones(x.shape[:2], x.dtype)
+        else:
+            m = jnp.asarray(m)
+        carries = self._init_carries(x.shape[0], x.dtype)
+        loss = None
+        for t0 in range(0, T, tbptt):
+            xc = x[:, t0:t0 + tbptt]
+            yc = y[:, t0:t0 + tbptt] if y.ndim == 3 else y
+            mc = m[:, t0:t0 + tbptt]
+            pad = tbptt - xc.shape[1]
+            if pad:
+                xc = jnp.pad(xc, ((0, 0), (0, pad), (0, 0)))
+                if yc.ndim == 3:
+                    yc = jnp.pad(yc, ((0, 0), (0, pad), (0, 0)))
+                mc = jnp.pad(mc, ((0, 0), (0, pad)))
+            self._rng, sub = jax.random.split(self._rng)
+            (self._params, self._opt_state, self._net_state, loss,
+             carries) = self._tbptt_step(
+                self._params, self._opt_state, self._net_state,
+                jnp.asarray(self._step), xc, yc, mc, sub, carries)
+        return loss
+
+    # -- stateful RNN inference (ref: rnnTimeStep / rnnClearPreviousState)
+    def rnn_time_step(self, x):
+        """Run a [B, T, C] (or [B, C] single-step) segment, carrying hidden
+        state across calls (ref: MultiLayerNetwork.rnnTimeStep)."""
+        x = jnp.asarray(x)
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[:, None, :]
+        if self._stored_carries is None:
+            self._stored_carries = self._init_carries(x.shape[0], x.dtype)
+        if self._jit_rnn_step is None:
+            def fwd(params, net_state, x, carries):
+                act, _, c2 = self._forward(params, net_state, x, False, None,
+                                           carries=carries)
+                return act, c2
+            self._jit_rnn_step = jax.jit(fwd)
+        out, self._stored_carries = self._jit_rnn_step(
+            self._params, self._net_state, x, self._stored_carries)
+        return out[:, 0] if squeeze and out.ndim == 3 else out
+
+    def rnn_clear_previous_state(self):
+        self._stored_carries = None
 
     @staticmethod
     def _unpack(item):
@@ -250,7 +368,7 @@ class MultiLayerNetwork:
         key = ("out", train)
         if key not in self._jit_forward:
             def fwd(params, net_state, x):
-                act, _ = self._forward(params, net_state, x, train, None)
+                act, _, _ = self._forward(params, net_state, x, train, None)
                 return act
             self._jit_forward[key] = jax.jit(fwd)
         return self._jit_forward[key](self._params, self._net_state, x)
@@ -260,10 +378,16 @@ class MultiLayerNetwork:
         x = self._reshape_input(jnp.asarray(x))
         acts = [x]
         act = x
+        carries = self._init_carries(x.shape[0], x.dtype)
         for i in range(len(self.layers)):
-            act, _ = self.layers[i].apply(
-                self._params.get(self._layer_keys[i], {}), act,
-                self._net_state.get(self._layer_keys[i], {}), train, None)
+            layer = self.layers[i]
+            p = self._params.get(self._layer_keys[i], {})
+            s = self._net_state.get(self._layer_keys[i], {})
+            if getattr(layer, "is_rnn", False):
+                act, _, _ = layer.apply_seq(p, act, s, train, None,
+                                            carries[i], None)
+            else:
+                act, _ = layer.apply(p, act, s, train, None)
             acts.append(act)
         return acts
 
@@ -278,7 +402,8 @@ class MultiLayerNetwork:
             return self.score_
         x = self._reshape_input(jnp.asarray(x))
         loss, _ = self._loss_fn(self._params, self._net_state, x, jnp.asarray(y),
-                                mask, False, None)
+                                None if mask is None else jnp.asarray(mask),
+                                False, None)
         return float(loss)
 
     def evaluate(self, iterator):

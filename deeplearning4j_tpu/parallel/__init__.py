@@ -138,7 +138,7 @@ class ParallelInference:
             data = batch_sharded(self.mesh)
 
             def fwd(params, net_state, x):
-                act, _ = m._forward(params, net_state, x, False, None)
+                act, _, _ = m._forward(params, net_state, x, False, None)
                 return act
 
             self._jit_out = jax.jit(fwd, in_shardings=(repl, repl, data),

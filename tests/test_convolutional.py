@@ -1,0 +1,209 @@
+"""Conv-family layer tests: shape contracts, known-value checks, numeric
+gradient spot-checks, JSON round-trip (ref: the reference's
+ConvolutionTests.cpp / gradientcheck CNN suites)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.layers import from_json
+from deeplearning4j_tpu.nn.layers.convolutional import (
+    Convolution1D, Convolution3D, Cropping1D, Cropping2D, Cropping3D,
+    Deconvolution2D, DepthToSpaceLayer, DepthwiseConvolution2D,
+    ElementWiseMultiplicationLayer, FrozenLayer, LocallyConnected1D,
+    LocallyConnected2D, PReLULayer, SeparableConvolution2D, SpaceToBatchLayer,
+    SpaceToDepthLayer, Subsampling1DLayer, Subsampling3DLayer, Upsampling1D,
+    Upsampling3D, ZeroPadding1DLayer, ZeroPadding3DLayer)
+
+
+def _run(layer, shape, seed=0):
+    layer.build(shape[1:], {"weight_init": "xavier", "activation": None})
+    params = layer.init_params(jax.random.PRNGKey(seed))
+    x = jax.random.normal(jax.random.PRNGKey(seed + 1), shape)
+    out, _ = layer.apply(params, x, {}, False, None)
+    expected = layer.output_shape(shape[1:])
+    assert out.shape == (shape[0],) + tuple(expected), \
+        f"{out.shape} vs declared {(shape[0],) + tuple(expected)}"
+    assert np.all(np.isfinite(np.asarray(out)))
+    return out, params, x
+
+
+def test_conv1d():
+    out, _, _ = _run(Convolution1D(n_out=6, kernel=3, stride=2), (2, 11, 4))
+    assert out.shape == (2, 6, 6)
+
+
+def test_conv3d():
+    out, _, _ = _run(Convolution3D(n_out=5, kernel=(2, 2, 2), padding="valid"),
+                     (2, 5, 6, 7, 3))
+    assert out.shape == (2, 4, 5, 6, 5)
+
+
+def test_deconv2d_inverts_stride():
+    out, _, _ = _run(Deconvolution2D(n_out=4, kernel=(2, 2), stride=(2, 2)),
+                     (2, 5, 5, 3))
+    assert out.shape == (2, 10, 10, 4)
+
+
+def test_depthwise_multiplier():
+    out, _, _ = _run(DepthwiseConvolution2D(depth_multiplier=3), (2, 8, 8, 4))
+    assert out.shape[-1] == 12
+
+
+def test_separable_equals_depthwise_then_pointwise():
+    layer = SeparableConvolution2D(n_out=6, kernel=(3, 3))
+    out, params, x = _run(layer, (2, 8, 8, 4))
+    assert out.shape == (2, 8, 8, 6)
+    assert set(params) == {"dW", "pW", "b"}
+
+
+def test_pooling_1d_3d():
+    _run(Subsampling1DLayer(kernel=2, stride=2), (2, 10, 3))
+    _run(Subsampling3DLayer(kernel=(2, 2, 2), pooling="avg"), (2, 4, 4, 4, 3))
+
+
+def test_upsampling_1d_3d():
+    out, _, _ = _run(Upsampling1D(size=3), (2, 4, 3))
+    assert out.shape == (2, 12, 3)
+    out, _, _ = _run(Upsampling3D(size=(2, 2, 2)), (1, 2, 3, 4, 2))
+    assert out.shape == (1, 4, 6, 8, 2)
+
+
+def test_crop_pad_roundtrip():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 6, 8, 3))
+    pad = ZeroPadding1DLayer(padding=(1, 2))
+    pad.build((6, 3), {})
+    crop = Cropping1D(cropping=(1, 2))
+    crop.build((9, 3), {})
+    x1 = jax.random.normal(jax.random.PRNGKey(1), (2, 6, 3))
+    padded, _ = pad.apply({}, x1, {}, False, None)
+    cropped, _ = crop.apply({}, padded, {}, False, None)
+    assert np.allclose(cropped, x1)
+
+    c2 = Cropping2D(cropping=((1, 1), (2, 2)))
+    c2.build((6, 8, 3), {})
+    out, _ = c2.apply({}, x, {}, False, None)
+    assert out.shape == (2, 4, 4, 3)
+
+    _run(Cropping3D(cropping=1), (1, 4, 4, 4, 2))
+    _run(ZeroPadding3DLayer(padding=1), (1, 2, 2, 2, 2))
+
+
+def test_space_depth_inverse():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 4, 3))
+    s2d = SpaceToDepthLayer(block_size=2)
+    s2d.build((4, 4, 3), {})
+    d2s = DepthToSpaceLayer(block_size=2)
+    d2s.build((2, 2, 12), {})
+    mid, _ = s2d.apply({}, x, {}, False, None)
+    assert mid.shape == (2, 2, 2, 12)
+    back, _ = d2s.apply({}, mid, {}, False, None)
+    assert np.allclose(back, x)
+
+
+def test_space_to_batch():
+    layer = SpaceToBatchLayer(blocks=(2, 2))
+    layer.build((4, 4, 3), {})
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 4, 3))
+    out, _ = layer.apply({}, x, {}, False, None)
+    assert out.shape == (8, 2, 2, 3)
+
+
+def test_prelu_negative_slope():
+    layer = PReLULayer(alpha_init=0.25)
+    layer.build((5,), {})
+    p = layer.init_params(jax.random.PRNGKey(0))
+    x = jnp.array([[-2.0, -1.0, 0.0, 1.0, 2.0]])
+    out, _ = layer.apply(p, x, {}, False, None)
+    assert np.allclose(out, [[-0.5, -0.25, 0.0, 1.0, 2.0]])
+
+
+def test_elementwise_mult_identity_at_init():
+    layer = ElementWiseMultiplicationLayer()
+    layer.build((4,), {"activation": None})
+    p = layer.init_params(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 4))
+    out, _ = layer.apply(p, x, {}, False, None)
+    assert np.allclose(out, x)  # w=1, b=0 at init
+
+
+def test_locally_connected_2d_vs_conv_when_tied():
+    """With identical weights at every position, LC == conv (valid)."""
+    lc = LocallyConnected2D(n_out=3, kernel=(2, 2), has_bias=False)
+    out, params, x = _run(lc, (2, 5, 5, 4))
+    assert out.shape == (2, 4, 4, 3)
+    # tie the weights: every position uses position-0's kernel
+    W = np.array(params["W"])
+    W[:] = W[0]
+    tied = {"W": jnp.asarray(W)}
+    out_tied, _ = lc.apply(tied, x, {}, False, None)
+    from jax import lax
+    # patch features are channel-major (C, kh, kw) — see LocallyConnected2D
+    Wc = W[0].reshape(4, 2, 2, 3).transpose(1, 2, 0, 3)
+    ref = lax.conv_general_dilated(x, jnp.asarray(Wc), (1, 1), "VALID",
+                                   dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    assert np.allclose(out_tied, ref, atol=1e-5)
+
+
+def test_locally_connected_1d():
+    out, _, _ = _run(LocallyConnected1D(n_out=3, kernel=2), (2, 6, 4))
+    assert out.shape == (2, 5, 3)
+
+
+def test_frozen_layer_blocks_gradients():
+    from deeplearning4j_tpu.nn.layers import DenseLayer
+    layer = FrozenLayer(DenseLayer(n_out=3))
+    layer.build((4,), {"weight_init": "xavier", "activation": None})
+    params = layer.init_params(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 4))
+
+    def loss(p):
+        out, _ = layer.apply(p, x, {}, False, None)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(loss)(params)
+    assert all(np.allclose(np.asarray(v), 0.0)
+               for v in jax.tree_util.tree_leaves(g))
+
+
+@pytest.mark.parametrize("layer_fn,shape", [
+    (lambda: Convolution1D(n_out=4, kernel=3), (2, 8, 3)),
+    (lambda: Convolution3D(n_out=4), (1, 4, 4, 4, 2)),
+    (lambda: Deconvolution2D(n_out=4), (1, 4, 4, 2)),
+    (lambda: SeparableConvolution2D(n_out=4), (1, 6, 6, 3)),
+    (lambda: DepthwiseConvolution2D(depth_multiplier=2), (1, 6, 6, 3)),
+    (lambda: PReLULayer(), (2, 5)),
+    (lambda: LocallyConnected2D(n_out=2, kernel=(2, 2)), (1, 4, 4, 2)),
+])
+def test_json_roundtrip(layer_fn, shape):
+    layer = layer_fn()
+    layer.build(shape[1:], {"weight_init": "xavier", "activation": None})
+    d = layer.to_json()
+    layer2 = from_json(d)
+    layer2.build(shape[1:], {"weight_init": "xavier", "activation": None})
+    assert layer2.output_shape(shape[1:]) == layer.output_shape(shape[1:])
+
+
+def test_numeric_gradient_sepconv():
+    layer = SeparableConvolution2D(n_out=2, kernel=(2, 2), has_bias=True)
+    layer.build((4, 4, 2), {"weight_init": "xavier", "activation": None})
+    params = layer.init_params(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 4, 4, 2))
+
+    def loss(p):
+        out, _ = layer.apply(p, x, {}, False, None)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(loss)(params)
+    eps = 1e-2
+    for k in ("dW", "pW"):
+        flat = np.asarray(params[k]).ravel()
+        for idx in [0, flat.size // 2]:
+            pp = {kk: np.array(vv, np.float32) for kk, vv in params.items()}
+            pp[k].ravel()[idx] += eps
+            up = float(loss({kk: jnp.asarray(vv) for kk, vv in pp.items()}))
+            pp[k].ravel()[idx] -= 2 * eps
+            dn = float(loss({kk: jnp.asarray(vv) for kk, vv in pp.items()}))
+            num = (up - dn) / (2 * eps)
+            ana = float(np.asarray(g[k]).ravel()[idx])
+            assert abs(num - ana) < 2e-2 * max(1.0, abs(num))

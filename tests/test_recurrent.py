@@ -1,0 +1,206 @@
+"""Recurrent layer tests — forward shapes, masking semantics, numeric
+gradient checks (the reference's workhorse correctness net:
+`gradientcheck/GradientCheckUtil.java:129` central differences), TBPTT,
+and stateful rnnTimeStep parity."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.learning import Adam, Sgd
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers import (LSTM, Bidirectional, DenseLayer,
+                                          EmbeddingSequenceLayer,
+                                          GravesBidirectionalLSTM, GravesLSTM,
+                                          LastTimeStep, MaskZeroLayer,
+                                          OutputLayer, RepeatVector,
+                                          RnnLossLayer, RnnOutputLayer,
+                                          SimpleRnn)
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(0)
+
+
+def _build(layer, n_in=4, t=7, rng_seed=0):
+    layer.build((t, n_in), {"weight_init": "xavier", "activation": None})
+    params = layer.init_params(jax.random.PRNGKey(rng_seed))
+    return layer, params
+
+
+@pytest.mark.parametrize("cls", [LSTM, GravesLSTM, SimpleRnn])
+def test_rnn_forward_shape(cls, rng):
+    layer, params = _build(cls(n_out=5))
+    x = jax.random.normal(rng, (3, 7, 4))
+    out, _, carry = layer.apply_seq(params, x, {}, False, None,
+                                    layer.init_carry(3), None)
+    assert out.shape == (3, 7, 5)
+
+
+@pytest.mark.parametrize("mode,ch", [("concat", 10), ("add", 5),
+                                     ("mul", 5), ("average", 5)])
+def test_bidirectional_modes(mode, ch, rng):
+    layer, params = _build(Bidirectional(LSTM(n_out=5), mode=mode))
+    x = jax.random.normal(rng, (3, 7, 4))
+    out, _, _ = layer.apply_seq(params, x, {}, False, None,
+                                layer.init_carry(3), None)
+    assert out.shape == (3, 7, ch)
+
+
+def test_mask_holds_state_and_zeroes_output(rng):
+    """Masked steps emit zeros and hold the carry (reference semantics)."""
+    layer, params = _build(LSTM(n_out=5))
+    x = jax.random.normal(rng, (2, 7, 4))
+    mask = jnp.ones((2, 7)).at[0, 4:].set(0.0)
+    out, _, (h, c) = layer.apply_seq(params, x, {}, False, None,
+                                     layer.init_carry(2), mask)
+    assert np.allclose(out[0, 4:], 0.0)
+    # carry for seq 0 equals the state after its last REAL step
+    out4, _, (h4, c4) = layer.apply_seq(params, x[:, :4], {}, False, None,
+                                        layer.init_carry(2), mask[:, :4])
+    assert np.allclose(h[0], h4[0], atol=1e-6)
+    assert np.allclose(c[0], c4[0], atol=1e-6)
+
+
+def test_masked_equals_truncated(rng):
+    """A mask-padded sequence must produce the same head outputs as the
+    truncated sequence run alone."""
+    layer, params = _build(GravesLSTM(n_out=5))
+    x = jax.random.normal(rng, (1, 7, 4))
+    mask = jnp.ones((1, 7)).at[0, 5:].set(0.0)
+    out_m, _, _ = layer.apply_seq(params, x, {}, False, None,
+                                  layer.init_carry(1), mask)
+    out_t, _, _ = layer.apply_seq(params, x[:, :5], {}, False, None,
+                                  layer.init_carry(1), None)
+    assert np.allclose(out_m[0, :5], out_t[0], atol=1e-5)
+
+
+def test_bidirectional_mask_aware_reverse(rng):
+    """Backward pass must start from each sequence's true end, not padding."""
+    layer, params = _build(Bidirectional(SimpleRnn(n_out=3), mode="concat"))
+    x = jax.random.normal(rng, (1, 6, 4))
+    mask = jnp.ones((1, 6)).at[0, 4:].set(0.0)
+    out_m, _, _ = layer.apply_seq(params, x, {}, False, None,
+                                  layer.init_carry(1), mask)
+    out_t, _, _ = layer.apply_seq(params, x[:, :4], {}, False, None,
+                                  layer.init_carry(1), None)
+    assert np.allclose(out_m[0, :4], out_t[0], atol=1e-5)
+
+
+@pytest.mark.parametrize("cls", [LSTM, GravesLSTM, SimpleRnn])
+def test_numeric_gradients(cls, rng):
+    """Central-difference check of d(loss)/d(params) through the scan."""
+    layer, params = _build(cls(n_out=3), n_in=2, t=5)
+    x = jax.random.normal(rng, (2, 5, 2))
+
+    def loss(p):
+        out, _, _ = layer.apply_seq(p, x, {}, False, None,
+                                    layer.init_carry(2, x.dtype), None)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(loss)(params)
+    eps = 1e-2  # float32: larger eps balances roundoff vs truncation error
+    for k in params:
+        flat = np.asarray(params[k]).ravel()
+        for idx in np.random.default_rng(0).choice(
+                flat.size, size=min(5, flat.size), replace=False):
+            pp = {kk: np.array(vv, np.float32) for kk, vv in params.items()}
+            pp[k].ravel()[idx] += eps
+            up = float(loss({kk: jnp.asarray(vv) for kk, vv in pp.items()}))
+            pp[k].ravel()[idx] -= 2 * eps
+            dn = float(loss({kk: jnp.asarray(vv) for kk, vv in pp.items()}))
+            num = (up - dn) / (2 * eps)
+            ana = float(np.asarray(g[k]).ravel()[idx])
+            assert abs(num - ana) < 2e-2 * max(1.0, abs(num)), \
+                f"{k}[{idx}]: numeric {num} vs autodiff {ana}"
+
+
+def test_lstm_lasttimestep_training_learns():
+    """Tiny sequence classification: last-step class = sign of mean input."""
+    rs = np.random.default_rng(42)
+    x = rs.normal(size=(64, 6, 3)).astype(np.float32)
+    labels = (x.mean(axis=(1, 2)) > 0).astype(int)
+    y = np.eye(2, dtype=np.float32)[labels]
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(5e-2))
+            .list()
+            .layer(LastTimeStep(LSTM(n_out=8)))
+            .layer(OutputLayer(n_out=2))
+            .input_type_recurrent(3, 6).build())
+    m = MultiLayerNetwork(conf).init()
+    for _ in range(60):
+        m.fit(x, y)
+    pred = np.asarray(m.output(x)).argmax(1)
+    assert (pred == labels).mean() > 0.9
+
+
+def test_tbptt_matches_full_bptt_loss_direction():
+    """TBPTT training decreases loss on a seq-to-seq task."""
+    rs = np.random.default_rng(3)
+    x = rs.normal(size=(8, 12, 2)).astype(np.float32)
+    y = np.zeros((8, 12, 2), np.float32)
+    y[..., 0] = (x.sum(-1) > 0)
+    y[..., 1] = 1 - y[..., 0]
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+            .list()
+            .layer(SimpleRnn(n_out=6))
+            .layer(RnnOutputLayer(n_out=2))
+            .input_type_recurrent(2, 12).tbptt(4).build())
+    m = MultiLayerNetwork(conf).init()
+    m.fit(x, y)
+    first = m.score(x, y)
+    for _ in range(30):
+        m.fit(x, y)
+    assert m.score(x, y) < first
+
+
+def test_rnn_time_step_stateful_matches_full():
+    conf = (NeuralNetConfiguration.builder().seed(5).updater(Sgd(0.1))
+            .list()
+            .layer(LSTM(n_out=4))
+            .layer(RnnOutputLayer(n_out=2))
+            .input_type_recurrent(3, 8).build())
+    m = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(0).normal(size=(2, 8, 3)).astype(np.float32)
+    m.rnn_clear_previous_state()
+    a = m.rnn_time_step(x[:, :5])
+    b = m.rnn_time_step(x[:, 5:])
+    full = m.output(x)
+    assert np.allclose(np.asarray(b), np.asarray(full)[:, 5:], atol=1e-5)
+
+
+def test_maskzero_derives_mask(rng):
+    layer, params = _build(MaskZeroLayer(SimpleRnn(n_out=3)))
+    x = jax.random.normal(rng, (1, 6, 4))
+    x = x.at[0, 4:].set(0.0)  # padding rows
+    out_w, _, _ = layer.apply_seq(params, x, {}, False, None,
+                                  layer.init_carry(1), None)
+    assert np.allclose(out_w[0, 4:], 0.0)
+
+
+def test_embedding_sequence_and_repeat(rng):
+    emb = EmbeddingSequenceLayer(n_in=10, n_out=4)
+    emb.build((5,), {"weight_init": "xavier"})
+    p = emb.init_params(rng)
+    idx = jnp.array([[1, 2, 3, 4, 5]])
+    out, _ = emb.apply(p, idx, {}, False, None)
+    assert out.shape == (1, 5, 4)
+
+    rv = RepeatVector(n=3)
+    rv.build((4,), {})
+    out2, _ = rv.apply({}, jnp.ones((2, 4)), {}, False, None)
+    assert out2.shape == (2, 3, 4)
+
+
+def test_json_roundtrip_recurrent():
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-3))
+            .list()
+            .layer(Bidirectional(LSTM(n_out=8), mode="add"))
+            .layer(GravesBidirectionalLSTM(n_out=4))
+            .layer(RnnOutputLayer(n_out=3))
+            .input_type_recurrent(4, 10).tbptt(5).build())
+    c2 = MultiLayerConfiguration.from_json(conf.to_json())
+    m = MultiLayerNetwork(c2).init()
+    out = m.output(np.zeros((2, 10, 4), np.float32))
+    assert out.shape == (2, 10, 3)
