@@ -7,3 +7,5 @@
 """
 from .conf import NeuralNetConfiguration, MultiLayerConfiguration  # noqa: F401
 from .multilayer import MultiLayerNetwork  # noqa: F401
+from .graph import (ComputationGraph,  # noqa: F401
+                    ComputationGraphConfiguration, GraphBuilder)

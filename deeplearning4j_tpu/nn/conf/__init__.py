@@ -252,3 +252,8 @@ class NeuralNetConfiguration:
 
     def list(self) -> ListBuilder:
         return ListBuilder(self)
+
+    def graph_builder(self):
+        """Ref: NeuralNetConfiguration.Builder.graphBuilder()."""
+        from ..graph import GraphBuilder
+        return GraphBuilder(self)

@@ -173,10 +173,12 @@ class Convolution3D(Layer):
         return self.activation(z), state
 
     def output_shape(self, input_shape):
-        dims = input_shape[:3]
+        dims = list(input_shape[:3])
+        if not isinstance(self.padding, str):
+            dims = [d + sum(p) for d, p in zip(dims, self.padding)]
+        pad = self.padding if isinstance(self.padding, str) else "valid"
         out = tuple(_conv_out(dims[i], self.kernel[i], self.stride[i],
-                              self.dilation[i],
-                              self.padding if isinstance(self.padding, str) else "valid")
+                              self.dilation[i], pad)
                     for i in range(3))
         return out + (self.n_out,)
 
@@ -314,6 +316,9 @@ class DepthwiseConvolution2D(Layer):
 
     def output_shape(self, input_shape):
         h, w, _ = input_shape
+        if not isinstance(self.padding, str):
+            h += sum(self.padding[0])
+            w += sum(self.padding[1])
         pad = self.padding if isinstance(self.padding, str) else "valid"
         return (_conv_out(h, self.kernel[0], self.stride[0], self.dilation[0], pad),
                 _conv_out(w, self.kernel[1], self.stride[1], self.dilation[1], pad),
@@ -391,6 +396,9 @@ class SeparableConvolution2D(Layer):
 
     def output_shape(self, input_shape):
         h, w, _ = input_shape
+        if not isinstance(self.padding, str):
+            h += sum(self.padding[0])
+            w += sum(self.padding[1])
         pad = self.padding if isinstance(self.padding, str) else "valid"
         return (_conv_out(h, self.kernel[0], self.stride[0], self.dilation[0], pad),
                 _conv_out(w, self.kernel[1], self.stride[1], self.dilation[1], pad),
@@ -962,6 +970,10 @@ class FrozenLayer(Layer):
     def build(self, input_shape, defaults=None):
         super().build(input_shape, defaults)
         self.layer.build(input_shape, defaults)
+        # frozen params must not receive weight decay either — zero out the
+        # regularization meta the network's loss fn reads (otherwise l2*W
+        # gradients leak past the stop_gradient and the weights drift)
+        self.l1 = self.l2 = self.l1_bias = self.l2_bias = 0.0
 
     def param_shapes(self):
         return self.layer.param_shapes()

@@ -1,0 +1,131 @@
+"""Object-detection output layer (YOLOv2 loss).
+
+Ref: deeplearning4j-nn `nn/conf/layers/objdetect/Yolo2OutputLayer.java` /
+runtime `nn/layers/objdetect/Yolo2OutputLayer.java` (computeLoss: squared-
+error position/size + confidence + class terms with lambda weights, per
+Redmon et al. 2016) and `nn/layers/objdetect/YoloUtils.java` (activation:
+sigmoid xy/conf, exp wh scaled by anchors, softmax classes).
+
+Layout here is NHWC: predictions [B, H, W, A*(5+C)] over an HxW grid with
+A anchors; labels [B, H, W, A*(5+C)] in the same layout with confidence
+used as the object-presence indicator (1 in the responsible anchor cell).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import Layer, register
+
+
+@register
+class Yolo2OutputLayer(Layer):
+    """YOLOv2 detection head: no params — applies the YOLO activation and
+    loss to the incoming feature map."""
+
+    kind = "yolo2output"
+
+    def __init__(self, anchors: Sequence[Sequence[float]] = ((1.0, 1.0),),
+                 lambda_coord: float = 5.0, lambda_noobj: float = 0.5, **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        self.anchors = tuple(tuple(float(v) for v in a) for a in anchors)
+        self.lambda_coord = float(lambda_coord)
+        self.lambda_noobj = float(lambda_noobj)
+
+    @property
+    def n_anchors(self):
+        return len(self.anchors)
+
+    def _split(self, x):
+        """[B,H,W,A*(5+C)] -> xy [B,H,W,A,2], wh [...,2], conf [...,1],
+        cls [...,C]."""
+        B, H, W, F = x.shape
+        A = self.n_anchors
+        z = x.reshape(B, H, W, A, F // A)
+        return z[..., 0:2], z[..., 2:4], z[..., 4:5], z[..., 5:]
+
+    def activate_detection(self, x):
+        """YOLO activation (ref: YoloUtils.activate): sigmoid on xy+conf,
+        exp(wh)*anchor, softmax classes."""
+        xy, wh, conf, cls = self._split(x)
+        anchors = jnp.asarray(self.anchors, x.dtype)  # [A, 2]
+        out_xy = jax.nn.sigmoid(xy)
+        out_wh = jnp.exp(wh) * anchors
+        out_conf = jax.nn.sigmoid(conf)
+        out_cls = jax.nn.softmax(cls, axis=-1)
+        return jnp.concatenate([out_xy, out_wh, out_conf, out_cls], axis=-1)
+
+    def apply(self, params, x, state, train, rng):
+        B, H, W, F = x.shape
+        return self.activate_detection(x).reshape(B, H, W, F), state
+
+    def compute_loss(self, params, x, labels, mask=None, train: bool = False,
+                     rng=None):
+        pred_xy, pred_wh, pred_conf, pred_cls = self._split(x)
+        lab_xy, lab_wh, lab_conf, lab_cls = self._split(labels)
+        anchors = jnp.asarray(self.anchors, x.dtype)
+
+        p_xy = jax.nn.sigmoid(pred_xy)
+        p_wh = jnp.exp(pred_wh) * anchors
+        p_conf = jax.nn.sigmoid(pred_conf)
+        p_cls = jax.nn.softmax(pred_cls, axis=-1)
+
+        obj = lab_conf  # [B,H,W,A,1] 1 where an object is assigned
+        noobj = 1.0 - obj
+
+        # sqrt on wh (YOLO paper: small boxes matter more)
+        loss_xy = jnp.sum(obj * jnp.square(p_xy - lab_xy))
+        loss_wh = jnp.sum(obj * jnp.square(
+            jnp.sqrt(jnp.maximum(p_wh, 1e-8)) -
+            jnp.sqrt(jnp.maximum(lab_wh, 1e-8))))
+        loss_obj = jnp.sum(obj * jnp.square(p_conf - 1.0))
+        loss_noobj = jnp.sum(noobj * jnp.square(p_conf))
+        loss_cls = jnp.sum(obj * jnp.square(p_cls - lab_cls))
+
+        n = x.shape[0]
+        total = (self.lambda_coord * (loss_xy + loss_wh) + loss_obj +
+                 self.lambda_noobj * loss_noobj + loss_cls) / n
+        return total
+
+    def _extra_json(self):
+        return {"anchors": [list(a) for a in self.anchors],
+                "lambda_coord": self.lambda_coord,
+                "lambda_noobj": self.lambda_noobj}
+
+
+def non_max_suppression(boxes: np.ndarray, scores: np.ndarray,
+                        iou_threshold: float = 0.45,
+                        score_threshold: float = 0.5):
+    """Host-side NMS over [N,4] xywh boxes (ref: YoloUtils.getPredictedObjects
+    + DetectedObject NMS in the reference's objdetect package)."""
+    keep_mask = scores >= score_threshold
+    boxes, scores = boxes[keep_mask], scores[keep_mask]
+    order = np.argsort(-scores)
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        rest = order[1:]
+        keep_rest = _iou_xywh(boxes[i], boxes[rest]) <= iou_threshold
+        order = rest[keep_rest]
+    return boxes[keep], scores[keep]
+
+
+def _iou_xywh(box: np.ndarray, others: np.ndarray) -> np.ndarray:
+    bx1, by1 = box[0] - box[2] / 2, box[1] - box[3] / 2
+    bx2, by2 = box[0] + box[2] / 2, box[1] + box[3] / 2
+    ox1 = others[:, 0] - others[:, 2] / 2
+    oy1 = others[:, 1] - others[:, 3] / 2
+    ox2 = others[:, 0] + others[:, 2] / 2
+    oy2 = others[:, 1] + others[:, 3] / 2
+    ix = np.maximum(0, np.minimum(bx2, ox2) - np.maximum(bx1, ox1))
+    iy = np.maximum(0, np.minimum(by2, oy2) - np.maximum(by1, oy1))
+    inter = ix * iy
+    union = box[2] * box[3] + others[:, 2] * others[:, 3] - inter
+    return inter / np.maximum(union, 1e-9)

@@ -116,7 +116,11 @@ class MultiLayerNetwork:
         return x
 
     def _init_carries(self, batch: int, dtype=jnp.float32):
-        """Zero RNN carries, one slot per layer (None for stateless layers)."""
+        """Zero RNN carries, one slot per layer (None for stateless layers).
+        Carries are always floating (int token inputs feed embeddings whose
+        outputs — and therefore scan carries — are float)."""
+        if not jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
+            dtype = jnp.float32
         return [l.init_carry(batch, dtype) if getattr(l, "is_rnn", False) else None
                 for l in self.layers]
 
@@ -166,8 +170,11 @@ class MultiLayerNetwork:
         out_layer = self.layers[-1]
         out_key = self._layer_keys[-1]
         lmask = mask
-        if mask is not None and feats.ndim == 2:
-            lmask = None  # sequence collapsed (e.g. LastTimeStep) — mask spent
+        if mask is not None and feats.ndim == 2 and x.ndim == 3:
+            # sequence input collapsed to [B, C] (e.g. LastTimeStep): the
+            # [B, T] mask was consumed by the RNN layers and no longer
+            # applies per-label. A per-SAMPLE mask on 2D input passes through.
+            lmask = None
         data_loss = out_layer.compute_loss(params.get(out_key, {}), feats, y,
                                            lmask, train=train, rng=r_out)
         reg = 0.0
@@ -334,7 +341,9 @@ class MultiLayerNetwork:
         """Run a [B, T, C] (or [B, C] single-step) segment, carrying hidden
         state across calls (ref: MultiLayerNetwork.rnnTimeStep)."""
         x = jnp.asarray(x)
-        squeeze = x.ndim == 2
+        # [B, C] float = one timestep (ref rnnTimeStep 2D overload);
+        # [B, T] int = a token sequence for an embedding front-end
+        squeeze = x.ndim == 2 and jnp.issubdtype(x.dtype, jnp.floating)
         if squeeze:
             x = x[:, None, :]
         if self._stored_carries is None:

@@ -1,0 +1,103 @@
+"""Named-op catalog — the TPU-native equivalent of libnd4j's declarable-op
+registry.
+
+Ref: `libnd4j/include/ops/declarable/OpRegistrator.h:43` (hash->op lookup
+:93), `DeclarableOp::execute` (`impl/DeclarableOp.cpp:434`), op headers
+`include/ops/declarable/headers/*.h` (the category names used here), and
+the 14 legacy families in `include/loops/*.h`.
+
+TPU-first redesign: each named op is a pure jnp/lax lowering — under jit
+XLA fuses them; there's no per-op kernel or dispatch table at runtime.
+The registry exists for API parity (execute-by-name, used by the graph
+importer and SameDiff-style frontends) and for the OpValidation harness's
+coverage accounting (ref: `autodiff/validation/OpValidation.java:92-110`).
+
+Backprop ops: the reference hand-writes `<op>_bp` kernels; here every
+differentiable forward op auto-derives its `_bp` via `jax.vjp`, so the
+catalog exposes the same `<op>_bp` names without hand-written gradients.
+"""
+from __future__ import annotations
+
+import fnmatch
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class Op:
+    name: str
+    category: str
+    fn: Callable
+    differentiable: bool = True
+    doc: str = ""
+
+
+REGISTRY: Dict[str, Op] = {}
+
+
+def op(name: str, category: str, differentiable: bool = True, doc: str = ""):
+    """Decorator: register a named op lowering."""
+    def wrap(fn):
+        REGISTRY[name] = Op(name, category, fn, differentiable, doc)
+        return fn
+    return wrap
+
+
+def register_alias(alias: str, target: str, category: Optional[str] = None):
+    t = REGISTRY[target]
+    REGISTRY[alias] = Op(alias, category or t.category, t.fn,
+                         t.differentiable, f"alias of {target}")
+
+
+def get(name: str) -> Op:
+    if name not in REGISTRY:
+        raise KeyError(f"unknown op {name!r} "
+                       f"({len(REGISTRY)} ops registered)")
+    return REGISTRY[name]
+
+
+def execute(name: str, *args, **kwargs):
+    """Execute an op by name (ref: NativeOps.execCustomOp /
+    OpRegistrator.getOperation)."""
+    return get(name).fn(*args, **kwargs)
+
+
+def ops_in_category(category: str) -> List[str]:
+    return sorted(n for n, o in REGISTRY.items() if o.category == category)
+
+
+def categories() -> List[str]:
+    return sorted({o.category for o in REGISTRY.values()})
+
+
+def _register_bp(fwd_name: str):
+    """Auto-derive `<op>_bp`: (inputs..., grad_out) -> input grads, via
+    jax.vjp of the forward lowering."""
+    fwd = REGISTRY[fwd_name]
+
+    def bp(*args, **kwargs):
+        *inputs, g = args
+        out, vjp = jax.vjp(lambda *xs: fwd.fn(*xs, **kwargs), *inputs)
+        grads = vjp(g)
+        return grads if len(grads) > 1 else grads[0]
+
+    REGISTRY[f"{fwd_name}_bp"] = Op(
+        f"{fwd_name}_bp", fwd.category, bp, False,
+        f"autodiff gradient of {fwd_name} (ref has a hand-written kernel)")
+
+
+def finalize_bp_ops(names: Sequence[str]):
+    for n in names:
+        if n in REGISTRY and f"{n}_bp" not in REGISTRY:
+            _register_bp(n)
+
+
+# populate the catalog
+from . import impl  # noqa: E402,F401
+from . import legacy  # noqa: E402,F401
+
+# the reference declares _bp kernels for these families — derive them all
+finalize_bp_ops([n for n, o in list(REGISTRY.items()) if o.differentiable])

@@ -1,0 +1,1364 @@
+"""The declarable-op catalog, organized by the reference's header
+categories (`libnd4j/include/ops/declarable/headers/*.h`). Each op is a
+pure jnp/lax lowering registered by name.
+
+Naming follows the reference exactly (`DECLARE_*_OP(<name>, ...)` names),
+so a user of the reference finds the same op names here. Layouts are
+TPU-native (NHWC / NWC / NDHWC; channels-last throughout).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from . import op, register_alias
+
+# ===========================================================================
+# broadcastable.h (44 ops)
+# ===========================================================================
+
+_BROADCASTABLE = {
+    "add": lambda a, b: a + b,
+    "subtract": lambda a, b: a - b,
+    "multiply": lambda a, b: a * b,
+    "divide": lambda a, b: a / b,
+    "realdiv": lambda a, b: a / b,
+    "truncatediv": lambda a, b: jnp.trunc(a / b),
+    "floordiv": lambda a, b: jnp.floor(a / b),
+    "floormod": lambda a, b: jnp.mod(a, b),
+    "mod": lambda a, b: jnp.mod(a, b),
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "squaredsubtract": lambda a, b: jnp.square(a - b),
+    "reversedivide": lambda a, b: b / a,
+    "reversesubtract": lambda a, b: b - a,
+    "reversemod": lambda a, b: jnp.mod(b, a),
+    "tf_atan2": jnp.arctan2,
+    "Pow": jnp.power,
+    "axpy": lambda a, b, alpha=1.0: alpha * a + b,
+}
+for _n, _f in _BROADCASTABLE.items():
+    op(_n, "broadcastable")(_f)
+register_alias("pow", "Pow")
+
+_COMPARISON = {
+    "equals": lambda a, b: a == b,
+    "not_equals": lambda a, b: a != b,
+    "greater": lambda a, b: a > b,
+    "greater_equal": lambda a, b: a >= b,
+    "less": lambda a, b: a < b,
+    "less_equal": lambda a, b: a <= b,
+}
+for _n, _f in _COMPARISON.items():
+    op(_n, "broadcastable", differentiable=False)(_f)
+
+for _n, _f in {
+    "boolean_and": jnp.logical_and, "boolean_or": jnp.logical_or,
+    "boolean_xor": jnp.logical_xor, "boolean_not": jnp.logical_not,
+}.items():
+    op(_n, "boolean", differentiable=False)(_f)
+
+for _n, _f in {
+    "eq_scalar": lambda x, s: x == s, "neq_scalar": lambda x, s: x != s,
+    "gt_scalar": lambda x, s: x > s, "gte_scalar": lambda x, s: x >= s,
+    "lt_scalar": lambda x, s: x < s, "lte_scalar": lambda x, s: x <= s,
+}.items():
+    op(_n, "boolean", differentiable=False)(_f)
+
+# ===========================================================================
+# activations.h (37 ops; _bp auto-derived)
+# ===========================================================================
+
+_ACTIVATIONS = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": lambda x, cutoff=0.0: jnp.maximum(x, cutoff),
+    "relu6": lambda x: jnp.clip(x, 0.0, 6.0),
+    "lrelu": lambda x, alpha=0.01: jnp.where(x >= 0, x, alpha * x),
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "cube": lambda x: x ** 3,
+    "hardsigmoid": lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0),
+    "hardtanh": lambda x: jnp.clip(x, -1.0, 1.0),
+    "rationaltanh": lambda x: 1.7159 * jnp.tanh(2.0 * x / 3.0),
+    "rectifiedtanh": lambda x: jnp.maximum(0.0, jnp.tanh(x)),
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "thresholdedrelu": lambda x, theta=1.0: jnp.where(x > theta, x, 0.0),
+    "identity": lambda x: x,
+    "crelu": lambda x: jnp.concatenate(
+        [jnp.maximum(x, 0), jnp.maximum(-x, 0)], axis=-1),
+    "prelu": lambda x, alpha: jnp.where(x >= 0, x, alpha * x),
+}
+for _n, _f in _ACTIVATIONS.items():
+    op(_n, "activations")(_f)
+
+
+@op("softmax", "activations")
+def _softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+@op("log_softmax", "activations")
+def _log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+# ===========================================================================
+# shape.h + related
+# ===========================================================================
+
+op("reshape", "shape")(lambda x, shape: jnp.reshape(x, tuple(int(s) for s in shape)))
+op("reshapeas", "shape")(lambda x, y: jnp.reshape(x, y.shape))
+op("permute", "shape")(lambda x, axes: jnp.transpose(x, tuple(int(a) for a in axes)))
+op("transpose", "shape")(lambda x, axes=None: jnp.transpose(x, axes))
+op("expand_dims", "shape")(lambda x, axis=0: jnp.expand_dims(x, int(axis)))
+op("squeeze", "shape")(lambda x, axis=None: jnp.squeeze(x, axis))
+op("rank", "shape", differentiable=False)(lambda x: jnp.asarray(x.ndim))
+op("size", "shape", differentiable=False)(lambda x: jnp.asarray(x.size))
+op("size_at", "shape", differentiable=False)(lambda x, dim: jnp.asarray(x.shape[int(dim)]))
+op("shape_of", "shape", differentiable=False)(lambda x: jnp.asarray(x.shape))
+op("shapes_of", "shape", differentiable=False)(lambda *xs: [jnp.asarray(x.shape) for x in xs])
+op("order", "shape", differentiable=False)(lambda x: jnp.asarray(ord("c")))
+op("broadcast_to", "shape")(lambda x, shape: jnp.broadcast_to(x, tuple(int(s) for s in shape)))
+op("broadcast_dynamic_shape", "shape", differentiable=False)(
+    lambda a, b: jnp.asarray(np.broadcast_shapes(tuple(np.asarray(a)), tuple(np.asarray(b)))))
+op("evaluate_reduction_shape", "shape", differentiable=False)(
+    lambda shape, axes, keep_dims=False: jnp.asarray(
+        [1 if (i in [int(a) for a in np.asarray(axes)]) and keep_dims else s
+         for i, s in enumerate(np.asarray(shape))
+         if keep_dims or i not in [int(a) for a in np.asarray(axes)]]))
+op("tile_to_shape", "shape")(lambda x, shape: jnp.broadcast_to(
+    x, tuple(int(s) for s in shape)))
+op("fill", "shape", differentiable=False)(lambda shape, value: jnp.full(
+    tuple(int(s) for s in np.asarray(shape)), value))
+op("fill_as", "shape")(lambda x, value: jnp.full_like(x, value))
+op("ones_as", "shape")(lambda x: jnp.ones_like(x))
+op("zeros_as", "shape")(lambda x: jnp.zeros_like(x))
+op("lin_space", "shape", differentiable=False)(
+    lambda start, stop, num: jnp.linspace(float(start), float(stop), int(num)))
+op("range", "shape", differentiable=False)(
+    lambda start, limit=None, delta=1: jnp.arange(start, limit, delta))
+op("meshgrid", "shape", differentiable=False)(
+    lambda *xs, indexing="xy": jnp.meshgrid(*xs, indexing=indexing))
+op("stack", "shape")(lambda *xs, axis=0: jnp.stack(xs, axis=axis))
+op("parallel_stack", "shape")(lambda *xs: jnp.stack(xs, axis=0))
+op("unstack", "shape")(lambda x, axis=0: [jnp.squeeze(s, axis) for s in
+                                          jnp.split(x, x.shape[axis], axis)])
+op("split", "shape")(lambda x, num, axis=0: jnp.split(x, int(num), axis=int(axis)))
+op("split_v", "shape")(lambda x, sizes, axis=0: jnp.split(
+    x, np.cumsum(np.asarray(sizes))[:-1].tolist(), axis=int(axis)))
+@op("concat", "transforms")
+def _concat(*xs, axis=-1):
+    return jnp.concatenate(xs, axis=int(axis))
+
+
+# ===========================================================================
+# transforms.h + parity_ops.h — elementwise & structural
+# ===========================================================================
+
+op("Floor", "transforms")(jnp.floor)
+register_alias("floor", "Floor")
+op("Log1p", "transforms")(jnp.log1p)
+op("rint", "transforms")(jnp.rint)
+op("square", "transforms")(jnp.square)
+op("assign", "transforms")(lambda x, y: jnp.broadcast_to(y, x.shape).astype(x.dtype))
+op("identity_n", "transforms")(lambda *xs: list(xs))
+op("noop", "transforms", differentiable=False)(lambda *xs: None)
+op("stop_gradient", "transforms")(lax.stop_gradient)
+op("Assert", "parity_ops", differentiable=False)(
+    lambda cond, *data: None)  # shape/NaN checks live in the validation pass
+op("reverse", "transforms")(lambda x, axes=None: jnp.flip(
+    x, axis=tuple(int(a) for a in axes) if axes is not None else None))
+op("roll", "transforms")(lambda x, shift, axis=None: jnp.roll(
+    x, int(shift) if np.ndim(shift) == 0 else tuple(shift),
+    axis=axis if axis is None or np.ndim(axis) == 0 else tuple(axis)))
+op("tile", "transforms")(lambda x, reps: jnp.tile(x, tuple(int(r) for r in reps)))
+op("repeat", "transforms")(lambda x, repeats, axis=0: jnp.repeat(
+    x, repeats, axis=int(axis)))
+op("cumsum", "transforms")(lambda x, axis=0, exclusive=False, reverse=False:
+                           _cum(jnp.cumsum, x, axis, exclusive, reverse))
+op("cumprod", "transforms")(lambda x, axis=0, exclusive=False, reverse=False:
+                            _cum(jnp.cumprod, x, axis, exclusive, reverse))
+
+
+def _cum(fn, x, axis, exclusive, reverse):
+    axis = int(axis)
+    if reverse:
+        x = jnp.flip(x, axis)
+    out = fn(x, axis=axis)
+    if exclusive:
+        pad = [(0, 0)] * x.ndim
+        pad[axis] = (1, 0)
+        sl = [slice(None)] * x.ndim
+        sl[axis] = slice(0, -1)
+        ident = 0.0 if fn is jnp.cumsum else 1.0
+        out = jnp.pad(out[tuple(sl)], pad, constant_values=ident)
+    if reverse:
+        out = jnp.flip(out, axis)
+    return out
+
+
+@op("pad", "transforms")
+def _pad(x, paddings, mode="constant", constant_values=0.0):
+    paddings = tuple(tuple(int(v) for v in p) for p in np.asarray(paddings))
+    mode = {"constant": "constant", "reflect": "reflect",
+            "symmetric": "symmetric"}[str(mode).lower()]
+    if mode == "constant":
+        return jnp.pad(x, paddings, mode, constant_values=constant_values)
+    return jnp.pad(x, paddings, mode)
+
+
+@op("mirror_pad", "transforms")
+def _mirror_pad(x, paddings, mode="reflect"):
+    return _pad(x, paddings, mode=mode)
+
+
+op("slice", "transforms")(lambda x, begin, size: lax.dynamic_slice(
+    x, tuple(int(b) for b in begin), tuple(int(s) for s in size)))
+
+
+@op("strided_slice", "transforms")
+def _strided_slice(x, begin, end, strides=None):
+    sl = tuple(slice(int(b), int(e), int(s))
+               for b, e, s in zip(begin, end, strides or [1] * len(begin)))
+    return x[sl]
+
+
+op("gather", "transforms")(lambda x, indices, axis=0: jnp.take(
+    x, jnp.asarray(indices), axis=int(axis)))
+op("gather_nd", "transforms")(lambda x, indices: x[tuple(
+    jnp.moveaxis(jnp.asarray(indices), -1, 0))])
+op("embedding_lookup", "transforms")(lambda params, ids, **kw: params[
+    jnp.asarray(ids)])
+
+
+def _scatter(mode):
+    def fn(ref, indices, updates):
+        idx = jnp.asarray(indices)
+        at = jnp.asarray(ref).at[idx]
+        return getattr(at, mode)(updates)
+    return fn
+
+
+for _n, _m in {"scatter_add": "add", "scatter_sub": "subtract",
+               "scatter_mul": "multiply", "scatter_div": "divide",
+               "scatter_max": "max", "scatter_min": "min",
+               "scatter_upd": "set", "scatter_update": "set"}.items():
+    op(_n, "transforms")(_scatter(_m))
+
+
+@op("scatter_nd", "transforms")
+def _scatter_nd(indices, updates, shape):
+    out = jnp.zeros(tuple(int(s) for s in np.asarray(shape)), updates.dtype)
+    idx = tuple(jnp.moveaxis(jnp.asarray(indices), -1, 0))
+    return out.at[idx].add(updates)
+
+
+op("scatter_nd_add", "transforms")(lambda ref, indices, updates: ref.at[
+    tuple(jnp.moveaxis(jnp.asarray(indices), -1, 0))].add(updates))
+op("scatter_nd_sub", "transforms")(lambda ref, indices, updates: ref.at[
+    tuple(jnp.moveaxis(jnp.asarray(indices), -1, 0))].add(-updates))
+op("scatter_nd_update", "transforms")(lambda ref, indices, updates: ref.at[
+    tuple(jnp.moveaxis(jnp.asarray(indices), -1, 0))].set(updates))
+
+
+@op("reverse_sequence", "transforms")
+def _reverse_sequence(x, seq_lengths, seq_dim=1, batch_dim=0):
+    x = jnp.moveaxis(x, (batch_dim, seq_dim), (0, 1))
+    T = x.shape[1]
+    lengths = jnp.asarray(seq_lengths).astype(jnp.int32)
+    idx = jnp.arange(T)[None, :]
+    src = lengths[:, None] - 1 - idx
+    src = jnp.where(src >= 0, src, idx)
+    shaped = src.reshape(src.shape + (1,) * (x.ndim - 2))
+    out = jnp.take_along_axis(x, jnp.broadcast_to(shaped, x.shape), axis=1)
+    return jnp.moveaxis(out, (0, 1), (batch_dim, seq_dim))
+
+
+op("clipbyvalue", "transforms")(lambda x, lo, hi: jnp.clip(x, lo, hi))
+
+
+@op("clipbynorm", "transforms")
+def _clipbynorm(x, clip_norm, axes=None):
+    axes = tuple(int(a) for a in axes) if axes is not None else None
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True))
+    return jnp.where(norm > clip_norm, x * clip_norm / norm, x)
+
+
+@op("clipbyavgnorm", "transforms")
+def _clipbyavgnorm(x, clip_norm, axes=None):
+    axes = tuple(int(a) for a in axes) if axes is not None else None
+    n = x.size if axes is None else np.prod([x.shape[a] for a in axes])
+    avg = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True)) / n
+    return jnp.where(avg > clip_norm, x * clip_norm / avg, x)
+
+
+@op("clip_by_global_norm", "transforms")
+def _clip_by_global_norm(xs, clip_norm):
+    xs = list(xs)
+    g = jnp.sqrt(sum(jnp.sum(jnp.square(x)) for x in xs))
+    scale = jnp.minimum(1.0, clip_norm / jnp.maximum(g, 1e-12))
+    return [x * scale for x in xs], g
+
+
+@op("standardize", "transforms")
+def _standardize(x, axes=-1):
+    axes = (int(axes),) if np.ndim(axes) == 0 else tuple(int(a) for a in axes)
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    std = jnp.std(x, axis=axes, keepdims=True)
+    return (x - mean) / (std + 1e-12)
+
+
+@op("layer_norm", "nn")
+def _layer_norm(x, gain, bias=None, axes=-1):
+    z = _standardize(x, axes)
+    z = z * gain
+    if bias is not None:
+        z = z + bias
+    return z
+
+
+op("dynamic_partition", "transforms", differentiable=False)(
+    lambda x, partitions, num_partitions: [
+        x[jnp.asarray(partitions) == i] for i in range(int(num_partitions))])
+
+
+@op("dynamic_stitch", "transforms", differentiable=False)
+def _dynamic_stitch(indices, data):
+    n = int(max(int(jnp.max(i)) for i in indices)) + 1
+    first = data[0]
+    out = jnp.zeros((n,) + first.shape[1:], first.dtype)
+    for idx, d in zip(indices, data):
+        out = out.at[jnp.asarray(idx)].set(d)
+    return out
+
+
+op("histogram_fixed_width", "parity_ops", differentiable=False)(
+    lambda x, range_, nbins=100: jnp.histogram(
+        x, bins=int(nbins), range=(float(range_[0]), float(range_[1])))[0])
+op("bincount", "parity_ops", differentiable=False)(
+    lambda x, weights=None, minlength=0, maxlength=None: jnp.bincount(
+        jnp.asarray(x).ravel().astype(jnp.int32),
+        weights=None if weights is None else jnp.asarray(weights).ravel(),
+        minlength=int(minlength),
+        length=None if maxlength is None else int(maxlength)))
+op("Where", "boolean", differentiable=False)(
+    lambda cond: jnp.stack(jnp.nonzero(cond), axis=-1))
+register_alias("where_np", "Where")
+op("select", "boolean")(lambda cond, a, b: jnp.where(cond, a, b))
+op("choose", "boolean", differentiable=False)(
+    lambda x, scalar, mode="gt": {
+        "gt": x > scalar, "lt": x < scalar, "eq": x == scalar,
+        "gte": x >= scalar, "lte": x <= scalar}[mode])
+op("cross", "transforms")(lambda a, b: jnp.cross(a, b))
+op("trace", "transforms")(lambda x: jnp.trace(x, axis1=-2, axis2=-1))
+op("tri", "transforms", differentiable=False)(
+    lambda n, m=None, k=0: jnp.tri(int(n), None if m is None else int(m), int(k)))
+op("triu", "transforms")(lambda x, k=0: jnp.triu(x, int(k)))
+op("diag", "transforms")(lambda x: jnp.diag(x.ravel()) if x.ndim <= 1
+                         else jnp.diag(x))
+op("diag_part", "transforms")(lambda x: jnp.diagonal(x, axis1=-2, axis2=-1))
+op("matrix_diag", "transforms")(
+    lambda x: jax.vmap(jnp.diag)(x.reshape(-1, x.shape[-1])).reshape(
+        x.shape + (x.shape[-1],)) if x.ndim > 1 else jnp.diag(x))
+op("matrix_diag_part", "transforms")(lambda x: jnp.diagonal(x, axis1=-2, axis2=-1))
+
+
+@op("matrix_set_diag", "transforms")
+def _matrix_set_diag(x, diagonal):
+    n = min(x.shape[-2], x.shape[-1])
+    eye = jnp.eye(x.shape[-2], x.shape[-1], dtype=bool)
+    dm = jnp.zeros_like(x).at[..., jnp.arange(n), jnp.arange(n)].set(diagonal)
+    return jnp.where(eye, dm, x)
+
+
+@op("matrix_band_part", "transforms")
+def _matrix_band_part(x, num_lower, num_upper):
+    m, n = x.shape[-2], x.shape[-1]
+    i = jnp.arange(m)[:, None]
+    j = jnp.arange(n)[None, :]
+    keep = jnp.ones((m, n), bool)
+    if int(num_lower) >= 0:
+        keep &= (i - j) <= int(num_lower)
+    if int(num_upper) >= 0:
+        keep &= (j - i) <= int(num_upper)
+    return jnp.where(keep, x, 0)
+
+
+op("eye", "transforms", differentiable=False)(
+    lambda rows, cols=None, batch_shape=None: jnp.broadcast_to(
+        jnp.eye(int(rows), None if cols is None else int(cols)),
+        (tuple(int(b) for b in batch_shape) if batch_shape else ()) +
+        (int(rows), int(cols or rows))))
+op("onehot", "transforms", differentiable=False)(
+    lambda indices, depth, on=1.0, off=0.0, axis=-1: jax.nn.one_hot(
+        jnp.asarray(indices), int(depth), axis=int(axis)) * (on - off) + off)
+op("sequence_mask", "transforms", differentiable=False)(
+    lambda lengths, maxlen=None: (jnp.arange(
+        int(maxlen) if maxlen is not None else int(jnp.max(jnp.asarray(lengths))))
+        [None, :] < jnp.asarray(lengths)[..., None]))
+op("invert_permutation", "transforms", differentiable=False)(
+    lambda p: jnp.zeros_like(jnp.asarray(p)).at[jnp.asarray(p)].set(
+        jnp.arange(len(np.asarray(p)))))
+
+
+@op("unique", "parity_ops", differentiable=False)
+def _unique(x):
+    vals, idx = np.unique(np.asarray(x), return_inverse=True)
+    return jnp.asarray(vals), jnp.asarray(idx)
+
+
+@op("unique_with_counts", "parity_ops", differentiable=False)
+def _unique_with_counts(x):
+    vals, idx, counts = np.unique(np.asarray(x), return_inverse=True,
+                                  return_counts=True)
+    return jnp.asarray(vals), jnp.asarray(idx), jnp.asarray(counts)
+
+
+op("top_k", "parity_ops", differentiable=False)(
+    lambda x, k=1, sorted=True: lax.top_k(x, int(k)))
+op("in_top_k", "parity_ops", differentiable=False)(
+    lambda predictions, targets, k: (lax.top_k(predictions, int(k))[1] ==
+                                     jnp.asarray(targets)[:, None]).any(-1))
+op("nth_element", "parity_ops", differentiable=False)(
+    lambda x, n, reverse=False: jnp.sort(x, axis=-1)[
+        ..., -(int(n) + 1) if reverse else int(n)])
+op("zero_fraction", "parity_ops", differentiable=False)(
+    lambda x: jnp.mean((x == 0).astype(jnp.float32)))
+op("listdiff", "parity_ops", differentiable=False)(
+    lambda x, y: (lambda xs, ys: (jnp.asarray([v for v in xs if v not in ys]),
+                                  jnp.asarray([i for i, v in enumerate(xs)
+                                               if v not in ys])))
+    (np.asarray(x).tolist(), set(np.asarray(y).tolist())))
+op("confusion_matrix", "parity_ops", differentiable=False)(
+    lambda labels, pred, num_classes=None, weights=None: _confusion(
+        labels, pred, num_classes, weights))
+
+
+def _confusion(labels, pred, num_classes, weights):
+    labels = jnp.asarray(labels).astype(jnp.int32)
+    pred = jnp.asarray(pred).astype(jnp.int32)
+    n = int(num_classes) if num_classes else int(jnp.maximum(
+        jnp.max(labels), jnp.max(pred))) + 1
+    w = jnp.ones_like(labels, jnp.float32) if weights is None else jnp.asarray(weights)
+    cm = jnp.zeros((n, n), w.dtype)
+    return cm.at[labels, pred].add(w)
+
+
+op("betainc", "transforms")(lambda a, b, x: jax.scipy.special.betainc(a, b, x))
+op("polygamma", "transforms")(lambda n, x: jax.scipy.special.polygamma(
+    jnp.asarray(n).astype(jnp.int32), x))
+op("zeta", "transforms")(lambda x, q: jax.scipy.special.zeta(x, q))
+op("is_non_decreasing", "boolean", differentiable=False)(
+    lambda x: jnp.all(jnp.diff(x.ravel()) >= 0))
+op("is_strictly_increasing", "boolean", differentiable=False)(
+    lambda x: jnp.all(jnp.diff(x.ravel()) > 0))
+op("is_numeric_tensor", "boolean", differentiable=False)(
+    lambda x: jnp.issubdtype(x.dtype, jnp.number))
+op("toggle_bits", "bitwise", differentiable=False)(
+    lambda x: ~jnp.asarray(x))
+
+
+@op("adjust_hue", "parity_ops", differentiable=False)
+def _adjust_hue(img, delta):
+    # RGB->HSV->shift hue->RGB (ref: adjust_hue kernel)
+    r, g, b = img[..., 0], img[..., 1], img[..., 2]
+    mx = jnp.max(img[..., :3], axis=-1)
+    mn = jnp.min(img[..., :3], axis=-1)
+    diff = mx - mn + 1e-12
+    h = jnp.where(mx == r, (g - b) / diff % 6,
+                  jnp.where(mx == g, (b - r) / diff + 2, (r - g) / diff + 4)) / 6
+    s = jnp.where(mx > 0, diff / (mx + 1e-12), 0.0)
+    v = mx
+    h = (h + delta) % 1.0
+    i = jnp.floor(h * 6)
+    f = h * 6 - i
+    p, q, t = v * (1 - s), v * (1 - f * s), v * (1 - (1 - f) * s)
+    i = i.astype(jnp.int32) % 6
+    r2 = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                    [v, q, p, p, t, v])
+    g2 = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                    [t, v, v, q, p, p])
+    b2 = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                    [p, p, t, v, v, q])
+    return jnp.stack([r2, g2, b2], axis=-1)
+
+
+@op("adjust_saturation", "parity_ops", differentiable=False)
+def _adjust_saturation(img, factor):
+    gray = jnp.mean(img[..., :3], axis=-1, keepdims=True)
+    return jnp.clip(gray + (img - gray) * factor, 0.0, 1.0)
+
+
+# ===========================================================================
+# reductions (reduce_*.h legacy + parity segment ops)
+# ===========================================================================
+
+def _axes(dims, x):
+    if dims is None:
+        return None
+    if np.ndim(dims) == 0:
+        return (int(dims),)
+    return tuple(int(d) for d in dims)
+
+
+_REDUCE = {
+    "reduce_sum": jnp.sum, "reduce_mean": jnp.mean, "reduce_max": jnp.max,
+    "reduce_min": jnp.min, "reduce_prod": jnp.prod,
+    "reduce_stdev": jnp.std, "reduce_variance": jnp.var,
+}
+for _n, _f in _REDUCE.items():
+    op(_n, "reduce")(partial(lambda f, x, axes=None, keep_dims=False:
+                             f(x, axis=_axes(axes, x), keepdims=bool(keep_dims)), _f))
+
+op("reduce_norm1", "reduce")(lambda x, axes=None, keep_dims=False: jnp.sum(
+    jnp.abs(x), axis=_axes(axes, x), keepdims=bool(keep_dims)))
+op("reduce_norm2", "reduce")(lambda x, axes=None, keep_dims=False: jnp.sqrt(
+    jnp.sum(jnp.square(x), axis=_axes(axes, x), keepdims=bool(keep_dims))))
+op("reduce_sqnorm", "reduce")(lambda x, axes=None, keep_dims=False: jnp.sum(
+    jnp.square(x), axis=_axes(axes, x), keepdims=bool(keep_dims)))
+op("reduce_norm_max", "reduce")(lambda x, axes=None, keep_dims=False: jnp.max(
+    jnp.abs(x), axis=_axes(axes, x), keepdims=bool(keep_dims)))
+op("reduce_logsumexp", "reduce")(lambda x, axes=None, keep_dims=False:
+                                 jax.scipy.special.logsumexp(
+                                     x, axis=_axes(axes, x),
+                                     keepdims=bool(keep_dims)))
+op("reduce_dot", "reduce")(lambda a, b, axes=None, keep_dims=False: jnp.sum(
+    a * b, axis=_axes(axes, a), keepdims=bool(keep_dims)))
+op("argmax", "reduce", differentiable=False)(
+    lambda x, axis=None: jnp.argmax(x, axis=axis))
+op("argmin", "reduce", differentiable=False)(
+    lambda x, axis=None: jnp.argmin(x, axis=axis))
+op("ismax", "reduce", differentiable=False)(
+    lambda x, axis=-1: (x == jnp.max(x, axis=axis, keepdims=True)).astype(x.dtype))
+op("moments", "reduce")(lambda x, axes=None, keep_dims=False: (
+    jnp.mean(x, axis=_axes(axes, x), keepdims=bool(keep_dims)),
+    jnp.var(x, axis=_axes(axes, x), keepdims=bool(keep_dims))))
+op("normalize_moments", "reduce")(lambda count, mean_ss, var_ss, shift=0.0: (
+    mean_ss / count + shift,
+    var_ss / count - jnp.square(mean_ss / count)))
+op("sufficient_statistics", "reduce")(lambda x, axes: (
+    jnp.asarray(np.prod([x.shape[a] for a in _axes(axes, x)])),
+    jnp.sum(x, axis=_axes(axes, x)),
+    jnp.sum(jnp.square(x), axis=_axes(axes, x))))
+op("percentile", "reduce", differentiable=False)(
+    lambda x, q, axis=None: jnp.percentile(x, q, axis=axis))
+op("l2_loss", "nn")(lambda x: 0.5 * jnp.sum(jnp.square(x)))
+
+
+def _segment(reduce_fn, init):
+    def fn(x, segment_ids, num_segments=None):
+        ids = jnp.asarray(segment_ids).astype(jnp.int32)
+        n = int(num_segments) if num_segments is not None \
+            else int(jnp.max(ids)) + 1
+        out = jnp.full((n,) + x.shape[1:], init, x.dtype)
+        return getattr(out.at[ids], reduce_fn)(x)
+    return fn
+
+
+op("segment_sum", "parity_ops")(_segment("add", 0))
+op("segment_prod", "parity_ops")(_segment("multiply", 1))
+op("segment_max", "parity_ops")(_segment("max", -jnp.inf))
+op("segment_min", "parity_ops")(_segment("min", jnp.inf))
+
+
+@op("segment_mean", "parity_ops")
+def _segment_mean(x, segment_ids, num_segments=None):
+    s = _segment("add", 0)(x, segment_ids, num_segments)
+    c = _segment("add", 0)(jnp.ones_like(x), segment_ids, num_segments)
+    return s / jnp.maximum(c, 1)
+
+
+for _n, _t in {"unsorted_segment_sum": "segment_sum",
+               "unsorted_segment_prod": "segment_prod",
+               "unsorted_segment_max": "segment_max",
+               "unsorted_segment_min": "segment_min",
+               "unsorted_segment_mean": "segment_mean"}.items():
+    register_alias(_n, _t, "parity_ops")
+
+
+@op("unsorted_segment_sqrt_n", "parity_ops")
+def _unsorted_segment_sqrt_n(x, segment_ids, num_segments=None):
+    s = _segment("add", 0)(x, segment_ids, num_segments)
+    c = _segment("add", 0)(jnp.ones_like(x), segment_ids, num_segments)
+    return s / jnp.sqrt(jnp.maximum(c, 1))
+
+
+# ===========================================================================
+# blas.h
+# ===========================================================================
+
+op("matmul", "blas")(lambda a, b, transpose_a=False, transpose_b=False:
+                     jnp.matmul(jnp.swapaxes(a, -1, -2) if transpose_a else a,
+                                jnp.swapaxes(b, -1, -2) if transpose_b else b))
+op("tensormmul", "blas")(lambda a, b, axes_a, axes_b: jnp.tensordot(
+    a, b, axes=(tuple(int(x) for x in axes_a), tuple(int(x) for x in axes_b))))
+op("batched_gemm", "blas")(lambda a, b: jnp.einsum("bij,bjk->bik", a, b))
+op("xw_plus_b", "blas")(lambda x, w, b: x @ w + b)
+op("svd", "blas", differentiable=False)(
+    lambda x, full_matrices=False, compute_uv=True: jnp.linalg.svd(
+        x, full_matrices=full_matrices, compute_uv=compute_uv))
+op("cholesky", "blas")(jnp.linalg.cholesky)
+op("matrix_determinant", "blas")(jnp.linalg.det)
+op("log_matrix_determinant", "blas")(lambda x: jnp.linalg.slogdet(x))
+op("logdet", "blas")(lambda x: jnp.linalg.slogdet(x)[1])
+op("matrix_inverse", "blas")(jnp.linalg.inv)
+
+
+# ===========================================================================
+# convo.h — NHWC/NWC/NDHWC lowerings onto the MXU
+# ===========================================================================
+
+def _pad_arg(padding, same_flag=None):
+    if isinstance(padding, str):
+        return padding.upper()
+    return padding
+
+
+@op("conv2d", "convo")
+def conv2d(x, w, b=None, stride=(1, 1), padding="same", dilation=(1, 1),
+           groups=1):
+    z = lax.conv_general_dilated(
+        x, w, window_strides=tuple(stride), padding=_pad_arg(padding),
+        rhs_dilation=tuple(dilation), feature_group_count=int(groups),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return z if b is None else z + b
+
+
+@op("conv1d", "convo")
+def conv1d(x, w, b=None, stride=1, padding="same", dilation=1):
+    z = lax.conv_general_dilated(
+        x, w, window_strides=(int(stride),), padding=_pad_arg(padding),
+        rhs_dilation=(int(dilation),),
+        dimension_numbers=("NWC", "WIO", "NWC"))
+    return z if b is None else z + b
+
+
+@op("conv3dnew", "convo")
+def conv3d(x, w, b=None, stride=(1, 1, 1), padding="same",
+           dilation=(1, 1, 1)):
+    z = lax.conv_general_dilated(
+        x, w, window_strides=tuple(stride), padding=_pad_arg(padding),
+        rhs_dilation=tuple(dilation),
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+    return z if b is None else z + b
+
+
+@op("deconv2d", "convo")
+def deconv2d(x, w, b=None, stride=(2, 2), padding="valid"):
+    z = lax.conv_transpose(x, w, strides=tuple(stride),
+                           padding=_pad_arg(padding),
+                           dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return z if b is None else z + b
+
+
+register_alias("deconv2d_tf", "deconv2d")
+
+
+@op("deconv3d", "convo")
+def deconv3d(x, w, b=None, stride=(2, 2, 2), padding="valid"):
+    z = lax.conv_transpose(x, w, strides=tuple(stride),
+                           padding=_pad_arg(padding),
+                           dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+    return z if b is None else z + b
+
+
+@op("depthwise_conv2d", "convo")
+def depthwise_conv2d(x, w, b=None, stride=(1, 1), padding="same",
+                     dilation=(1, 1)):
+    c_in = x.shape[-1]
+    z = lax.conv_general_dilated(
+        x, w, window_strides=tuple(stride), padding=_pad_arg(padding),
+        rhs_dilation=tuple(dilation), feature_group_count=c_in,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return z if b is None else z + b
+
+
+@op("sconv2d", "convo")
+def sconv2d(x, dw, pw=None, b=None, stride=(1, 1), padding="same"):
+    z = depthwise_conv2d(x, dw, None, stride, padding)
+    if pw is not None:
+        z = lax.conv_general_dilated(
+            z, pw, (1, 1), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return z if b is None else z + b
+
+
+op("pointwise_conv2d", "convo")(lambda x, w, b=None: conv2d(
+    x, w, b, (1, 1), "valid"))
+
+
+def _pool2d(x, kernel, stride, padding, kind, pnorm=2):
+    window = (1,) + tuple(kernel) + (1,)
+    strides = (1,) + tuple(stride) + (1,)
+    pad = padding.upper() if isinstance(padding, str) else padding
+    if kind == "max":
+        return lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pad)
+    if kind == "avg":
+        s = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+        c = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window, strides,
+                              pad)
+        return s / c
+    p = float(pnorm)
+    s = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window, strides, pad)
+    return s ** (1.0 / p)
+
+
+op("maxpool2d", "convo")(lambda x, kernel=(2, 2), stride=(2, 2),
+                         padding="valid": _pool2d(x, kernel, stride, padding, "max"))
+op("avgpool2d", "convo")(lambda x, kernel=(2, 2), stride=(2, 2),
+                         padding="valid": _pool2d(x, kernel, stride, padding, "avg"))
+op("pnormpool2d", "convo")(lambda x, kernel=(2, 2), stride=(2, 2),
+                           padding="valid", pnorm=2: _pool2d(
+                               x, kernel, stride, padding, "pnorm", pnorm))
+
+
+def _pool3d(x, kernel, stride, padding, kind):
+    window = (1,) + tuple(kernel) + (1,)
+    strides = (1,) + tuple(stride) + (1,)
+    pad = padding.upper() if isinstance(padding, str) else padding
+    if kind == "max":
+        return lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pad)
+    s = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+    c = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window, strides, pad)
+    return s / c
+
+
+op("maxpool3dnew", "convo")(lambda x, kernel=(2, 2, 2), stride=(2, 2, 2),
+                            padding="valid": _pool3d(x, kernel, stride, padding, "max"))
+op("avgpool3dnew", "convo")(lambda x, kernel=(2, 2, 2), stride=(2, 2, 2),
+                            padding="valid": _pool3d(x, kernel, stride, padding, "avg"))
+
+
+@op("max_pool_with_argmax", "convo", differentiable=False)
+def _max_pool_with_argmax(x, kernel=(2, 2), stride=(2, 2), padding="valid"):
+    """Max pool + flat-index argmax (TF semantics: index into the flattened
+    [H, W, C] input). Works for any stride via patch extraction; indices
+    are computed in int32, never through the float path."""
+    out = _pool2d(x, kernel, stride, padding, "max")
+    B, H, W, C = x.shape
+    kh, kw = int(kernel[0]), int(kernel[1])
+    sh, sw = int(stride[0]), int(stride[1])
+    pad = padding.upper() if isinstance(padding, str) else padding
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), pad,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    oh, ow = patches.shape[1], patches.shape[2]
+    # patch features are channel-major (C, kh, kw)
+    p = patches.reshape(B, oh, ow, C, kh * kw)
+    k_star = jnp.argmax(p, axis=-1)                         # [B, oh, ow, C]
+    ky, kx = k_star // kw, k_star % kw
+    oy = jnp.arange(oh)[None, :, None, None]
+    ox = jnp.arange(ow)[None, None, :, None]
+    ci = jnp.arange(C)[None, None, None, :]
+    flat = ((oy * sh + ky) * W + (ox * sw + kx)) * C + ci
+    return out, flat.astype(jnp.int32)
+
+
+@op("im2col", "convo")
+def _im2col(x, kernel=(2, 2), stride=(1, 1), padding="valid", dilation=(1, 1)):
+    return lax.conv_general_dilated_patches(
+        x, tuple(kernel), tuple(stride),
+        padding.upper() if isinstance(padding, str) else padding,
+        rhs_dilation=tuple(dilation),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+@op("col2im", "convo")
+def _col2im(cols, output_shape, kernel=(2, 2), stride=(1, 1)):
+    # adjoint of im2col — expressed via the VJP of the patch extraction
+    def f(x):
+        return lax.conv_general_dilated_patches(
+            x, tuple(kernel), tuple(stride), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    zeros = jnp.zeros(tuple(int(s) for s in output_shape), cols.dtype)
+    _, vjp = jax.vjp(f, zeros)
+    return vjp(cols)[0]
+
+
+op("upsampling2d", "convo")(lambda x, size=(2, 2): jnp.repeat(
+    jnp.repeat(x, int(size[0]), axis=1), int(size[1]), axis=2))
+op("upsampling3d", "convo")(lambda x, size=(2, 2, 2): jnp.repeat(jnp.repeat(
+    jnp.repeat(x, int(size[0]), axis=1), int(size[1]), axis=2),
+    int(size[2]), axis=3))
+
+
+@op("dilation2d", "convo")
+def _dilation2d(x, w, stride=(1, 1), rate=(1, 1), padding="same"):
+    # morphological dilation: max over window of (x + w)
+    B, H, W, C = x.shape
+    kh, kw = w.shape[0], w.shape[1]
+    pad = padding.upper() if isinstance(padding, str) else padding
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), tuple(stride), pad, rhs_dilation=tuple(rate),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    oh, ow = patches.shape[1], patches.shape[2]
+    patches = patches.reshape(B, oh, ow, C, kh * kw)  # C-major patch order
+    wflat = jnp.moveaxis(w.reshape(kh * kw, C), 0, -1)
+    return jnp.max(patches + wflat, axis=-1)
+
+
+op("extract_image_patches", "convo")(lambda x, kernel, stride, rate=(1, 1),
+                                     padding="valid": _im2col(
+                                         x, kernel, stride, padding, rate))
+
+
+@op("resize_bilinear", "convo")
+def _resize_bilinear(x, size, align_corners=False):
+    return jax.image.resize(x, (x.shape[0], int(size[0]), int(size[1]),
+                                x.shape[3]), method="bilinear")
+
+
+@op("resize_nearest_neighbor", "convo")
+def _resize_nn(x, size):
+    return jax.image.resize(x, (x.shape[0], int(size[0]), int(size[1]),
+                                x.shape[3]), method="nearest")
+
+
+@op("crop_and_resize", "convo", differentiable=False)
+def _crop_and_resize(img, boxes, box_indices, crop_size):
+    ch, cw = int(crop_size[0]), int(crop_size[1])
+    outs = []
+    B, H, W, C = img.shape
+    for box, bi in zip(np.asarray(boxes), np.asarray(box_indices)):
+        y1, x1, y2, x2 = [float(v) for v in box]
+        src = img[int(bi), int(y1 * (H - 1)):max(int(y2 * (H - 1)), int(y1 * (H - 1)) + 1) + 1,
+                  int(x1 * (W - 1)):max(int(x2 * (W - 1)), int(x1 * (W - 1)) + 1) + 1]
+        outs.append(jax.image.resize(src, (ch, cw, C), method="bilinear"))
+    return jnp.stack(outs)
+
+
+@op("space_to_depth", "convo")
+def _space_to_depth(x, block_size=2):
+    B, H, W, C = x.shape
+    s = int(block_size)
+    z = x.reshape(B, H // s, s, W // s, s, C)
+    return z.transpose(0, 1, 3, 2, 4, 5).reshape(B, H // s, W // s, C * s * s)
+
+
+@op("depth_to_space", "convo")
+def _depth_to_space(x, block_size=2):
+    B, H, W, C = x.shape
+    s = int(block_size)
+    z = x.reshape(B, H, W, s, s, C // (s * s))
+    return z.transpose(0, 1, 3, 2, 4, 5).reshape(B, H * s, W * s, C // (s * s))
+
+
+@op("space_to_batch", "convo")
+def _space_to_batch(x, blocks=(2, 2), paddings=((0, 0), (0, 0))):
+    (pt, pb), (pl, pr) = paddings
+    x = jnp.pad(x, ((0, 0), (pt, pb), (pl, pr), (0, 0)))
+    B, H, W, C = x.shape
+    bh, bw = int(blocks[0]), int(blocks[1])
+    z = x.reshape(B, H // bh, bh, W // bw, bw, C)
+    return z.transpose(2, 4, 0, 1, 3, 5).reshape(B * bh * bw, H // bh,
+                                                 W // bw, C)
+
+
+@op("batch_to_space", "convo")
+def _batch_to_space(x, blocks=(2, 2), crops=((0, 0), (0, 0))):
+    bh, bw = int(blocks[0]), int(blocks[1])
+    Bb, H, W, C = x.shape
+    B = Bb // (bh * bw)
+    z = x.reshape(bh, bw, B, H, W, C).transpose(2, 3, 0, 4, 1, 5)
+    z = z.reshape(B, H * bh, W * bw, C)
+    (ct, cb), (cl, cr) = crops
+    return z[:, ct:z.shape[1] - cb if cb else None,
+             cl:z.shape[2] - cr if cr else None, :]
+
+
+# ===========================================================================
+# nn.h
+# ===========================================================================
+
+@op("batchnorm", "nn")
+def _batchnorm(x, mean, variance, gamma=None, beta=None, eps=1e-5):
+    z = (x - mean) / jnp.sqrt(variance + eps)
+    if gamma is not None:
+        z = z * gamma
+    if beta is not None:
+        z = z + beta
+    return z
+
+
+register_alias("batchnorm_new", "batchnorm")
+
+
+@op("fused_batch_norm", "nn")
+def _fused_batch_norm(x, gamma, beta, eps=1e-3):
+    axes = tuple(range(x.ndim - 1))
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.var(x, axis=axes)
+    return _batchnorm(x, mean, var, gamma, beta, eps), mean, var
+
+
+op("biasadd", "nn")(lambda x, b: x + b)
+op("relu_layer", "nn")(lambda x, w, b: jnp.maximum(x @ w + b, 0.0))
+
+
+@op("lrn", "nn")
+def _lrn(x, k=2.0, n=5, alpha=1e-4, beta=0.75):
+    half = int(n) // 2
+    sq = jnp.square(x)
+    padded = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half, half)])
+    ssum = sum(padded[..., i:i + x.shape[-1]] for i in range(int(n)))
+    return x / jnp.power(k + alpha * ssum, beta)
+
+
+register_alias("lrn_old", "lrn")
+
+
+@op("dropout", "random")
+def _dropout(x, rate, rng=None):
+    if rng is None:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)
+
+
+@op("apply_sgd", "nn")
+def _apply_sgd(params, grads, lr):
+    return jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+
+
+@op("fake_quant_with_min_max_vars", "nn", differentiable=False)
+def _fake_quant(x, min_val, max_val, num_bits=8):
+    n = float(2 ** int(num_bits) - 1)
+    scale = (max_val - min_val) / n
+    q = jnp.round((jnp.clip(x, min_val, max_val) - min_val) / scale)
+    return q * scale + min_val
+
+
+# ===========================================================================
+# loss.h (TF-style reduction-mode losses; grads auto-derived)
+# ===========================================================================
+
+def _weighted_loss(per_example, weights, reduction):
+    w = jnp.asarray(weights) if weights is not None else 1.0
+    loss = per_example * w
+    if reduction in ("none", 0):
+        return loss
+    if reduction in ("sum", 1):
+        return jnp.sum(loss)
+    if reduction in ("mean_by_weight", 3):
+        denom = jnp.sum(jnp.broadcast_to(w, per_example.shape))
+        return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+    return jnp.mean(loss)  # "weighted_mean" default
+
+
+def _loss(name):
+    def deco(fn):
+        return op(name, "loss")(fn)
+    return deco
+
+
+@_loss("absolute_difference_loss")
+def _abs_loss(predictions, labels, weights=None, reduction="weighted_mean"):
+    return _weighted_loss(jnp.abs(predictions - labels), weights, reduction)
+
+
+@_loss("mean_sqerr_loss")
+def _mse_loss(predictions, labels, weights=None, reduction="weighted_mean"):
+    return _weighted_loss(jnp.square(predictions - labels), weights, reduction)
+
+
+@_loss("huber_loss")
+def _huber_loss(predictions, labels, weights=None, delta=1.0,
+                reduction="weighted_mean"):
+    err = jnp.abs(predictions - labels)
+    l = jnp.where(err <= delta, 0.5 * jnp.square(err),
+                  delta * err - 0.5 * delta ** 2)
+    return _weighted_loss(l, weights, reduction)
+
+
+@_loss("log_loss")
+def _log_loss(predictions, labels, weights=None, eps=1e-7,
+              reduction="weighted_mean"):
+    p = jnp.clip(predictions, eps, 1 - eps)
+    l = -(labels * jnp.log(p) + (1 - labels) * jnp.log(1 - p))
+    return _weighted_loss(l, weights, reduction)
+
+
+@_loss("hinge_loss")
+def _hinge_loss(logits, labels, weights=None, reduction="weighted_mean"):
+    y = 2.0 * labels - 1.0
+    return _weighted_loss(jnp.maximum(0.0, 1.0 - y * logits), weights,
+                          reduction)
+
+
+@_loss("cosine_distance_loss")
+def _cosine_loss(predictions, labels, weights=None, axis=-1,
+                 reduction="weighted_mean"):
+    return _weighted_loss(1.0 - jnp.sum(predictions * labels, axis=int(axis),
+                                        keepdims=True), weights, reduction)
+
+
+@_loss("log_poisson_loss")
+def _log_poisson(log_input, targets, weights=None, full=False,
+                 reduction="weighted_mean"):
+    l = jnp.exp(log_input) - targets * log_input
+    if full:
+        l = l + (targets * jnp.log(jnp.maximum(targets, 1e-12)) - targets +
+                 0.5 * jnp.log(2 * jnp.pi * jnp.maximum(targets, 1e-12)))
+    return _weighted_loss(l, weights, reduction)
+
+
+@_loss("mean_pairwssqerr_loss")
+def _pairwise_mse(predictions, labels, weights=None,
+                  reduction="weighted_mean"):
+    d = predictions - labels
+    n = d.shape[-1]
+    sum_d = jnp.sum(d, axis=-1, keepdims=True)
+    per = (n * jnp.sum(jnp.square(d), axis=-1, keepdims=True) -
+           jnp.square(sum_d)) / jnp.maximum(n * n, 1)
+    return _weighted_loss(per, weights, reduction)
+
+
+@_loss("sigm_cross_entropy_loss")
+def _sigm_xent(logits, labels, weights=None, label_smoothing=0.0,
+               reduction="weighted_mean"):
+    if label_smoothing:
+        labels = labels * (1 - label_smoothing) + 0.5 * label_smoothing
+    l = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(
+        jnp.exp(-jnp.abs(logits)))
+    return _weighted_loss(l, weights, reduction)
+
+
+@_loss("softmax_cross_entropy_loss")
+def _softmax_xent(logits, labels, weights=None, label_smoothing=0.0,
+                  reduction="weighted_mean"):
+    n = labels.shape[-1]
+    if label_smoothing:
+        labels = labels * (1 - label_smoothing) + label_smoothing / n
+    l = -jnp.sum(labels * jax.nn.log_softmax(logits, axis=-1), axis=-1,
+                 keepdims=True)
+    return _weighted_loss(l, weights, reduction)
+
+
+@_loss("softmax_cross_entropy_loss_with_logits")
+def _softmax_xent_logits(logits, labels, axis=-1):
+    return -jnp.sum(labels * jax.nn.log_softmax(logits, axis=int(axis)),
+                    axis=int(axis))
+
+
+@_loss("sparse_softmax_cross_entropy_loss_with_logits")
+def _sparse_softmax_xent(logits, labels):
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(
+        lp, jnp.asarray(labels)[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+
+@_loss("weighted_cross_entropy_with_logits")
+def _weighted_xent(targets, logits, pos_weight):
+    log_weight = 1 + (pos_weight - 1) * targets
+    return (1 - targets) * logits + log_weight * (
+        jnp.log1p(jnp.exp(-jnp.abs(logits))) + jnp.maximum(-logits, 0))
+
+
+# ===========================================================================
+# recurrent.h — functional cells (layer-level impls live in nn.layers)
+# ===========================================================================
+
+@op("lstmCell", "recurrent")
+def lstm_cell(x, h_prev, c_prev, W, U, b, forget_bias=1.0):
+    """One LSTM step. Gate layout [i|f|g|o] (ref: lstmCell
+    `include/ops/declarable/headers/recurrent.h`)."""
+    H = h_prev.shape[-1]
+    z = x @ W + h_prev @ U + b
+    i = jax.nn.sigmoid(z[..., :H])
+    f = jax.nn.sigmoid(z[..., H:2 * H] + forget_bias)
+    g = jnp.tanh(z[..., 2 * H:3 * H])
+    o = jax.nn.sigmoid(z[..., 3 * H:])
+    c = f * c_prev + i * g
+    h = o * jnp.tanh(c)
+    return h, c
+
+
+register_alias("lstmBlockCell", "lstmCell")
+
+
+@op("lstm", "recurrent")
+def lstm_seq(x, h0, c0, W, U, b, forget_bias=0.0):
+    """Full-sequence LSTM over [B, T, C] via scan (ref: lstm / lstmBlock)."""
+    xz = jnp.einsum("btc,cf->btf", x, W) + b
+
+    def step(hc, z_t):
+        h, c = hc
+        H = h.shape[-1]
+        z = z_t + h @ U
+        i = jax.nn.sigmoid(z[..., :H])
+        f = jax.nn.sigmoid(z[..., H:2 * H] + forget_bias)
+        g = jnp.tanh(z[..., 2 * H:3 * H])
+        o = jax.nn.sigmoid(z[..., 3 * H:])
+        c2 = f * c + i * g
+        h2 = o * jnp.tanh(c2)
+        return (h2, c2), h2
+
+    (h, c), out = lax.scan(step, (h0, c0), jnp.swapaxes(xz, 0, 1))
+    return jnp.swapaxes(out, 0, 1), h, c
+
+
+register_alias("lstmBlock", "lstm")
+
+
+@op("gruCell", "recurrent")
+def gru_cell(x, h_prev, Wru, Wc, bru, bc):
+    """GRU step (ref: gruCell). Wru: [C+H, 2H] reset/update; Wc: [C+H, H]."""
+    xh = jnp.concatenate([x, h_prev], axis=-1)
+    ru = jax.nn.sigmoid(xh @ Wru + bru)
+    H = h_prev.shape[-1]
+    r, u = ru[..., :H], ru[..., H:]
+    c = jnp.tanh(jnp.concatenate([x, r * h_prev], axis=-1) @ Wc + bc)
+    return u * h_prev + (1 - u) * c
+
+
+@op("gru", "recurrent")
+def gru_seq(x, h0, Wru, Wc, bru, bc):
+    def step(h, x_t):
+        h2 = gru_cell(x_t, h, Wru, Wc, bru, bc)
+        return h2, h2
+
+    h, out = lax.scan(step, h0, jnp.swapaxes(x, 0, 1))
+    return jnp.swapaxes(out, 0, 1), h
+
+
+@op("sruCell", "recurrent")
+def sru_cell(x, c_prev, W, b):
+    """Simple Recurrent Unit step (ref: sruCell; Lei et al. 2017).
+    W: [C, 3C] -> (xt', forget gate, reset gate)."""
+    C = x.shape[-1]
+    z = x @ W
+    xt = z[..., :C]
+    f = jax.nn.sigmoid(z[..., C:2 * C] + b[..., :C])
+    r = jax.nn.sigmoid(z[..., 2 * C:] + b[..., C:])
+    c = f * c_prev + (1 - f) * xt
+    h = r * jnp.tanh(c) + (1 - r) * x
+    return h, c
+
+
+@op("sru", "recurrent")
+def sru_seq(x, c0, W, b):
+    z = jnp.einsum("btc,cf->btf", x, W)
+
+    def step(c, inp):
+        x_t, z_t = inp
+        C = x_t.shape[-1]
+        xt = z_t[..., :C]
+        f = jax.nn.sigmoid(z_t[..., C:2 * C] + b[..., :C])
+        r = jax.nn.sigmoid(z_t[..., 2 * C:] + b[..., C:])
+        c2 = f * c + (1 - f) * xt
+        h = r * jnp.tanh(c2) + (1 - r) * x_t
+        return c2, h
+
+    c, out = lax.scan(step, c0, (jnp.swapaxes(x, 0, 1), jnp.swapaxes(z, 0, 1)))
+    return jnp.swapaxes(out, 0, 1), c
+
+
+@op("sru_bi", "recurrent")
+def sru_bi(x, c0_fwd, c0_bwd, W, b):
+    out_f, cf = sru_seq(x, c0_fwd, W, b)
+    out_b, cb = sru_seq(jnp.flip(x, 1), c0_bwd, W, b)
+    return jnp.concatenate([out_f, jnp.flip(out_b, 1)], axis=-1), cf, cb
+
+
+@op("static_rnn", "recurrent")
+def static_rnn(x, h0, W, U, b):
+    def step(h, x_t):
+        h2 = jnp.tanh(x_t @ W + h @ U + b)
+        return h2, h2
+
+    h, out = lax.scan(step, h0, jnp.swapaxes(x, 0, 1))
+    return jnp.swapaxes(out, 0, 1), h
+
+
+register_alias("dynamic_rnn", "static_rnn")
+
+
+@op("static_bidirectional_rnn", "recurrent")
+def static_birnn(x, h0f, h0b, Wf, Uf, bf, Wb, Ub, bb):
+    out_f, hf = static_rnn(x, h0f, Wf, Uf, bf)
+    out_b, hb = static_rnn(jnp.flip(x, 1), h0b, Wb, Ub, bb)
+    return jnp.concatenate([out_f, jnp.flip(out_b, 1)], axis=-1), hf, hb
+
+
+register_alias("dynamic_bidirectional_rnn", "static_bidirectional_rnn")
+
+
+# ===========================================================================
+# random.h
+# ===========================================================================
+
+op("randomuniform", "random", differentiable=False)(
+    lambda rng, shape, minval=0.0, maxval=1.0: jax.random.uniform(
+        rng, tuple(int(s) for s in shape), minval=minval, maxval=maxval))
+op("random_normal", "random", differentiable=False)(
+    lambda rng, shape, mean=0.0, stdev=1.0: mean + stdev * jax.random.normal(
+        rng, tuple(int(s) for s in shape)))
+op("random_bernoulli", "random", differentiable=False)(
+    lambda rng, shape, prob=0.5: jax.random.bernoulli(
+        rng, prob, tuple(int(s) for s in shape)))
+op("random_exponential", "random", differentiable=False)(
+    lambda rng, shape, lam=1.0: jax.random.exponential(
+        rng, tuple(int(s) for s in shape)) / lam)
+op("random_shuffle", "random", differentiable=False)(
+    lambda rng, x: jax.random.permutation(rng, x, axis=0))
+
+
+@op("random_crop", "random", differentiable=False)
+def _random_crop(rng, x, size):
+    size = tuple(int(s) for s in size)
+    starts = [jax.random.randint(k, (), 0, d - s + 1)
+              for k, d, s in zip(jax.random.split(rng, len(size)),
+                                 x.shape, size)]
+    return lax.dynamic_slice(x, starts, size)
+
+
+_SEED = {"seed": 0}
+op("get_seed", "random", differentiable=False)(lambda: _SEED["seed"])
+
+
+@op("set_seed", "random", differentiable=False)
+def _set_seed(s):
+    _SEED["seed"] = int(s)
+
+
+# ===========================================================================
+# datatypes.h
+# ===========================================================================
+
+op("cast", "datatypes", differentiable=False)(lambda x, dtype: x.astype(dtype))
+for _n, _t in {"to_double": jnp.float64, "to_float16": jnp.float16,
+               "to_float32": jnp.float32, "to_int32": jnp.int32,
+               "to_int64": jnp.int64, "to_uint32": jnp.uint32,
+               "to_uint64": jnp.uint64}.items():
+    op(_n, "datatypes", differentiable=False)(partial(
+        lambda t, x: x.astype(t), _t))
+
+
+# ===========================================================================
+# list.h — TensorArray / TensorList ops (ref: NDArrayList + list/*.cpp).
+# Functional: every op returns a NEW TensorList (XLA-friendly immutability).
+# ===========================================================================
+
+class TensorList:
+    """Immutable tensor list (ref: `include/ops/declarable/generic/list/`)."""
+
+    def __init__(self, arrays=()):
+        self.arrays = tuple(arrays)
+
+    def __len__(self):
+        return len(self.arrays)
+
+
+op("create_list", "list", differentiable=False)(lambda *a, **kw: TensorList())
+op("size_list", "list", differentiable=False)(lambda tl: len(tl))
+op("read_list", "list", differentiable=False)(lambda tl, i: tl.arrays[int(i)])
+op("clone_list", "list", differentiable=False)(
+    lambda tl: TensorList(tl.arrays))
+op("gather_list", "list", differentiable=False)(
+    lambda tl, indices: jnp.stack([tl.arrays[int(i)] for i in np.asarray(indices)]))
+op("stack_list", "list", differentiable=False)(
+    lambda tl: jnp.stack(tl.arrays))
+op("pick_list", "list", differentiable=False)(
+    lambda tl, indices: jnp.concatenate(
+        [tl.arrays[int(i)] for i in np.asarray(indices)]))
+
+
+@op("write_list", "list", differentiable=False)
+def _write_list(tl, i, value):
+    arrays = list(tl.arrays)
+    i = int(i)
+    while len(arrays) <= i:
+        arrays.append(None)
+    arrays[i] = value
+    return TensorList(arrays)
+
+
+@op("scatter_list", "list", differentiable=False)
+def _scatter_list(tl, indices, values):
+    arrays = list(tl.arrays)
+    for i, v in zip(np.asarray(indices), values):
+        while len(arrays) <= int(i):
+            arrays.append(None)
+        arrays[int(i)] = v
+    return TensorList(arrays)
+
+
+op("split_list", "list", differentiable=False)(
+    lambda tl, x, sizes: TensorList(jnp.split(
+        x, np.cumsum(np.asarray(sizes))[:-1].tolist())))
+op("unstack_list", "list", differentiable=False)(
+    lambda tl, x, axis=0: TensorList(
+        [jnp.squeeze(s, axis) for s in jnp.split(x, x.shape[axis], axis)]))
+op("tear", "list", differentiable=False)(
+    lambda x, axis=0: TensorList(
+        [jnp.squeeze(s, axis) for s in jnp.split(x, x.shape[axis], axis)]))
+
+
+# ===========================================================================
+# nlp.h — skipgram/cbow inference kernels (training loop lives in
+# deeplearning4j_tpu.nlp; these are the op-catalog entry points)
+# ===========================================================================
+
+@op("skipgram", "nlp")
+def skipgram_step(syn0, syn1neg, center_idx, target_idx, labels, lr):
+    """One negative-sampling skip-gram update (ref: skipgram op /
+    `parameterserver/.../SkipGramTrainer.java`). Returns updated
+    (syn0, syn1neg). labels: 1 for the true context word, 0 for negatives."""
+    syn0, syn1neg = jnp.asarray(syn0), jnp.asarray(syn1neg)
+    h = syn0[center_idx]                       # [B, D]
+    ctx = syn1neg[target_idx]                  # [B, K, D]
+    score = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, ctx))
+    g = (labels - score) * lr                  # [B, K]
+    dh = jnp.einsum("bk,bkd->bd", g, ctx)
+    dctx = jnp.einsum("bk,bd->bkd", g, h)
+    syn0 = syn0.at[center_idx].add(dh)
+    syn1neg = syn1neg.at[target_idx].add(dctx)
+    return syn0, syn1neg
+
+
+@op("cbow", "nlp")
+def cbow_step(syn0, syn1neg, context_idx, context_mask, target_idx, labels, lr):
+    """One CBOW update: mean of context vectors vs target (ref: cbow op)."""
+    syn0, syn1neg = jnp.asarray(syn0), jnp.asarray(syn1neg)
+    ctx_vecs = syn0[context_idx]               # [B, W, D]
+    m = context_mask[..., None]
+    h = jnp.sum(ctx_vecs * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
+    tgt = syn1neg[target_idx]                  # [B, K, D]
+    score = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, tgt))
+    g = (labels - score) * lr
+    dh = jnp.einsum("bk,bkd->bd", g, tgt)
+    dtgt = jnp.einsum("bk,bd->bkd", g, h)
+    counts = jnp.maximum(jnp.sum(context_mask, axis=1, keepdims=True), 1.0)
+    syn0 = syn0.at[context_idx].add(
+        (dh[:, None, :] / counts[..., None]) * m)
+    syn1neg = syn1neg.at[target_idx].add(dtgt)
+    return syn0, syn1neg
+
+
+# ===========================================================================
+# misc parity ops
+# ===========================================================================
+
+@op("non_max_suppression", "parity_ops", differentiable=False)
+def _nms_op(boxes, scores, max_output_size, iou_threshold=0.5):
+    from ..nn.layers.objdetect import non_max_suppression as _nms
+    b = np.asarray(boxes)
+    # convert corner boxes [y1,x1,y2,x2] to xywh
+    xywh = np.stack([(b[:, 1] + b[:, 3]) / 2, (b[:, 0] + b[:, 2]) / 2,
+                     b[:, 3] - b[:, 1], b[:, 2] - b[:, 0]], axis=1)
+    kept, _ = _nms(xywh, np.asarray(scores), iou_threshold, -np.inf)
+    idx = []
+    for k in kept[:int(max_output_size)]:
+        for i in range(len(xywh)):
+            if np.allclose(xywh[i], k):
+                idx.append(i)
+                break
+    return jnp.asarray(idx, jnp.int32)

@@ -1,0 +1,121 @@
+"""OpValidation — per-op correctness harness with coverage accounting.
+
+Ref: `nd4j-api/.../autodiff/validation/OpValidation.java:112` (+TestCase,
+OpTestCase, GradCheckUtil): declarative per-op checks for forward outputs,
+numeric gradients, and shape functions, PLUS coverage accounting — the
+harness records which registered ops have been exercised and can report
+the ones that lack tests (`OpValidation.java:92-110`).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import REGISTRY, get
+
+_EXERCISED: Set[str] = set()
+
+
+@dataclass
+class OpTestCase:
+    """One op validation case (ref: OpTestCase.java)."""
+
+    name: str
+    args: tuple
+    kwargs: dict = field(default_factory=dict)
+    expected: Any = None            # expected forward output(s)
+    expected_shape: Optional[tuple] = None
+    grad_check: bool = False        # numeric gradient vs autodiff
+    grad_argnums: Sequence[int] = (0,)
+    rtol: float = 1e-4
+    atol: float = 1e-5
+
+
+def validate(case: OpTestCase) -> List[str]:
+    """Run one case; returns a list of failure messages (empty = pass)."""
+    failures: List[str] = []
+    o = get(case.name)
+    _EXERCISED.add(case.name)
+    out = o.fn(*case.args, **case.kwargs)
+
+    if case.expected is not None:
+        exp = case.expected
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        exps = exp if isinstance(exp, (tuple, list)) else (exp,)
+        for i, (a, e) in enumerate(zip(outs, exps)):
+            if not np.allclose(np.asarray(a), np.asarray(e),
+                               rtol=case.rtol, atol=case.atol):
+                failures.append(
+                    f"{case.name}: forward output {i} mismatch: "
+                    f"{np.asarray(a).ravel()[:5]} vs {np.asarray(e).ravel()[:5]}")
+
+    if case.expected_shape is not None:
+        got = tuple(np.asarray(out).shape)
+        if got != tuple(case.expected_shape):
+            failures.append(f"{case.name}: shape {got} != "
+                            f"{tuple(case.expected_shape)}")
+
+    if case.grad_check:
+        failures.extend(_grad_check(o, case))
+    return failures
+
+
+def _grad_check(o, case: OpTestCase, eps=1e-2, tol=2e-2) -> List[str]:
+    """Central-difference gradient check (ref: GradCheckUtil.java)."""
+    failures = []
+
+    def scalar_loss(*xs):
+        args = list(case.args)
+        for an, x in zip(case.grad_argnums, xs):
+            args[an] = x
+        out = o.fn(*args, **case.kwargs)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        return jnp.sum(jnp.square(out))
+
+    diff_args = [jnp.asarray(case.args[an], jnp.float32)
+                 for an in case.grad_argnums]
+    grads = jax.grad(scalar_loss, argnums=tuple(range(len(diff_args))))(
+        *diff_args)
+    for gi, (an, g) in enumerate(zip(case.grad_argnums, grads)):
+        base = np.array(diff_args[gi], np.float32)
+        flat = base.ravel()
+        rng = np.random.default_rng(0)
+        for idx in rng.choice(flat.size, size=min(4, flat.size),
+                              replace=False):
+            pert = [np.array(d, np.float32) for d in diff_args]
+            pert[gi].ravel()[idx] += eps
+            up = float(scalar_loss(*[jnp.asarray(p) for p in pert]))
+            pert[gi].ravel()[idx] -= 2 * eps
+            dn = float(scalar_loss(*[jnp.asarray(p) for p in pert]))
+            num = (up - dn) / (2 * eps)
+            ana = float(np.asarray(g).ravel()[idx])
+            if abs(num - ana) > tol * max(1.0, abs(num)):
+                failures.append(
+                    f"{case.name}: grad arg{an}[{idx}] numeric {num:.5f} "
+                    f"vs autodiff {ana:.5f}")
+    return failures
+
+
+def coverage_report(include_bp: bool = False) -> Dict[str, Any]:
+    """Which registered ops have validation cases (ref:
+    OpValidation coverage logging)."""
+    names = {n for n in REGISTRY
+             if include_bp or not n.endswith("_bp")}
+    tested = _EXERCISED & names
+    untested = sorted(names - _EXERCISED)
+    return {
+        "registered": len(names),
+        "tested": len(tested),
+        "coverage": len(tested) / max(len(names), 1),
+        "untested": untested,
+    }
+
+
+def mark_exercised(*names: str):
+    """Record out-of-band coverage (ops exercised via layer/model tests)."""
+    _EXERCISED.update(names)

@@ -1,0 +1,96 @@
+"""Model zoo — the 16 reference architectures, TPU-native (NHWC, bf16-ready).
+
+Ref: `deeplearning4j-zoo/src/main/java/org/deeplearning4j/zoo/model/*.java`
+(AlexNet, Darknet19, FaceNetNN4Small2, InceptionResNetV1, LeNet, NASNet,
+ResNet50, SimpleCNN, SqueezeNet, TextGenerationLSTM, TinyYOLO, UNet, VGG16,
+VGG19, Xception, YOLO2) and `zoo/ZooModel.java` (initPretrained + checksum
+download).
+
+These are standard public architectures; each `*.init()` returns a ready
+`MultiLayerNetwork` or `ComputationGraph`. Pretrained weights: the
+reference downloads from a CDN; this build has no egress, so
+`init_pretrained()` loads from a local `~/.deeplearning4j_tpu/zoo/*.npz`
+cache when present (same cache-or-fail contract as `ZooModel.java`'s
+checksum path).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class ZooModel:
+    """Base zoo model. Ref: `zoo/ZooModel.java`."""
+
+    name = "zoo"
+
+    def __init__(self, num_classes: int = 1000, seed: int = 1234,
+                 input_shape: Optional[Tuple[int, ...]] = None,
+                 updater=None):
+        self.num_classes = int(num_classes)
+        self.seed = int(seed)
+        if input_shape is not None:
+            self.input_shape = tuple(input_shape)
+        self.updater = updater
+
+    def init(self):
+        """Build + initialize the network."""
+        raise NotImplementedError
+
+    def pretrained_cache_path(self) -> str:
+        return os.path.expanduser(
+            f"~/.deeplearning4j_tpu/zoo/{self.name}.npz")
+
+    def init_pretrained(self):
+        """Load pretrained params from the local cache (ref:
+        ZooModel.initPretrained — download+checksum; here: local file)."""
+        path = self.pretrained_cache_path()
+        model = self.init()
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"no pretrained weights cached at {path}; this environment "
+                "has no network egress (reference downloads from CDN)")
+        blob = np.load(path, allow_pickle=False)
+        params = model.params()
+        flat = _flatten("", params)
+        for key, arr in flat.items():
+            if key in blob and blob[key].shape == arr.shape:
+                _assign(params, key, jnp.asarray(blob[key]))
+        model.set_params(params)
+        return model
+
+    def _updater(self):
+        from ..learning import Adam
+        return self.updater if self.updater is not None else Adam(1e-3)
+
+
+def _flatten(prefix, tree):
+    out = {}
+    for k, v in tree.items():
+        key = f"{prefix}/{k}" if prefix else k
+        if isinstance(v, dict):
+            out.update(_flatten(key, v))
+        else:
+            out[key] = v
+    return out
+
+
+def _assign(tree, path, value):
+    parts = path.split("/")
+    for p in parts[:-1]:
+        tree = tree[p]
+    tree[parts[-1]] = value
+
+
+from .simple import (AlexNet, Darknet19, LeNet, SimpleCNN,  # noqa: E402,F401
+                     TextGenerationLSTM, TinyYOLO, VGG16, VGG19, YOLO2)
+from .resnet import ResNet50  # noqa: E402,F401
+from .inception import FaceNetNN4Small2, InceptionResNetV1  # noqa: E402,F401
+from .advanced import NASNet, SqueezeNet, UNet, Xception  # noqa: E402,F401
+
+ALL_MODELS = (AlexNet, Darknet19, FaceNetNN4Small2, InceptionResNetV1, LeNet,
+              NASNet, ResNet50, SimpleCNN, SqueezeNet, TextGenerationLSTM,
+              TinyYOLO, UNet, VGG16, VGG19, Xception, YOLO2)

@@ -1,0 +1,319 @@
+"""SqueezeNet, UNet, Xception, NASNet. Ref: `zoo/model/{SqueezeNet,UNet,
+Xception,NASNet}.java` (+ `zoo/model/helper/NASNetHelper.java`)."""
+from __future__ import annotations
+
+from ..nn import NeuralNetConfiguration
+from ..nn.conf import InputType
+from ..nn.graph import ComputationGraph, ElementWiseVertex, MergeVertex
+from ..nn.layers import (ActivationLayer, BatchNormalization, ConvolutionLayer,
+                         DropoutLayer, GlobalPoolingLayer, OutputLayer,
+                         SubsamplingLayer)
+from ..nn.layers.convolutional import (Cropping2D, Deconvolution2D,
+                                       SeparableConvolution2D)
+from . import ZooModel
+
+
+class SqueezeNet(ZooModel):
+    """SqueezeNet v1.1: fire modules (squeeze 1x1 -> expand 1x1 + 3x3).
+    Ref: `zoo/model/SqueezeNet.java`."""
+
+    name = "squeezenet"
+    input_shape = (227, 227, 3)
+
+    def __init__(self, num_classes: int = 1000, **kw):
+        super().__init__(num_classes=num_classes, **kw)
+
+    def init(self):
+        h, w, c = self.input_shape
+        g = (NeuralNetConfiguration.builder().seed(self.seed)
+             .updater(self._updater()).weight_init("relu")
+             .graph_builder()
+             .add_inputs("in")
+             .set_input_types(InputType.convolutional(h, w, c)))
+
+        def fire(name, inp, squeeze, expand):
+            g.add_layer(f"{name}_sq", ConvolutionLayer(
+                n_out=squeeze, kernel=(1, 1), activation="relu"), inp)
+            g.add_layer(f"{name}_e1", ConvolutionLayer(
+                n_out=expand, kernel=(1, 1), activation="relu"), f"{name}_sq")
+            g.add_layer(f"{name}_e3", ConvolutionLayer(
+                n_out=expand, kernel=(3, 3), activation="relu"), f"{name}_sq")
+            g.add_vertex(name, MergeVertex(), f"{name}_e1", f"{name}_e3")
+            return name
+
+        g.add_layer("c1", ConvolutionLayer(n_out=64, kernel=(3, 3),
+                                           stride=(2, 2), padding="valid",
+                                           activation="relu"), "in")
+        g.add_layer("p1", SubsamplingLayer(kernel=(3, 3), stride=(2, 2)), "c1")
+        x = fire("f2", "p1", 16, 64)
+        x = fire("f3", x, 16, 64)
+        g.add_layer("p3", SubsamplingLayer(kernel=(3, 3), stride=(2, 2)), x)
+        x = fire("f4", "p3", 32, 128)
+        x = fire("f5", x, 32, 128)
+        g.add_layer("p5", SubsamplingLayer(kernel=(3, 3), stride=(2, 2)), x)
+        x = fire("f6", "p5", 48, 192)
+        x = fire("f7", x, 48, 192)
+        x = fire("f8", x, 64, 256)
+        x = fire("f9", x, 64, 256)
+        g.add_layer("drop", DropoutLayer(dropout=0.5), x)
+        g.add_layer("c10", ConvolutionLayer(n_out=self.num_classes,
+                                            kernel=(1, 1), activation="relu"),
+                    "drop")
+        g.add_layer("avgpool", GlobalPoolingLayer(pooling="avg"), "c10")
+        from ..nn.layers import LossLayer
+        g.add_layer("out", LossLayer(loss="mcxent", activation="softmax"),
+                    "avgpool")
+        g.set_outputs("out")
+        return ComputationGraph(g.build()).init()
+
+
+class UNet(ZooModel):
+    """U-Net encoder/decoder with skip concats.
+    Ref: `zoo/model/UNet.java` (512x512x3, sigmoid 1-channel output)."""
+
+    name = "unet"
+    input_shape = (512, 512, 3)
+
+    def __init__(self, num_classes: int = 1, **kw):
+        super().__init__(num_classes=num_classes, **kw)
+
+    def init(self):
+        h, w, c = self.input_shape
+        g = (NeuralNetConfiguration.builder().seed(self.seed)
+             .updater(self._updater()).weight_init("relu")
+             .graph_builder()
+             .add_inputs("in")
+             .set_input_types(InputType.convolutional(h, w, c)))
+
+        def double_conv(name, inp, n_out):
+            g.add_layer(f"{name}_1", ConvolutionLayer(
+                n_out=n_out, kernel=(3, 3), activation="relu"), inp)
+            g.add_layer(f"{name}_2", ConvolutionLayer(
+                n_out=n_out, kernel=(3, 3), activation="relu"), f"{name}_1")
+            return f"{name}_2"
+
+        enc1 = double_conv("e1", "in", 64)
+        g.add_layer("p1", SubsamplingLayer(kernel=(2, 2), stride=(2, 2)), enc1)
+        enc2 = double_conv("e2", "p1", 128)
+        g.add_layer("p2", SubsamplingLayer(kernel=(2, 2), stride=(2, 2)), enc2)
+        enc3 = double_conv("e3", "p2", 256)
+        g.add_layer("p3", SubsamplingLayer(kernel=(2, 2), stride=(2, 2)), enc3)
+        enc4 = double_conv("e4", "p3", 512)
+        g.add_layer("drop4", DropoutLayer(dropout=0.5), enc4)
+        g.add_layer("p4", SubsamplingLayer(kernel=(2, 2), stride=(2, 2)),
+                    "drop4")
+        mid = double_conv("mid", "p4", 1024)
+        g.add_layer("dropmid", DropoutLayer(dropout=0.5), mid)
+
+        def up_block(name, inp, skip, n_out):
+            g.add_layer(f"{name}_up", Deconvolution2D(
+                n_out=n_out, kernel=(2, 2), stride=(2, 2),
+                activation="relu"), inp)
+            g.add_vertex(f"{name}_cat", MergeVertex(), skip, f"{name}_up")
+            return double_conv(name, f"{name}_cat", n_out)
+
+        d4 = up_block("d4", "dropmid", "drop4", 512)
+        d3 = up_block("d3", d4, enc3, 256)
+        d2 = up_block("d2", d3, enc2, 128)
+        d1 = up_block("d1", d2, enc1, 64)
+        g.add_layer("penult", ConvolutionLayer(n_out=2, kernel=(3, 3),
+                                               activation="relu"), d1)
+        from ..nn.layers import LossLayer
+        g.add_layer("pred", ConvolutionLayer(n_out=self.num_classes,
+                                             kernel=(1, 1),
+                                             activation="sigmoid"), "penult")
+        g.add_layer("out", LossLayer(loss="xent", activation="identity"),
+                    "pred")
+        g.set_outputs("out")
+        return ComputationGraph(g.build()).init()
+
+
+class Xception(ZooModel):
+    """Xception: depthwise-separable conv stacks with residual connections.
+    Ref: `zoo/model/Xception.java`."""
+
+    name = "xception"
+    input_shape = (299, 299, 3)
+
+    def __init__(self, num_classes: int = 1000, **kw):
+        super().__init__(num_classes=num_classes, **kw)
+
+    def init(self):
+        h, w, c = self.input_shape
+        g = (NeuralNetConfiguration.builder().seed(self.seed)
+             .updater(self._updater()).weight_init("relu")
+             .graph_builder()
+             .add_inputs("in")
+             .set_input_types(InputType.convolutional(h, w, c)))
+
+        def conv_bn(name, inp, n_out, kernel, stride=(1, 1), act="relu",
+                    padding="same"):
+            g.add_layer(f"{name}_c", ConvolutionLayer(
+                n_out=n_out, kernel=kernel, stride=stride, padding=padding,
+                has_bias=False, activation="identity"), inp)
+            g.add_layer(name, BatchNormalization(activation=act), f"{name}_c")
+            return name
+
+        def sep_bn(name, inp, n_out, act="relu"):
+            g.add_layer(f"{name}_s", SeparableConvolution2D(
+                n_out=n_out, kernel=(3, 3), has_bias=False,
+                activation="identity"), inp)
+            g.add_layer(name, BatchNormalization(activation=act), f"{name}_s")
+            return name
+
+        x = conv_bn("b1c1", "in", 32, (3, 3), (2, 2), padding="valid")
+        x = conv_bn("b1c2", x, 64, (3, 3), padding="valid")
+
+        def xception_block(name, inp, n_out, first_act=True):
+            sc = conv_bn(f"{name}_sc", inp, n_out, (1, 1), (2, 2),
+                         act="identity")
+            y = inp
+            if first_act:
+                g.add_layer(f"{name}_preact", ActivationLayer(
+                    activation="relu"), y)
+                y = f"{name}_preact"
+            y = sep_bn(f"{name}_s1", y, n_out)
+            y = sep_bn(f"{name}_s2", y, n_out, act="identity")
+            g.add_layer(f"{name}_pool", SubsamplingLayer(
+                kernel=(3, 3), stride=(2, 2), padding="same"), y)
+            g.add_vertex(name, ElementWiseVertex("add"), f"{name}_pool", sc)
+            return name
+
+        x = xception_block("b2", x, 128, first_act=False)
+        x = xception_block("b3", x, 256)
+        x = xception_block("b4", x, 728)
+        # middle flow: 8 identical residual blocks
+        for i in range(8):
+            name = f"m{i}"
+            g.add_layer(f"{name}_a1", ActivationLayer(activation="relu"), x)
+            y = sep_bn(f"{name}_s1", f"{name}_a1", 728)
+            y = sep_bn(f"{name}_s2", y, 728)
+            y = sep_bn(f"{name}_s3", y, 728, act="identity")
+            g.add_vertex(name, ElementWiseVertex("add"), y, x)
+            x = name
+        # exit flow
+        sc = conv_bn("exit_sc", x, 1024, (1, 1), (2, 2), act="identity")
+        g.add_layer("exit_a", ActivationLayer(activation="relu"), x)
+        y = sep_bn("exit_s1", "exit_a", 728)
+        y = sep_bn("exit_s2", y, 1024, act="identity")
+        g.add_layer("exit_pool", SubsamplingLayer(kernel=(3, 3), stride=(2, 2),
+                                                  padding="same"), y)
+        g.add_vertex("exit_add", ElementWiseVertex("add"), "exit_pool", sc)
+        y = sep_bn("exit_s3", "exit_add", 1536)
+        y = sep_bn("exit_s4", y, 2048)
+        g.add_layer("avgpool", GlobalPoolingLayer(pooling="avg"), y)
+        g.add_layer("out", OutputLayer(n_out=self.num_classes, loss="mcxent"),
+                    "avgpool")
+        g.set_outputs("out")
+        return ComputationGraph(g.build()).init()
+
+
+class NASNet(ZooModel):
+    """NASNet-A mobile: stem + stacked normal/reduction cells built from
+    separable convs. Ref: `zoo/model/NASNet.java` +
+    `zoo/model/helper/NASNetHelper.java` (sepConvBlock/adjustBlock/
+    normalA/reductionA)."""
+
+    name = "nasnet"
+    input_shape = (224, 224, 3)
+
+    def __init__(self, num_classes: int = 1000, penultimate_filters: int = 1056,
+                 n_cells: int = 4, **kw):
+        super().__init__(num_classes=num_classes, **kw)
+        self.penultimate_filters = int(penultimate_filters)
+        self.n_cells = int(n_cells)  # cells per stack (ref mobile: 4)
+
+    def init(self):
+        h, w, c = self.input_shape
+        filters = self.penultimate_filters // 24
+        g = (NeuralNetConfiguration.builder().seed(self.seed)
+             .updater(self._updater()).weight_init("relu")
+             .graph_builder()
+             .add_inputs("in")
+             .set_input_types(InputType.convolutional(h, w, c)))
+
+        def sep_block(name, inp, n_out, kernel=(3, 3), stride=(1, 1)):
+            g.add_layer(f"{name}_a", ActivationLayer(activation="relu"), inp)
+            g.add_layer(f"{name}_s1", SeparableConvolution2D(
+                n_out=n_out, kernel=kernel, stride=stride, has_bias=False,
+                activation="identity"), f"{name}_a")
+            g.add_layer(f"{name}_b1", BatchNormalization(activation="relu"),
+                        f"{name}_s1")
+            g.add_layer(f"{name}_s2", SeparableConvolution2D(
+                n_out=n_out, kernel=kernel, has_bias=False,
+                activation="identity"), f"{name}_b1")
+            g.add_layer(name, BatchNormalization(activation="identity"),
+                        f"{name}_s2")
+            return name
+
+        def fit_channels(name, inp, n_out, stride=(1, 1)):
+            """1x1 conv to align channels (NASNetHelper.adjustBlock role)."""
+            g.add_layer(f"{name}_a", ActivationLayer(activation="relu"), inp)
+            g.add_layer(f"{name}_c", ConvolutionLayer(
+                n_out=n_out, kernel=(1, 1), stride=stride, has_bias=False,
+                activation="identity"), f"{name}_a")
+            g.add_layer(name, BatchNormalization(activation="identity"),
+                        f"{name}_c")
+            return name
+
+        def normal_cell(name, x, prev, f, adjust_stride=(1, 1)):
+            # adjust_stride=(2,2) when prev comes from before a reduction
+            # cell (NASNetHelper.adjustBlock's factorized-reduction role)
+            p = fit_channels(f"{name}_adj", prev, f, stride=adjust_stride)
+            hx = fit_channels(f"{name}_h", x, f)
+            b1 = sep_block(f"{name}_b1", hx, f, (5, 5))
+            g.add_vertex(f"{name}_a1", ElementWiseVertex("add"), b1, hx)
+            b2 = sep_block(f"{name}_b2a", p, f, (5, 5))
+            b2b = sep_block(f"{name}_b2b", hx, f, (3, 3))
+            g.add_vertex(f"{name}_a2", ElementWiseVertex("add"), b2, b2b)
+            g.add_layer(f"{name}_pool", SubsamplingLayer(
+                kernel=(3, 3), stride=(1, 1), padding="same", pooling="avg"),
+                hx)
+            g.add_vertex(f"{name}_a3", ElementWiseVertex("add"),
+                         f"{name}_pool", p)
+            g.add_vertex(name, MergeVertex(), f"{name}_a1", f"{name}_a2",
+                         f"{name}_a3")
+            return name, x
+
+        def reduction_cell(name, x, prev, f, adjust_stride=(1, 1)):
+            p = fit_channels(f"{name}_adj", prev, f,
+                             stride=tuple(2 * s for s in adjust_stride))
+            hx = fit_channels(f"{name}_h", x, f)
+            b1 = sep_block(f"{name}_b1", hx, f, (5, 5), (2, 2))
+            b2 = sep_block(f"{name}_b2", hx, f, (7, 7), (2, 2))
+            g.add_layer(f"{name}_pool", SubsamplingLayer(
+                kernel=(3, 3), stride=(2, 2), padding="same"), hx)
+            g.add_vertex(f"{name}_a1", ElementWiseVertex("add"), b1, b2)
+            g.add_vertex(f"{name}_a2", ElementWiseVertex("add"),
+                         f"{name}_pool", p)
+            g.add_vertex(name, MergeVertex(), f"{name}_a1", f"{name}_a2")
+            return name, x
+
+        # stem: 3x3/2 conv
+        g.add_layer("stem_c", ConvolutionLayer(
+            n_out=32, kernel=(3, 3), stride=(2, 2), has_bias=False,
+            padding="valid", activation="identity"), "in")
+        g.add_layer("stem", BatchNormalization(activation="identity"), "stem_c")
+        # `prev` lags `x` by one cell; after a reduction the lagging tensor
+        # is spatially 2x, so the next cell adjusts it with stride 2.
+        x, prev = "stem", "stem"
+        x, prev = reduction_cell("stem_r1", x, prev, filters // 4)
+        x, prev = reduction_cell("stem_r2", x, prev, filters // 2,
+                                 adjust_stride=(2, 2))
+        for i in range(self.n_cells):
+            x, prev = normal_cell(f"n1_{i}", x, prev, filters,
+                                  adjust_stride=(2, 2) if i == 0 else (1, 1))
+        x, prev = reduction_cell("r1", x, prev, filters * 2)
+        for i in range(self.n_cells):
+            x, prev = normal_cell(f"n2_{i}", x, prev, filters * 2,
+                                  adjust_stride=(2, 2) if i == 0 else (1, 1))
+        x, prev = reduction_cell("r2", x, prev, filters * 4)
+        for i in range(self.n_cells):
+            x, prev = normal_cell(f"n3_{i}", x, prev, filters * 4,
+                                  adjust_stride=(2, 2) if i == 0 else (1, 1))
+        g.add_layer("final_act", ActivationLayer(activation="relu"), x)
+        g.add_layer("avgpool", GlobalPoolingLayer(pooling="avg"), "final_act")
+        g.add_layer("out", OutputLayer(n_out=self.num_classes, loss="mcxent"),
+                    "avgpool")
+        g.set_outputs("out")
+        return ComputationGraph(g.build()).init()

@@ -1,0 +1,323 @@
+"""Sequential zoo models. Ref: `deeplearning4j-zoo/.../model/{LeNet,SimpleCNN,
+AlexNet,VGG16,VGG19,Darknet19,TinyYOLO,YOLO2,TextGenerationLSTM}.java`."""
+from __future__ import annotations
+
+from ..learning import Adam, Nesterovs
+from ..nn import MultiLayerNetwork, NeuralNetConfiguration
+from ..nn.layers import (LSTM, ActivationLayer, BatchNormalization,
+                         ConvolutionLayer, DenseLayer, DropoutLayer,
+                         GlobalPoolingLayer, LocalResponseNormalization,
+                         OutputLayer, RnnOutputLayer, SubsamplingLayer,
+                         ZeroPaddingLayer)
+from ..nn.layers.objdetect import Yolo2OutputLayer
+from . import ZooModel
+
+
+class LeNet(ZooModel):
+    """Ref: `zoo/model/LeNet.java` (28x28x1, conv5-20/pool/conv5-50/pool/
+    dense500/softmax10)."""
+
+    name = "lenet"
+    input_shape = (28, 28, 1)
+
+    def __init__(self, num_classes: int = 10, **kw):
+        super().__init__(num_classes=num_classes, **kw)
+
+    def init(self):
+        h, w, c = self.input_shape
+        conf = (NeuralNetConfiguration.builder().seed(self.seed)
+                .updater(self._updater()).weight_init("xavier")
+                .list()
+                .layer(ConvolutionLayer(n_out=20, kernel=(5, 5), stride=(1, 1),
+                                        padding="same", activation="identity"))
+                .layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=50, kernel=(5, 5), stride=(1, 1),
+                                        padding="same", activation="identity"))
+                .layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+                .layer(DenseLayer(n_out=500, activation="relu"))
+                .layer(OutputLayer(n_out=self.num_classes, loss="mcxent"))
+                .input_type_convolutional(h, w, c).build())
+        return MultiLayerNetwork(conf).init()
+
+
+class SimpleCNN(ZooModel):
+    """Ref: `zoo/model/SimpleCNN.java` (48x48x3 4-block CNN)."""
+
+    name = "simplecnn"
+    input_shape = (48, 48, 3)
+
+    def __init__(self, num_classes: int = 10, **kw):
+        super().__init__(num_classes=num_classes, **kw)
+
+    def init(self):
+        h, w, c = self.input_shape
+        b = (NeuralNetConfiguration.builder().seed(self.seed)
+             .updater(self._updater()).weight_init("relu")
+             .activation("relu")
+             .list())
+        for n_out, pool in ((16, False), (16, True), (32, False), (32, True),
+                            (64, False), (64, True)):
+            b.layer(ConvolutionLayer(n_out=n_out, kernel=(3, 3)))
+            b.layer(BatchNormalization())
+            if pool:
+                b.layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+        b.layer(DropoutLayer(dropout=0.5))
+        b.layer(DenseLayer(n_out=256, activation="relu"))
+        b.layer(OutputLayer(n_out=self.num_classes, loss="mcxent"))
+        return MultiLayerNetwork(b.input_type_convolutional(h, w, c).build()).init()
+
+
+class AlexNet(ZooModel):
+    """Ref: `zoo/model/AlexNet.java` (one-tower AlexNet w/ LRN)."""
+
+    name = "alexnet"
+    input_shape = (224, 224, 3)
+
+    def __init__(self, num_classes: int = 1000, **kw):
+        super().__init__(num_classes=num_classes, **kw)
+
+    def init(self):
+        h, w, c = self.input_shape
+        conf = (NeuralNetConfiguration.builder().seed(self.seed)
+                .updater(self.updater or Nesterovs(1e-2, 0.9))
+                .weight_init("normal").activation("relu")
+                .list()
+                .layer(ConvolutionLayer(n_out=96, kernel=(11, 11), stride=(4, 4),
+                                        padding="valid"))
+                .layer(LocalResponseNormalization(k=2, n=5, alpha=1e-4, beta=0.75))
+                .layer(SubsamplingLayer(kernel=(3, 3), stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=256, kernel=(5, 5), padding="same",
+                                        bias_init=1.0))
+                .layer(LocalResponseNormalization(k=2, n=5, alpha=1e-4, beta=0.75))
+                .layer(SubsamplingLayer(kernel=(3, 3), stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=384, kernel=(3, 3)))
+                .layer(ConvolutionLayer(n_out=384, kernel=(3, 3), bias_init=1.0))
+                .layer(ConvolutionLayer(n_out=256, kernel=(3, 3), bias_init=1.0))
+                .layer(SubsamplingLayer(kernel=(3, 3), stride=(2, 2)))
+                .layer(DenseLayer(n_out=4096, dropout=0.5, bias_init=1.0))
+                .layer(DenseLayer(n_out=4096, dropout=0.5, bias_init=1.0))
+                .layer(OutputLayer(n_out=self.num_classes, loss="mcxent"))
+                .input_type_convolutional(h, w, c).build())
+        return MultiLayerNetwork(conf).init()
+
+
+def _vgg_blocks(b, spec):
+    for n_convs, n_out in spec:
+        for _ in range(n_convs):
+            b.layer(ConvolutionLayer(n_out=n_out, kernel=(3, 3),
+                                     activation="relu"))
+        b.layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+    return b
+
+
+class VGG16(ZooModel):
+    """Ref: `zoo/model/VGG16.java`."""
+
+    name = "vgg16"
+    input_shape = (224, 224, 3)
+    _spec = ((2, 64), (2, 128), (3, 256), (3, 512), (3, 512))
+
+    def __init__(self, num_classes: int = 1000, **kw):
+        super().__init__(num_classes=num_classes, **kw)
+
+    def init(self):
+        h, w, c = self.input_shape
+        b = (NeuralNetConfiguration.builder().seed(self.seed)
+             .updater(self.updater or Nesterovs(1e-2, 0.9))
+             .weight_init("relu").list())
+        _vgg_blocks(b, self._spec)
+        b.layer(DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+        b.layer(DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+        b.layer(OutputLayer(n_out=self.num_classes, loss="mcxent"))
+        return MultiLayerNetwork(b.input_type_convolutional(h, w, c).build()).init()
+
+
+class VGG19(VGG16):
+    """Ref: `zoo/model/VGG19.java`."""
+
+    name = "vgg19"
+    _spec = ((2, 64), (2, 128), (4, 256), (4, 512), (4, 512))
+
+
+def _dark_conv(b, n_out, kernel=(3, 3)):
+    b.layer(ConvolutionLayer(n_out=n_out, kernel=kernel, padding="same",
+                             has_bias=False, activation="identity"))
+    b.layer(BatchNormalization(activation="leakyrelu"))
+    return b
+
+
+class Darknet19(ZooModel):
+    """Ref: `zoo/model/Darknet19.java` (conv/BN/leaky-relu backbone,
+    1x1 class conv + global avg pool)."""
+
+    name = "darknet19"
+    input_shape = (224, 224, 3)
+
+    def __init__(self, num_classes: int = 1000, **kw):
+        super().__init__(num_classes=num_classes, **kw)
+
+    def _backbone(self, b):
+        _dark_conv(b, 32)
+        b.layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+        _dark_conv(b, 64)
+        b.layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+        _dark_conv(b, 128)
+        _dark_conv(b, 64, (1, 1))
+        _dark_conv(b, 128)
+        b.layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+        _dark_conv(b, 256)
+        _dark_conv(b, 128, (1, 1))
+        _dark_conv(b, 256)
+        b.layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+        _dark_conv(b, 512)
+        _dark_conv(b, 256, (1, 1))
+        _dark_conv(b, 512)
+        _dark_conv(b, 256, (1, 1))
+        _dark_conv(b, 512)
+        b.layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+        _dark_conv(b, 1024)
+        _dark_conv(b, 512, (1, 1))
+        _dark_conv(b, 1024)
+        _dark_conv(b, 512, (1, 1))
+        _dark_conv(b, 1024)
+        return b
+
+    def init(self):
+        h, w, c = self.input_shape
+        b = (NeuralNetConfiguration.builder().seed(self.seed)
+             .updater(self._updater()).weight_init("relu").list())
+        self._backbone(b)
+        b.layer(ConvolutionLayer(n_out=self.num_classes, kernel=(1, 1),
+                                 activation="identity"))
+        b.layer(GlobalPoolingLayer(pooling="avg"))
+        from ..nn.layers import LossLayer
+        b.layer(LossLayer(loss="mcxent", activation="softmax"))
+        return MultiLayerNetwork(b.input_type_convolutional(h, w, c).build()).init()
+
+
+class TinyYOLO(ZooModel):
+    """Ref: `zoo/model/TinyYOLO.java` (tiny darknet backbone + YOLO2 head;
+    5 anchors, 416x416 input -> 13x13 grid)."""
+
+    name = "tinyyolo"
+    input_shape = (416, 416, 3)
+    anchors = ((1.08, 1.19), (3.42, 4.41), (6.63, 11.38), (9.42, 5.11),
+               (16.62, 10.52))
+
+    def __init__(self, num_classes: int = 20, **kw):
+        super().__init__(num_classes=num_classes, **kw)
+
+    def init(self):
+        h, w, c = self.input_shape
+        b = (NeuralNetConfiguration.builder().seed(self.seed)
+             .updater(self._updater()).weight_init("relu").list())
+        for i, n_out in enumerate((16, 32, 64, 128, 256)):
+            _dark_conv(b, n_out)
+            b.layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+        _dark_conv(b, 512)
+        b.layer(SubsamplingLayer(kernel=(2, 2), stride=(1, 1), padding="same"))
+        _dark_conv(b, 1024)
+        _dark_conv(b, 1024)
+        A = len(self.anchors)
+        b.layer(ConvolutionLayer(n_out=A * (5 + self.num_classes),
+                                 kernel=(1, 1), activation="identity"))
+        b.layer(Yolo2OutputLayer(anchors=self.anchors))
+        return MultiLayerNetwork(b.input_type_convolutional(h, w, c).build()).init()
+
+
+class YOLO2(ZooModel):
+    """Ref: `zoo/model/YOLO2.java` (Darknet19 backbone + passthrough
+    (SpaceToDepth) + YOLO2 head). Built as a ComputationGraph for the
+    reorg/route connection."""
+
+    name = "yolo2"
+    input_shape = (608, 608, 3)
+    anchors = ((0.57273, 0.677385), (1.87446, 2.06253), (3.33843, 5.47434),
+               (7.88282, 3.52778), (9.77052, 9.16828))
+
+    def __init__(self, num_classes: int = 80, **kw):
+        super().__init__(num_classes=num_classes, **kw)
+
+    def init(self):
+        from ..nn import NeuralNetConfiguration
+        from ..nn.conf import InputType
+        from ..nn.graph import ComputationGraph, MergeVertex
+        from ..nn.layers.convolutional import SpaceToDepthLayer
+        h, w, c = self.input_shape
+        g = (NeuralNetConfiguration.builder().seed(self.seed)
+             .updater(self._updater()).weight_init("relu")
+             .graph_builder()
+             .add_inputs("in")
+             .set_input_types(InputType.convolutional(h, w, c)))
+
+        def conv_bn(name, inp, n_out, kernel=(3, 3)):
+            g.add_layer(f"{name}_c", ConvolutionLayer(
+                n_out=n_out, kernel=kernel, padding="same", has_bias=False,
+                activation="identity"), inp)
+            g.add_layer(name, BatchNormalization(activation="leakyrelu"),
+                        f"{name}_c")
+            return name
+
+        def pool(name, inp):
+            g.add_layer(name, SubsamplingLayer(kernel=(2, 2), stride=(2, 2)),
+                        inp)
+            return name
+
+        x = conv_bn("c1", "in", 32)
+        x = pool("p1", x)
+        x = conv_bn("c2", x, 64)
+        x = pool("p2", x)
+        x = conv_bn("c3a", x, 128)
+        x = conv_bn("c3b", x, 64, (1, 1))
+        x = conv_bn("c3c", x, 128)
+        x = pool("p3", x)
+        x = conv_bn("c4a", x, 256)
+        x = conv_bn("c4b", x, 128, (1, 1))
+        x = conv_bn("c4c", x, 256)
+        x = pool("p4", x)
+        x = conv_bn("c5a", x, 512)
+        x = conv_bn("c5b", x, 256, (1, 1))
+        x = conv_bn("c5c", x, 512)
+        x = conv_bn("c5d", x, 256, (1, 1))
+        passthrough = conv_bn("c5e", x, 512)      # route source (26x26x512)
+        x = pool("p5", passthrough)
+        x = conv_bn("c6a", x, 1024)
+        x = conv_bn("c6b", x, 512, (1, 1))
+        x = conv_bn("c6c", x, 1024)
+        x = conv_bn("c6d", x, 512, (1, 1))
+        x = conv_bn("c6e", x, 1024)
+        x = conv_bn("c7a", x, 1024)
+        x = conv_bn("c7b", x, 1024)
+        g.add_layer("reorg", SpaceToDepthLayer(block_size=2), passthrough)
+        g.add_vertex("route", MergeVertex(), "reorg", x)
+        x = conv_bn("c8", "route", 1024)
+        A = len(self.anchors)
+        g.add_layer("pred", ConvolutionLayer(
+            n_out=A * (5 + self.num_classes), kernel=(1, 1),
+            activation="identity"), x)
+        g.add_layer("yolo", Yolo2OutputLayer(anchors=self.anchors), "pred")
+        g.set_outputs("yolo")
+        return ComputationGraph(g.build()).init()
+
+
+class TextGenerationLSTM(ZooModel):
+    """Ref: `zoo/model/TextGenerationLSTM.java` (char-level 2xLSTM(256))."""
+
+    name = "textgenlstm"
+
+    def __init__(self, num_classes: int = 77, timesteps: int = 40,
+                 hidden: int = 256, **kw):
+        super().__init__(num_classes=num_classes, **kw)
+        self.timesteps = int(timesteps)
+        self.hidden = int(hidden)
+
+    def init(self):
+        conf = (NeuralNetConfiguration.builder().seed(self.seed)
+                .updater(self._updater()).weight_init("xavier")
+                .list()
+                .layer(LSTM(n_out=self.hidden, activation="tanh"))
+                .layer(LSTM(n_out=self.hidden, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=self.num_classes, loss="mcxent"))
+                .input_type_recurrent(self.num_classes, self.timesteps)
+                .build())
+        return MultiLayerNetwork(conf).init()

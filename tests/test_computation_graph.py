@@ -1,0 +1,171 @@
+"""ComputationGraph tests: vertices, DAG topologies (residual, multi-input,
+multi-output, siamese), training convergence, JSON round-trip (ref:
+deeplearning4j-core TestComputationGraphNetwork / graph vertex tests)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn import (ComputationGraph,
+                                   ComputationGraphConfiguration,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf import InputType
+from deeplearning4j_tpu.nn.graph import (ElementWiseVertex, L2NormalizeVertex,
+                                         L2Vertex, MergeVertex,
+                                         PreprocessorVertex, ReshapeVertex,
+                                         ScaleVertex, ShiftVertex, StackVertex,
+                                         SubsetVertex, UnstackVertex)
+from deeplearning4j_tpu.nn.layers import (ConvolutionLayer, DenseLayer,
+                                          OutputLayer, SubsamplingLayer)
+
+
+def test_vertices_unit():
+    a = jnp.ones((2, 3))
+    b = 2 * jnp.ones((2, 3))
+    assert MergeVertex().apply([a, b]).shape == (2, 6)
+    assert np.allclose(ElementWiseVertex("add").apply([a, b]), 3.0)
+    assert np.allclose(ElementWiseVertex("product").apply([a, b]), 2.0)
+    assert np.allclose(ElementWiseVertex("subtract").apply([a, b]), -1.0)
+    assert np.allclose(ElementWiseVertex("average").apply([a, b]), 1.5)
+    assert np.allclose(ElementWiseVertex("max").apply([a, b]), 2.0)
+    assert SubsetVertex(0, 1).apply([a]).shape == (2, 2)
+    assert StackVertex().apply([a, b]).shape == (4, 3)
+    assert UnstackVertex(1, 2).apply([StackVertex().apply([a, b])]).shape == (2, 3)
+    assert np.allclose(UnstackVertex(1, 2).apply([StackVertex().apply([a, b])]), 2.0)
+    assert np.allclose(ScaleVertex(3.0).apply([a]), 3.0)
+    assert np.allclose(ShiftVertex(1.0).apply([a]), 2.0)
+    n = L2NormalizeVertex().apply([b])
+    assert np.allclose(np.sum(np.asarray(n) ** 2, axis=1), 1.0, atol=1e-5)
+    d = L2Vertex().apply([a, b])
+    assert d.shape == (2, 1)
+    assert np.allclose(d, np.sqrt(3.0), atol=1e-3)
+    r = ReshapeVertex((3, 1)).apply([a])
+    assert r.shape == (2, 3, 1)
+    p = PreprocessorVertex("cnn_to_ff").apply([jnp.ones((2, 2, 2, 3))])
+    assert p.shape == (2, 12)
+
+
+def _residual_mlp():
+    return (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+            .weight_init("xavier")
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(4))
+            .add_layer("d1", DenseLayer(n_out=4, activation="relu"), "in")
+            .add_layer("d2", DenseLayer(n_out=4, activation="relu"), "d1")
+            .add_vertex("res", ElementWiseVertex("add"), "d1", "d2")
+            .add_layer("out", OutputLayer(n_out=2), "res")
+            .set_outputs("out")
+            .build())
+
+
+def test_residual_graph_trains():
+    g = ComputationGraph(_residual_mlp()).init()
+    rs = np.random.default_rng(0)
+    x = rs.normal(size=(64, 4)).astype(np.float32)
+    labels = (x.sum(1) > 0).astype(int)
+    y = np.eye(2, dtype=np.float32)[labels]
+    g.fit(x, y)
+    first = g.score(x, y)
+    for _ in range(100):
+        g.fit(x, y)
+    assert g.score(x, y) < first * 0.7
+    pred = np.asarray(g.output(x)).argmax(1)
+    assert (pred == labels).mean() > 0.9
+
+
+def test_multi_input_multi_output():
+    conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-2))
+            .graph_builder()
+            .add_inputs("a", "b")
+            .set_input_types(InputType.feed_forward(3), InputType.feed_forward(5))
+            .add_layer("da", DenseLayer(n_out=4, activation="tanh"), "a")
+            .add_layer("db", DenseLayer(n_out=4, activation="tanh"), "b")
+            .add_vertex("m", MergeVertex(), "da", "db")
+            .add_layer("out1", OutputLayer(n_out=2), "m")
+            .add_layer("out2", OutputLayer(n_out=3), "m")
+            .set_outputs("out1", "out2")
+            .build())
+    g = ComputationGraph(conf).init()
+    xa = np.random.randn(8, 3).astype(np.float32)
+    xb = np.random.randn(8, 5).astype(np.float32)
+    y1 = np.eye(2, dtype=np.float32)[np.random.randint(0, 2, 8)]
+    y2 = np.eye(3, dtype=np.float32)[np.random.randint(0, 3, 8)]
+    g.fit([xa, xb], [y1, y2])
+    outs = g.output(xa, xb)
+    assert isinstance(outs, list) and outs[0].shape == (8, 2) \
+        and outs[1].shape == (8, 3)
+    assert np.isfinite(g.score_)
+
+
+def test_cnn_graph():
+    conf = (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-3))
+            .graph_builder()
+            .add_inputs("img")
+            .set_input_types(InputType.convolutional(8, 8, 1))
+            .add_layer("c1", ConvolutionLayer(n_out=4, kernel=(3, 3),
+                                              activation="relu"), "img")
+            .add_layer("p1", SubsamplingLayer(kernel=(2, 2), stride=(2, 2)), "c1")
+            .add_vertex("flat", PreprocessorVertex("cnn_to_ff"), "p1")
+            .add_layer("out", OutputLayer(n_out=3), "flat")
+            .set_outputs("out")
+            .build())
+    g = ComputationGraph(conf).init()
+    x = np.random.randn(4, 8, 8, 1).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[np.random.randint(0, 3, 4)]
+    g.fit(x, y)
+    assert np.asarray(g.output(x)).shape == (4, 3)
+
+
+def test_siamese_stack_unstack():
+    """Shared-weight twin towers via Stack/Unstack + L2 distance."""
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-2))
+            .graph_builder()
+            .add_inputs("x1", "x2")
+            .set_input_types(InputType.feed_forward(4), InputType.feed_forward(4))
+            .add_vertex("stack", StackVertex(), "x1", "x2")
+            .add_layer("tower", DenseLayer(n_out=6, activation="tanh"), "stack")
+            .add_vertex("e1", UnstackVertex(0, 2), "tower")
+            .add_vertex("e2", UnstackVertex(1, 2), "tower")
+            .add_vertex("dist", L2Vertex(), "e1", "e2")
+            .add_layer("out", OutputLayer(n_out=2, activation="softmax"), "dist")
+            .set_outputs("out")
+            .build())
+    g = ComputationGraph(conf).init()
+    x1 = np.random.randn(6, 4).astype(np.float32)
+    x2 = np.random.randn(6, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[np.random.randint(0, 2, 6)]
+    g.fit({"x1": x1, "x2": x2}, y)
+    out = g.output({"x1": x1, "x2": x2})
+    assert np.asarray(out).shape == (6, 2)
+
+
+def test_topo_order_and_cycle_detection():
+    conf = _residual_mlp()
+    order = conf.topo_order()
+    assert order.index("d1") < order.index("d2") < order.index("res") \
+        < order.index("out")
+    # introduce a cycle
+    conf.nodes["d1"].inputs = ["d2"]
+    with pytest.raises(ValueError, match="cycle"):
+        conf.topo_order()
+
+
+def test_json_roundtrip_graph():
+    conf = _residual_mlp()
+    c2 = ComputationGraphConfiguration.from_json(conf.to_json())
+    g = ComputationGraph(c2).init()
+    x = np.random.randn(3, 4).astype(np.float32)
+    assert np.asarray(g.output(x)).shape == (3, 2)
+    # params identical count
+    g0 = ComputationGraph(conf).init()
+    assert g.num_params() == g0.num_params()
+
+
+def test_clone_preserves_params():
+    g = ComputationGraph(_residual_mlp()).init()
+    x = np.random.randn(3, 4).astype(np.float32)
+    out1 = np.asarray(g.output(x))
+    g2 = g.clone()
+    assert np.allclose(out1, np.asarray(g2.output(x)), atol=1e-6)

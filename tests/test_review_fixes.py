@@ -1,0 +1,120 @@
+"""Regression tests for code-review findings (round 1)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deeplearning4j_tpu.ops as ops
+from deeplearning4j_tpu.learning import Adam, Sgd
+from deeplearning4j_tpu.nn import (ComputationGraph, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf import InputType
+from deeplearning4j_tpu.nn.layers import (LSTM, DenseLayer, OutputLayer,
+                                          RnnOutputLayer)
+from deeplearning4j_tpu.nn.layers.convolutional import (
+    DepthwiseConvolution2D, FrozenLayer, SeparableConvolution2D)
+from deeplearning4j_tpu.nn.layers.recurrent import EmbeddingSequenceLayer
+
+
+def test_per_sample_mask_respected_in_mlp():
+    """A per-sample weight mask on 2D input must reach the loss."""
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.0))
+            .list().layer(OutputLayer(n_out=2, n_in=2))
+            .input_type_feed_forward(2).build())
+    m = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(0).normal(size=(4, 2)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[[0, 1, 0, 1]]
+    full = m.score(x, y, mask=np.ones(4, np.float32))
+    half = m.score(x, y, mask=np.array([1, 1, 0, 0], np.float32))
+    first_two = m.score(x[:2], y[:2])
+    assert abs(half - first_two) < 1e-5
+    assert abs(full - half) > 1e-7 or abs(full - first_two) > 1e-7
+
+
+def test_int_token_input_lstm():
+    """Embedding->LSTM with int32 token input must trace (carry dtype)."""
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+            .list()
+            .layer(EmbeddingSequenceLayer(n_in=20, n_out=8))
+            .layer(LSTM(n_out=6))
+            .layer(RnnOutputLayer(n_out=3))
+            .input_type_recurrent(1, 5).build())
+    m = MultiLayerNetwork(conf).init()
+    tokens = np.random.default_rng(0).integers(0, 20, (4, 5)).astype(np.int32)
+    y = np.zeros((4, 5, 3), np.float32)
+    y[..., 0] = 1
+    m.fit(tokens, y)
+    out = m.output(tokens)
+    assert out.shape == (4, 5, 3)
+    # stateful path too
+    m.rnn_clear_previous_state()
+    assert m.rnn_time_step(tokens).shape == (4, 5, 3)
+
+
+def test_frozen_layer_ignores_weight_decay():
+    """Global l2 must not decay frozen-layer params."""
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.5)).l2(0.1)
+            .list()
+            .layer(FrozenLayer(DenseLayer(n_out=4, n_in=3, activation="tanh")))
+            .layer(OutputLayer(n_out=2))
+            .input_type_feed_forward(3).build())
+    m = MultiLayerNetwork(conf).init()
+    frozen_key = m._layer_keys[0]
+    before = np.array(m._params[frozen_key]["W"])
+    x = np.random.default_rng(0).normal(size=(8, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[np.random.randint(0, 2, 8)]
+    for _ in range(5):
+        m.fit(x, y)
+    after = np.array(m._params[frozen_key]["W"])
+    assert np.allclose(before, after), "frozen weights drifted"
+
+
+def test_graph_fit_threads_mask():
+    """ComputationGraph.fit((x, y, mask)) must apply the label mask."""
+    conf = (NeuralNetConfiguration.builder().seed(2).updater(Sgd(0.0))
+            .graph_builder().add_inputs("in")
+            .set_input_types(InputType.recurrent(2, 4))
+            .add_layer("l", LSTM(n_out=3), "in")
+            .add_layer("out", RnnOutputLayer(n_out=2), "l")
+            .set_outputs("out").build())
+    g = ComputationGraph(conf).init()
+    x = np.random.default_rng(0).normal(size=(2, 4, 2)).astype(np.float32)
+    y = np.zeros((2, 4, 2), np.float32)
+    y[..., 0] = 1
+    mask = np.ones((2, 4), np.float32)
+    mask[:, 2:] = 0
+    g.fit([((x, y, mask))])
+    loss_masked = float(g._last_loss)
+    g2 = ComputationGraph(conf).init()
+    g2.fit([((x, y, None))])
+    # with lr=0 params don't move; losses differ iff mask was applied
+    assert abs(loss_masked - float(g2._last_loss)) > 1e-7
+
+
+def test_matmul_batched_transpose():
+    a = np.random.default_rng(0).normal(size=(3, 4, 2)).astype(np.float32)
+    b = np.random.default_rng(1).normal(size=(3, 4, 5)).astype(np.float32)
+    out = ops.execute("matmul", a, b, transpose_a=True)
+    ref = np.einsum("bka,bkc->bac", a, b)
+    assert np.allclose(np.asarray(out), ref, atol=1e-5)
+
+
+def test_max_pool_with_argmax_stride1():
+    x = np.random.default_rng(0).normal(size=(1, 4, 4, 2)).astype(np.float32)
+    out, idx = ops.execute("max_pool_with_argmax", x, (2, 2), (1, 1), "valid")
+    assert out.shape == (1, 3, 3, 2) and idx.shape == (1, 3, 3, 2)
+    flat = x[0].ravel()
+    assert np.allclose(flat[np.asarray(idx)[0]], np.asarray(out)[0])
+
+
+def test_conv_output_shape_numeric_padding():
+    for layer in (DepthwiseConvolution2D(kernel=(3, 3),
+                                         padding=((1, 1), (1, 1))),
+                  SeparableConvolution2D(n_out=4, kernel=(3, 3),
+                                         padding=((1, 1), (1, 1)))):
+        layer.build((6, 6, 3), {"weight_init": "xavier"})
+        p = layer.init_params(jax.random.PRNGKey(0))
+        x = jnp.ones((1, 6, 6, 3))
+        out, _ = layer.apply(p, x, {}, False, None)
+        assert out.shape[1:] == tuple(layer.output_shape((6, 6, 3))), \
+            f"{type(layer).__name__}: {out.shape[1:]} vs declared " \
+            f"{layer.output_shape((6, 6, 3))}"

@@ -1,0 +1,164 @@
+"""Model zoo tests: every reference architecture instantiates at a reduced
+input size and produces a finite forward pass of the right shape (ref:
+deeplearning4j-zoo TestInstantiation.java)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.zoo import (ALL_MODELS, AlexNet, Darknet19,
+                                    FaceNetNN4Small2, InceptionResNetV1, LeNet,
+                                    NASNet, ResNet50, SimpleCNN, SqueezeNet,
+                                    TextGenerationLSTM, TinyYOLO, UNet, VGG16,
+                                    VGG19, Xception, YOLO2)
+
+
+def _fwd(model, shape, classes):
+    net = model.init()
+    x = np.random.default_rng(0).normal(size=(1,) + shape).astype(np.float32)
+    out = net.output(x)
+    out = np.asarray(out)
+    assert np.all(np.isfinite(out)), f"{model.name}: non-finite output"
+    return net, out
+
+
+def test_zoo_has_all_16():
+    assert len(ALL_MODELS) == 16
+    names = {m.name for m in ALL_MODELS}
+    assert names == {"alexnet", "darknet19", "facenetnn4small2",
+                     "inceptionresnetv1", "lenet", "nasnet", "resnet50",
+                     "simplecnn", "squeezenet", "textgenlstm", "tinyyolo",
+                     "unet", "vgg16", "vgg19", "xception", "yolo2"}
+
+
+def test_lenet_trains_on_synthetic():
+    net = LeNet(num_classes=10).init()
+    x = np.random.default_rng(0).normal(size=(8, 28, 28, 1)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[np.random.randint(0, 10, 8)]
+    net.fit(x, y)
+    assert np.isfinite(net.score_)
+    assert np.asarray(net.output(x)).shape == (8, 10)
+
+
+def test_simplecnn():
+    m = SimpleCNN(num_classes=5, input_shape=(48, 48, 3))
+    net, out = _fwd(m, (48, 48, 3), 5)
+    assert out.shape == (1, 5)
+
+
+def test_alexnet_small():
+    m = AlexNet(num_classes=10, input_shape=(96, 96, 3))
+    net, out = _fwd(m, (96, 96, 3), 10)
+    assert out.shape == (1, 10)
+
+
+def test_vgg16_small():
+    m = VGG16(num_classes=7, input_shape=(64, 64, 3))
+    net, out = _fwd(m, (64, 64, 3), 7)
+    assert out.shape == (1, 7)
+
+
+def test_vgg19_small():
+    m = VGG19(num_classes=4, input_shape=(64, 64, 3))
+    net, out = _fwd(m, (64, 64, 3), 4)
+    assert out.shape == (1, 4)
+
+
+def test_darknet19_small():
+    m = Darknet19(num_classes=6, input_shape=(64, 64, 3))
+    net, out = _fwd(m, (64, 64, 3), 6)
+    assert out.shape == (1, 6)
+    assert np.allclose(out.sum(), 1.0, atol=1e-4)  # softmax head
+
+
+def test_resnet50_small():
+    m = ResNet50(num_classes=9, input_shape=(64, 64, 3))
+    net, out = _fwd(m, (64, 64, 3), 9)
+    assert out.shape == (1, 9)
+    # bottleneck structure: 53 conv layers in main path + shortcuts
+    assert net.num_params() > 20_000_000
+
+
+def test_squeezenet_small():
+    m = SqueezeNet(num_classes=5, input_shape=(67, 67, 3))
+    net, out = _fwd(m, (67, 67, 3), 5)
+    assert out.shape == (1, 5)
+
+
+def test_unet_small():
+    m = UNet(input_shape=(64, 64, 3))
+    net, out = _fwd(m, (64, 64, 3), 1)
+    assert out.shape == (1, 64, 64, 1)
+    assert (out >= 0).all() and (out <= 1).all()  # sigmoid mask
+
+
+def test_xception_small():
+    m = Xception(num_classes=5, input_shape=(71, 71, 3))
+    net, out = _fwd(m, (71, 71, 3), 5)
+    assert out.shape == (1, 5)
+
+
+def test_inception_resnet_v1_small():
+    m = InceptionResNetV1(num_classes=8, input_shape=(96, 96, 3))
+    net, out = _fwd(m, (96, 96, 3), 8)
+    assert out.shape == (1, 8)
+
+
+def test_facenet_small():
+    m = FaceNetNN4Small2(num_classes=8, input_shape=(96, 96, 3))
+    net, out = _fwd(m, (96, 96, 3), 8)
+    assert out.shape == (1, 8)
+
+
+def test_nasnet_small():
+    m = NASNet(num_classes=5, input_shape=(64, 64, 3), n_cells=2)
+    net, out = _fwd(m, (64, 64, 3), 5)
+    assert out.shape == (1, 5)
+
+
+def test_tinyyolo_small():
+    m = TinyYOLO(num_classes=3, input_shape=(128, 128, 3))
+    net = m.init()
+    x = np.random.default_rng(0).normal(size=(1, 128, 128, 3)).astype(np.float32)
+    out = np.asarray(net.output(x))
+    A = len(m.anchors)
+    assert out.shape == (1, 4, 4, A * (5 + 3))
+    assert np.all(np.isfinite(out))
+
+
+def test_yolo2_small():
+    m = YOLO2(num_classes=4, input_shape=(128, 128, 3))
+    net = m.init()
+    x = np.random.default_rng(0).normal(size=(1, 128, 128, 3)).astype(np.float32)
+    out = np.asarray(net.output(x))
+    A = len(m.anchors)
+    assert out.shape == (1, 4, 4, A * (5 + 4))
+
+
+def test_yolo_loss_and_nms():
+    from deeplearning4j_tpu.nn.layers.objdetect import (Yolo2OutputLayer,
+                                                        non_max_suppression)
+    import jax.numpy as jnp
+    layer = Yolo2OutputLayer(anchors=((1, 1), (2, 2)))
+    layer.build((4, 4, 2 * 7), {})
+    x = np.random.default_rng(1).normal(size=(2, 4, 4, 14)).astype(np.float32)
+    labels = np.zeros((2, 4, 4, 14), np.float32)
+    labels[0, 1, 1, 4] = 1.0  # anchor 0 responsible
+    labels[0, 1, 1, 0:2] = 0.5
+    labels[0, 1, 1, 2:4] = 1.0
+    labels[0, 1, 1, 5] = 1.0
+    loss = layer.compute_loss({}, jnp.asarray(x), jnp.asarray(labels))
+    assert np.isfinite(float(loss)) and float(loss) > 0
+
+    boxes = np.array([[0.5, 0.5, 1, 1], [0.52, 0.5, 1, 1], [3, 3, 1, 1]])
+    scores = np.array([0.9, 0.8, 0.7])
+    kept, ks = non_max_suppression(boxes, scores, iou_threshold=0.5,
+                                   score_threshold=0.1)
+    assert len(kept) == 2  # overlapping pair suppressed to one
+
+
+def test_textgen_lstm():
+    m = TextGenerationLSTM(num_classes=30, timesteps=12)
+    net = m.init()
+    x = np.zeros((2, 12, 30), np.float32)
+    x[:, :, 0] = 1
+    out = np.asarray(net.output(x))
+    assert out.shape == (2, 12, 30)
