@@ -1,0 +1,25 @@
+"""SameDiff-class graph autodiff layer.
+
+The TPU-native counterpart of ND4J's SameDiff subsystem
+(ref: `nd4j-api/.../autodiff/samediff/SameDiff.java` — graph build,
+`createGradFunction` :2915, `fit` :1450-1523; `SDVariable.java`;
+sessions `internal/AbstractSession.java:26-120` /
+`internal/InferenceSession.java:88-260` incl. control-flow
+Enter/Exit/Merge/Switch/TensorArray; serde
+`samediff/serde/FlatBuffersMapper.java`).
+
+TPU-first redesign: the graph records named ops from the catalog
+(`deeplearning4j_tpu.ops`) and *execution is one pure function* over
+(variables, placeholders) that XLA traces and compiles whole — there is no
+per-op interpreter loop at runtime, no VarId=(name,frame,iter) scheduler:
+control flow lowers to `lax.cond` / `lax.while_loop` / `lax.scan` so the
+compiled program stays on-device. Reverse mode (`createGradFunction`) is
+`jax.grad` of that same function rather than a hand-built backward graph.
+Serialization replaces FlatBuffers with JSON graph + npz arrays.
+"""
+from .samediff import (SDVariable, SameDiff, TensorArray, TrainingConfig,
+                       VariableType)
+from .gradcheck import check_gradients
+
+__all__ = ["SameDiff", "SDVariable", "TrainingConfig", "VariableType",
+           "TensorArray", "check_gradients"]

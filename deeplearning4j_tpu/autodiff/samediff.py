@@ -1,0 +1,1191 @@
+"""SameDiff core: graph build, execution, autodiff, training, serde.
+
+Ref: `autodiff/samediff/SameDiff.java` (4,337 lines), `SDVariable.java`,
+`internal/{AbstractSession,InferenceSession}.java`,
+`serde/FlatBuffersMapper.java`, op namespaces under `samediff/ops/`.
+
+Architecture (TPU-first):
+- The graph is a recorded list of named-op nodes over the op catalog.
+- `_build()` turns (a subset of) the graph into ONE pure function
+  `fn(values, rng) -> outputs`; `jax.jit` compiles it whole, so XLA sees
+  the entire program and fuses freely — no per-op dispatch at runtime.
+- `createGradFunction` (ref :2915) is `jax.value_and_grad` of that
+  function: no separate backward graph is built or stored.
+- Control flow (reference: Enter/Exit/Merge/Switch frames executed by
+  InferenceSession) is recorded as subgraph nodes and lowered to
+  `lax.cond` / `lax.while_loop` / `lax.scan`, keeping the compiled
+  program on-device with static shapes.
+- TensorArray (reference: TensorArray ops in InferenceSession:204-253)
+  is a fixed-capacity stacked buffer with dynamic_update_slice writes —
+  jit/scan-compatible, unlike a host-side list.
+"""
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import (Any, Callable, Dict, List, Optional, Sequence, Tuple,
+                    Union)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import ops as catalog
+from .. import learning
+
+
+class VariableType(Enum):
+    """Ref: `org.nd4j.autodiff.samediff.VariableType`."""
+    VARIABLE = "VARIABLE"        # trainable, persisted
+    CONSTANT = "CONSTANT"        # fixed value, persisted
+    PLACEHOLDER = "PLACEHOLDER"  # fed at execution time
+    ARRAY = "ARRAY"              # op output
+
+
+# Bare-name conveniences -> catalog names (the catalog itself mirrors the
+# reference's libnd4j op names; `legacy.*` are the strict transform family).
+_ALIASES = {
+    "sub": "subtract", "mul": "multiply", "div": "divide", "mmul": "matmul",
+    "sum": "reduce_sum", "mean": "reduce_mean", "prod": "reduce_prod",
+    "amax": "reduce_max", "amin": "reduce_min", "norm1": "reduce_norm1",
+    "norm2": "reduce_norm2", "normmax": "reduce_norm_max",
+    "variance": "reduce_variance", "std": "reduce_stdev",
+    "one_hot": "onehot", "eq": "equals", "neq": "not_equals",
+    "gt": "greater", "lt": "less", "gte": "greater_equal",
+    "lte": "less_equal", "where": "Where", "lrelu": "lrelu",
+    "leakyrelu": "lrelu", "avg_pool2d": "avgpool2d", "max_pool2d": "maxpool2d",
+    "conv3d": "conv3dnew", "random_uniform": "randomuniform",
+    "bernoulli": "random_bernoulli",
+}
+
+# Fallback output-arity table for ops whose outputs can't be shape-inferred
+# (ref: DeclarableOp::calculateOutputShape). Most arities come from
+# jax.eval_shape at record time; these are the known multi-output ops.
+_N_OUT = {
+    "unique_with_counts": 2, "top_k": 2, "max_pool_with_argmax": 2,
+    "moments": 2, "svd": 3, "lstm": 3, "lstmBlock": 3, "gru": 2,
+    "listdiff": 2,
+    "sufficient_statistics": 3, "normalize_moments": 2,
+    "fused_batch_norm": 3, "log_matrix_determinant": 2,
+}
+
+_CONTROL_OPS = ("__cond", "__while", "__scan")
+
+
+def _resolve(name: str) -> str:
+    if name in catalog.REGISTRY:
+        return name
+    if name in _ALIASES and _ALIASES[name] in catalog.REGISTRY:
+        return _ALIASES[name]
+    legacy = f"legacy.{name}"
+    if legacy in catalog.REGISTRY:
+        return legacy
+    raise AttributeError(f"no op {name!r} in the catalog "
+                         f"({len(catalog.REGISTRY)} registered)")
+
+
+@dataclass
+class _Node:
+    """One recorded op. `arg_template` preserves the positional-call
+    structure: entries are either ('$', input_index) tensor slots or
+    literal static args (shapes, axes, flags)."""
+    op: str
+    inputs: List[str]
+    outputs: List[str]
+    arg_template: List[Any]
+    kwargs: Dict[str, Any]
+    subgraphs: Optional[Dict[str, Any]] = None  # control flow
+
+
+class SDVariable:
+    """Symbolic tensor handle (ref: `SDVariable.java`, 1,824 lines)."""
+
+    def __init__(self, sd: "SameDiff", name: str, vtype: VariableType,
+                 shape=None, dtype=None):
+        self.sd = sd
+        self.name = name
+        self.vtype = vtype
+        self._shape = tuple(shape) if shape is not None else None
+        self._dtype = jnp.dtype(dtype) if dtype is not None else None
+
+    # -- info ----------------------------------------------------------
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    def rank(self):
+        return None if self._shape is None else len(self._shape)
+
+    def get_arr(self):
+        """Current value for VARIABLE/CONSTANT (ref: SDVariable.getArr)."""
+        return self.sd._values.get(self.name)
+
+    def set_arr(self, value):
+        self.sd._values[self.name] = jnp.asarray(value)
+        return self
+
+    def eval(self, placeholders: Optional[Dict[str, Any]] = None):
+        """Ref: SDVariable.eval — execute the graph up to this variable."""
+        return self.sd.output(placeholders or {}, [self.name])[self.name]
+
+    def rename(self, new_name: str) -> "SDVariable":
+        self.sd._rename(self.name, new_name)
+        return self
+
+    # -- operators -----------------------------------------------------
+    def _bin(self, op, other, swap=False):
+        a, b = (other, self) if swap else (self, other)
+        return self.sd._record(op, (a, b), {})
+
+    def __add__(self, o): return self._bin("add", o)
+    def __radd__(self, o): return self._bin("add", o, True)
+    def __sub__(self, o): return self._bin("subtract", o)
+    def __rsub__(self, o): return self._bin("subtract", o, True)
+    def __mul__(self, o): return self._bin("multiply", o)
+    def __rmul__(self, o): return self._bin("multiply", o, True)
+    def __truediv__(self, o): return self._bin("divide", o)
+    def __rtruediv__(self, o): return self._bin("divide", o, True)
+    def __pow__(self, o): return self._bin("pow", o)
+    def __mod__(self, o): return self._bin("mod", o)
+    def __matmul__(self, o): return self._bin("matmul", o)
+    def __neg__(self): return self.sd._record("legacy.neg", (self,), {})
+    def __gt__(self, o): return self._bin("greater", o)
+    def __lt__(self, o): return self._bin("less", o)
+    def __ge__(self, o): return self._bin("greater_equal", o)
+    def __le__(self, o): return self._bin("less_equal", o)
+
+    def __getitem__(self, idx):
+        """Basic indexing via strided_slice (ref: SDVariable.get/SDIndex)."""
+        if self._shape is None:
+            raise ValueError(f"cannot index {self.name}: unknown shape")
+        if not isinstance(idx, tuple):
+            idx = (idx,)
+        _FULL = 2 ** 31 - 1  # clamped by slice semantics on dynamic dims
+        begin, end, strides, squeeze = [], [], [], []
+        for axis, it in enumerate(idx):
+            dim = self._shape[axis]
+            if isinstance(it, int):
+                if dim is None and it < 0:
+                    raise ValueError(
+                        f"negative index on dynamic axis {axis} of {self.name}")
+                it = it if it >= 0 else it + dim
+                begin.append(it); end.append(it + 1); strides.append(1)
+                squeeze.append(axis)
+            elif isinstance(it, slice):
+                if dim is None:
+                    if it != slice(None):
+                        raise ValueError(
+                            f"partial slice on dynamic axis {axis} of "
+                            f"{self.name}; only [:] is supported there")
+                    begin.append(0); end.append(_FULL); strides.append(1)
+                else:
+                    b, e, s = it.indices(dim)
+                    begin.append(b); end.append(e); strides.append(s)
+            else:
+                raise TypeError(f"unsupported index {it!r}")
+        for axis in range(len(idx), len(self._shape)):
+            dim = self._shape[axis]
+            begin.append(0)
+            end.append(_FULL if dim is None else dim)
+            strides.append(1)
+        out = self.sd._record("strided_slice", (self,),
+                              {"begin": begin, "end": end, "strides": strides})
+        if squeeze:
+            out = self.sd._record("squeeze", (out,), {"axis": tuple(squeeze)})
+        return out
+
+    # -- common graph methods (parity with SDVariable's fluent API) ----
+    def add(self, o): return self.__add__(o)
+    def sub(self, o): return self.__sub__(o)
+    def mul(self, o): return self.__mul__(o)
+    def div(self, o): return self.__truediv__(o)
+    def rsub(self, o): return self.__rsub__(o)
+    def rdiv(self, o): return self.__rtruediv__(o)
+    def mmul(self, o): return self.__matmul__(o)
+    def dot(self, o): return self.sd.math.reduce_dot(self, o)
+    def neg(self): return self.__neg__()
+
+    def std(self, *axes, keepdims=False):
+        return self.sd._record("reduce_stdev", (self,),
+                               {"axes": axes or None, "keepdims": keepdims})
+
+    def __repr__(self):
+        return (f"SDVariable(name={self.name!r}, type={self.vtype.value}, "
+                f"shape={self._shape}, dtype={self._dtype})")
+
+    def __getattr__(self, name):
+        """Fluent op application: `x.tanh()`, `x.reduce_sum(axes=0)`…"""
+        if name.startswith("_"):
+            raise AttributeError(name)
+        try:
+            _resolve(name)
+        except AttributeError:
+            raise AttributeError(
+                f"SDVariable has no attribute/op {name!r}") from None
+        return lambda *a, **kw: self.sd._record(name, (self,) + a, kw)
+
+
+class _OpNamespace:
+    """An op namespace (ref: `samediff/ops/SDMath.java`, SDNN, SDCNN,
+    SDRNN, SDLoss, SDRandom, SDImage, SDBitwise, SDLinalg…). Resolution is
+    shared (the whole catalog); the namespace scopes `dir()` for
+    discoverability and mirrors the reference call sites."""
+
+    def __init__(self, sd: "SameDiff", categories: Tuple[str, ...]):
+        self._sd = sd
+        self._categories = categories
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        _resolve(name)  # raise early on unknown ops
+        return lambda *a, **kw: self._sd._record(name, a, kw)
+
+    def __dir__(self):
+        names = [n for n, o in catalog.REGISTRY.items()
+                 if o.category in self._categories]
+        return sorted(names)
+
+
+class TensorArray:
+    """Fixed-capacity functional tensor array, usable inside jit/scan
+    (ref: TensorArray handling in `InferenceSession.java:204-253`; the
+    catalog's eager `*_list` ops cover the host-side path).
+
+    Functional: `write` returns a NEW TensorArray whose buffer variable is
+    the updated one. Backed by a [capacity, *element_shape] buffer plus
+    dynamic_update_slice."""
+
+    def __init__(self, sd: "SameDiff", capacity: int, element_shape,
+                 dtype=jnp.float32, _buffer: Optional[SDVariable] = None):
+        self.sd = sd
+        self.capacity = int(capacity)
+        self.element_shape = tuple(element_shape)
+        self.dtype = jnp.dtype(dtype)
+        if _buffer is None:
+            _buffer = sd.zero(None, (self.capacity,) + self.element_shape,
+                              dtype=self.dtype)
+        self.buffer = _buffer
+
+    def write(self, index, value: SDVariable) -> "TensorArray":
+        exp = self.sd._record("expand_dims", (value,), {"axis": 0})
+        if isinstance(index, SDVariable):
+            idx = self.sd._record("reshape", (index,), {"shape": (1,)})
+        else:
+            idx = self.sd.constant(jnp.asarray([index], jnp.int32))
+        # scatter_update catalog signature: (ref, indices, updates)
+        buf = self.sd._record("scatter_update", (self.buffer, idx, exp), {})
+        return TensorArray(self.sd, self.capacity, self.element_shape,
+                           self.dtype, _buffer=buf)
+
+    def read(self, index) -> SDVariable:
+        if isinstance(index, SDVariable):
+            out = self.sd._record("gather", (self.buffer, index), {})
+            return out
+        return self.buffer[int(index)]
+
+    def stack(self) -> SDVariable:
+        return self.buffer
+
+    def unstack(self, x: SDVariable) -> "TensorArray":
+        return TensorArray(self.sd, self.capacity, self.element_shape,
+                           self.dtype, _buffer=x)
+
+    def size(self) -> int:
+        return self.capacity
+
+
+class TrainingConfig:
+    """Ref: `org.nd4j.autodiff.samediff.TrainingConfig` — updater, L1/L2,
+    dataset feature/label mappings."""
+
+    def __init__(self, updater=None, l1: float = 0.0, l2: float = 0.0,
+                 data_set_feature_mapping: Sequence[str] = (),
+                 data_set_label_mapping: Sequence[str] = (),
+                 minimize: bool = True):
+        self.updater = learning.get(updater) if updater is not None \
+            else learning.Adam(1e-3)
+        self.l1 = float(l1)
+        self.l2 = float(l2)
+        self.data_set_feature_mapping = list(data_set_feature_mapping)
+        self.data_set_label_mapping = list(data_set_label_mapping)
+        self.minimize = minimize
+
+    def to_json(self) -> dict:
+        return {"updater": self.updater.to_json(), "l1": self.l1,
+                "l2": self.l2,
+                "dataSetFeatureMapping": self.data_set_feature_mapping,
+                "dataSetLabelMapping": self.data_set_label_mapping,
+                "minimize": self.minimize}
+
+    @staticmethod
+    def from_json(d: dict) -> "TrainingConfig":
+        return TrainingConfig(updater=learning.get(d["updater"]),
+                              l1=d["l1"], l2=d["l2"],
+                              data_set_feature_mapping=d["dataSetFeatureMapping"],
+                              data_set_label_mapping=d["dataSetLabelMapping"],
+                              minimize=d.get("minimize", True))
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def updater(self, u): self._kw["updater"] = u; return self
+        def l1(self, v): self._kw["l1"] = v; return self
+        def l2(self, v): self._kw["l2"] = v; return self
+
+        def data_set_feature_mapping(self, *names):
+            self._kw["data_set_feature_mapping"] = list(names); return self
+
+        def data_set_label_mapping(self, *names):
+            self._kw["data_set_label_mapping"] = list(names); return self
+
+        def minimize(self, v=True): self._kw["minimize"] = v; return self
+        def build(self): return TrainingConfig(**self._kw)
+
+    @staticmethod
+    def builder() -> "TrainingConfig.Builder":
+        return TrainingConfig.Builder()
+
+
+class History:
+    """Ref: `org.nd4j.autodiff.listeners.records.History`."""
+
+    def __init__(self):
+        self.loss_curve: List[float] = []
+        self.epoch_losses: List[float] = []
+
+    def last_loss(self) -> float:
+        return self.loss_curve[-1] if self.loss_curve else float("nan")
+
+
+class SameDiff:
+    """Graph-building + execution context (ref: SameDiff.java)."""
+
+    def __init__(self):
+        self._vars: Dict[str, SDVariable] = {}
+        self._values: Dict[str, jnp.ndarray] = {}
+        self._nodes: List[_Node] = []
+        self._producer: Dict[str, _Node] = {}
+        self._counter = 0
+        self._loss_variables: List[str] = []
+        self._training_config: Optional[TrainingConfig] = None
+        self._updater_state = None
+        self._step = 0
+        self._fn_cache: Dict[Tuple[str, ...], Callable] = {}
+        self._grads: Dict[str, jnp.ndarray] = {}
+        self.seed = 0
+        # namespaces (ref: samediff/ops/)
+        self.math = _OpNamespace(self, ("broadcastable", "transforms",
+                                        "parity_ops", "legacy.transform",
+                                        "legacy.pairwise", "legacy.reduce",
+                                        "reduce", "boolean", "blas", "shape"))
+        self.nn = _OpNamespace(self, ("nn", "activations"))
+        self.cnn = _OpNamespace(self, ("convo",))
+        self.rnn = _OpNamespace(self, ("recurrent",))
+        self.loss = _OpNamespace(self, ("loss",))
+        self.random = _OpNamespace(self, ("random",))
+        self.image = _OpNamespace(self, ("parity_ops",))
+        self.bitwise = _OpNamespace(self, ("bitwise",))
+        self.linalg = _OpNamespace(self, ("blas",))
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+    @staticmethod
+    def create() -> "SameDiff":
+        return SameDiff()
+
+    def _name(self, base: str) -> str:
+        self._counter += 1
+        name = f"{base}_{self._counter}"
+        while name in self._vars:
+            self._counter += 1
+            name = f"{base}_{self._counter}"
+        return name
+
+    def _add_var(self, name, vtype, shape, dtype) -> SDVariable:
+        if name in self._vars:
+            raise ValueError(f"variable {name!r} already exists")
+        v = SDVariable(self, name, vtype, shape, dtype)
+        self._vars[name] = v
+        return v
+
+    def var(self, name=None, shape=None, value=None, dtype=jnp.float32,
+            weight_init=None, key=None) -> SDVariable:
+        """Trainable variable (ref: SameDiff.var). Default init zeros;
+        `weight_init` accepts a `weightinit` scheme name (e.g. 'xavier')."""
+        if isinstance(name, (np.ndarray, jnp.ndarray)):
+            value, name = name, None
+        name = name or self._name("variable")
+        if value is None:
+            if shape is None:
+                raise ValueError("var() needs shape or value")
+            if weight_init is not None:
+                from .. import weightinit
+                fan_in = int(np.prod(shape[:-1])) if len(shape) > 1 else shape[0]
+                fan_out = int(shape[-1])
+                value = weightinit.init_weights(
+                    key if key is not None else jax.random.PRNGKey(self.seed),
+                    shape, fan_in, fan_out, weight_init)
+            else:
+                value = jnp.zeros(shape, dtype)
+        value = jnp.asarray(value)
+        v = self._add_var(name, VariableType.VARIABLE, value.shape, value.dtype)
+        self._values[name] = value
+        return v
+
+    def constant(self, value, name=None) -> SDVariable:
+        value = jnp.asarray(value)
+        name = name or self._name("constant")
+        v = self._add_var(name, VariableType.CONSTANT, value.shape, value.dtype)
+        self._values[name] = value
+        return v
+
+    def placeholder(self, name, shape=None, dtype=jnp.float32) -> SDVariable:
+        """Ref: SameDiff.placeHolder. `None`/-1 dims = batch-polymorphic."""
+        shape = None if shape is None else tuple(
+            None if (s is None or s == -1) else int(s) for s in shape)
+        return self._add_var(name, VariableType.PLACEHOLDER, shape, dtype)
+
+    place_holder = placeholder
+
+    def zero(self, name, shape, dtype=jnp.float32) -> SDVariable:
+        return self.constant(jnp.zeros(shape, dtype), name)
+
+    def one(self, name, shape, dtype=jnp.float32) -> SDVariable:
+        return self.constant(jnp.ones(shape, dtype), name)
+
+    def tensor_array(self, capacity, element_shape, dtype=jnp.float32):
+        return TensorArray(self, capacity, element_shape, dtype)
+
+    # ------------------------------------------------------------------
+    # recording
+    # ------------------------------------------------------------------
+    def _coerce(self, x) -> Any:
+        """SDVariable passes through; arrays become constants; python
+        scalars/sequences stay literal (static attrs)."""
+        if isinstance(x, SDVariable):
+            if x.sd is not self:
+                raise ValueError(f"variable {x.name!r} belongs to another "
+                                 "SameDiff instance")
+            return x
+        if isinstance(x, (np.ndarray, jnp.ndarray)):
+            return self.constant(x)
+        return x
+
+    def _record(self, op_name: str, args: Sequence[Any],
+                kwargs: Dict[str, Any], name: Optional[str] = None,
+                n_out: Optional[int] = None):
+        kwargs = dict(kwargs)
+        # reference-style leading name: sd.math.add("z", x, y) and
+        # name= kwarg both name the output variable
+        name = kwargs.pop("name", name)
+        if args and isinstance(args[0], str):
+            name, args = args[0], args[1:]
+        n_out = kwargs.pop("n_out", n_out)
+        resolved = _resolve(op_name)
+        o = catalog.get(resolved)
+        inputs: List[str] = []
+        template: List[Any] = []
+        for a in args:
+            a = self._coerce(a)
+            if isinstance(a, SDVariable):
+                template.append(("$", len(inputs)))
+                inputs.append(a.name)
+            else:
+                template.append(a)
+        kw = {}
+        kw_inputs: Dict[str, int] = {}
+        for k, vv in kwargs.items():
+            vv = self._coerce(vv) if isinstance(
+                vv, (SDVariable, np.ndarray, jnp.ndarray)) else vv
+            if isinstance(vv, SDVariable):
+                kw_inputs[k] = len(inputs)
+                inputs.append(vv.name)
+            else:
+                kw[k] = vv
+        if kw_inputs:
+            kw["__kw_inputs__"] = kw_inputs
+
+        out_structs = self._infer(resolved, template, kw, inputs)
+        if out_structs is None:
+            count = n_out or _N_OUT.get(resolved, 1)
+            out_structs = [None] * count
+        base = name or self._name(resolved.replace("legacy.", ""))
+        out_names: List[str] = []
+        out_vars: List[SDVariable] = []
+        for i, ss in enumerate(out_structs):
+            nm = base if i == 0 else f"{base}:{i}"
+            shape, dt = ss if ss is not None else (None, None)
+            out_vars.append(self._add_var(nm, VariableType.ARRAY, shape, dt))
+            out_names.append(nm)
+        node = _Node(resolved, inputs, out_names, template, kw)
+        self._nodes.append(node)
+        for nm in out_names:
+            self._producer[nm] = node
+        self._fn_cache.clear()
+        return out_vars[0] if len(out_vars) == 1 else tuple(out_vars)
+
+    def _infer(self, resolved, template, kw, inputs):
+        """Output shape/arity inference via abstract evaluation
+        (ref: DeclarableOp::calculateOutputShape,
+        `impl/DeclarableOp.cpp:183`). Returns a list of (shape, dtype)
+        pairs — shape dims that derive from batch-polymorphic (None)
+        input dims are restored to None — or None when inference is not
+        possible (unknown input shapes, random/list ops)."""
+        o = catalog.get(resolved)
+        if o.category == "random" or o.category == "list":
+            return None
+        any_dynamic = False
+        for nm in inputs:
+            v = self._vars[nm]
+            if v.shape is None or v.dtype is None:
+                return None
+            any_dynamic = any_dynamic or any(s is None for s in v.shape)
+
+        def call(*xs):
+            args = [xs[t[1]] if isinstance(t, tuple) and len(t) == 2
+                    and t[0] == "$" else t for t in template]
+            kws = {k: v for k, v in kw.items() if k != "__kw_inputs__"}
+            for k, i in kw.get("__kw_inputs__", {}).items():
+                kws[k] = xs[i]
+            return o.fn(*args, **kws)
+
+        def probe(subst):
+            structs = [jax.ShapeDtypeStruct(
+                tuple(subst if s is None else s for s in self._vars[nm].shape),
+                self._vars[nm].dtype) for nm in inputs]
+            res = jax.eval_shape(call, *structs)
+            return list(res) if isinstance(res, (tuple, list)) else [res]
+
+        try:
+            res_a = probe(2)
+            if not any_dynamic:
+                return [(r.shape, r.dtype) for r in res_a]
+            # probe twice with different substitutions: output dims that
+            # track the substitution are batch-derived -> None
+            res_b = probe(3)
+        except Exception:
+            return None
+        out = []
+        for a, b in zip(res_a, res_b):
+            if len(a.shape) != len(b.shape):
+                out.append((None, a.dtype))
+            else:
+                out.append((tuple(None if da != db else da
+                                  for da, db in zip(a.shape, b.shape)),
+                            a.dtype))
+        return out
+
+    # ------------------------------------------------------------------
+    # control flow (ref: InferenceSession Enter/Exit/Merge/Switch/While)
+    # ------------------------------------------------------------------
+    def _subgraph(self, fn, arg_vars: Sequence[SDVariable],
+                  extra_shapes: Sequence[Tuple] = ()):
+        child = SameDiff()
+        child.seed = self.seed
+        phs = []
+        for i, v in enumerate(arg_vars):
+            phs.append(child.placeholder(f"__arg{i}", v.shape,
+                                         v.dtype or jnp.float32))
+        outs = fn(child, *phs)
+        if isinstance(outs, SDVariable):
+            outs = (outs,)
+        return child, [o.name for o in outs]
+
+    def cond(self, pred: SDVariable, true_fn, false_fn,
+             inputs: Sequence[SDVariable], name=None):
+        """`lax.cond`-lowered conditional. true_fn/false_fn:
+        (child_sd, *args) -> SDVariable(s). (Ref: SameDiff.ifCond /
+        Switch+Merge frames.)"""
+        inputs = [self._coerce(x) for x in inputs]
+        child_t, outs_t = self._subgraph(true_fn, inputs)
+        child_f, outs_f = self._subgraph(false_fn, inputs)
+        if len(outs_t) != len(outs_f):
+            raise ValueError("cond branches must return the same arity")
+        base = name or self._name("cond")
+        out_names = [base if i == 0 else f"{base}:{i}"
+                     for i in range(len(outs_t))]
+        out_vars = []
+        for i, nm in enumerate(out_names):
+            tv = child_t._vars[outs_t[i]]
+            out_vars.append(self._add_var(nm, VariableType.ARRAY,
+                                          tv.shape, tv.dtype))
+        node = _Node("__cond", [pred.name] + [v.name for v in inputs],
+                     out_names, [], {},
+                     subgraphs={"true": (child_t, outs_t),
+                                "false": (child_f, outs_f)})
+        self._nodes.append(node)
+        for nm in out_names:
+            self._producer[nm] = node
+        self._fn_cache.clear()
+        return out_vars[0] if len(out_vars) == 1 else tuple(out_vars)
+
+    if_cond = cond
+
+    def while_loop(self, cond_fn, body_fn, init: Sequence[SDVariable],
+                   name=None):
+        """`lax.while_loop`-lowered loop. cond_fn: (sd, *carry) -> scalar
+        bool SDVariable; body_fn: (sd, *carry) -> new carry.
+        (Ref: SameDiff.whileLoop / Enter-Exit-NextIteration frames.)"""
+        init = [self._coerce(x) for x in init]
+        child_c, outs_c = self._subgraph(cond_fn, init)
+        if len(outs_c) != 1:
+            raise ValueError("while cond must return one scalar")
+        child_b, outs_b = self._subgraph(body_fn, init)
+        if len(outs_b) != len(init):
+            raise ValueError("while body must return the carry arity")
+        base = name or self._name("while")
+        out_names = [base if i == 0 else f"{base}:{i}"
+                     for i in range(len(init))]
+        out_vars = []
+        for i, nm in enumerate(out_names):
+            out_vars.append(self._add_var(nm, VariableType.ARRAY,
+                                          init[i].shape, init[i].dtype))
+        node = _Node("__while", [v.name for v in init], out_names, [], {},
+                     subgraphs={"cond": (child_c, outs_c),
+                                "body": (child_b, outs_b)})
+        self._nodes.append(node)
+        for nm in out_names:
+            self._producer[nm] = node
+        self._fn_cache.clear()
+        return out_vars[0] if len(out_vars) == 1 else tuple(out_vars)
+
+    def scan(self, body_fn, init: Sequence[SDVariable],
+             xs: Sequence[SDVariable], name=None):
+        """`lax.scan` over the leading axis of `xs`. body_fn:
+        (sd, carry..., x_slice...) -> (new_carry..., y...). Returns
+        (final_carry..., stacked_y...). The reference reaches this
+        semantics via TensorArray + while frames; scan is the TPU-native
+        form (static trip count, fused)."""
+        init = [self._coerce(x) for x in init]
+        xs = [self._coerce(x) for x in xs]
+        n_carry = len(init)
+        slices = []
+        for x in xs:
+            if x.shape is None:
+                raise ValueError("scan inputs need known shapes")
+            slices.append(SDVariable(self, "__tmp", VariableType.ARRAY,
+                                     x.shape[1:], x.dtype))
+        child, out_names = self._subgraph(body_fn, list(init) + slices)
+        n_y = len(out_names) - n_carry
+        if n_y < 0:
+            raise ValueError("scan body must return at least the carry")
+        length = xs[0].shape[0] if xs else None
+        base = name or self._name("scan")
+        all_names, out_vars = [], []
+        for i in range(len(out_names)):
+            nm = base if i == 0 else f"{base}:{i}"
+            cv = child._vars[out_names[i]]
+            if i < n_carry:
+                shape, dt = init[i].shape, init[i].dtype
+            else:
+                shape = ((length,) + cv.shape) if (
+                    cv.shape is not None and length is not None) else None
+                dt = cv.dtype
+            out_vars.append(self._add_var(nm, VariableType.ARRAY, shape, dt))
+            all_names.append(nm)
+        node = _Node("__scan", [v.name for v in init + xs], all_names, [],
+                     {"n_carry": n_carry, "n_xs": len(xs)},
+                     subgraphs={"body": (child, out_names)})
+        self._nodes.append(node)
+        for nm in all_names:
+            self._producer[nm] = node
+        self._fn_cache.clear()
+        return out_vars[0] if len(out_vars) == 1 else tuple(out_vars)
+
+    # ------------------------------------------------------------------
+    # execution
+    # ------------------------------------------------------------------
+    def _plan(self, outputs: Sequence[str]) -> List[_Node]:
+        """Prune to the subgraph needed for `outputs` (ref:
+        AbstractSession subgraph determination :26-80)."""
+        needed: List[_Node] = []
+        seen = set()
+        stack = list(outputs)
+        want = set()
+        while stack:
+            nm = stack.pop()
+            if nm in want:
+                continue
+            want.add(nm)
+            node = self._producer.get(nm)
+            if node is not None and id(node) not in seen:
+                seen.add(id(node))
+                stack.extend(node.inputs)
+        for node in self._nodes:  # recorded order is topological
+            if id(node) in seen:
+                needed.append(node)
+        return needed
+
+    def _child_closure(self, child: "SameDiff", out_names, env_keys):
+        """Build an executor for a control-flow subgraph; child constants/
+        variables are closed over."""
+        cfn = child._build(tuple(out_names))
+
+        def run(args, rng):
+            vals = dict(child._values)
+            for i, a in enumerate(args):
+                vals[f"__arg{i}"] = a
+            return cfn(vals, rng)
+        return run
+
+    def _build(self, outputs: Tuple[str, ...]) -> Callable:
+        """Compile-ready pure function over (values, rng). This is the
+        whole-graph lowering that replaces InferenceSession's per-op
+        dispatch."""
+        if outputs in self._fn_cache:
+            return self._fn_cache[outputs]
+        plan = self._plan(outputs)
+        missing = [nm for nm in outputs
+                   if nm not in self._vars]
+        if missing:
+            raise KeyError(f"unknown output variables {missing}")
+
+        op_objs = {n.op: catalog.get(n.op) for n in plan
+                   if n.op not in _CONTROL_OPS}
+        subruns: Dict[int, Dict[str, Callable]] = {}
+        for n in plan:
+            if n.subgraphs:
+                subruns[id(n)] = {
+                    k: self._child_closure(child, onames, None)
+                    for k, (child, onames) in n.subgraphs.items()}
+
+        def fn(values: Dict[str, Any], rng):
+            env = dict(values)
+            for i, node in enumerate(plan):
+                key = jax.random.fold_in(rng, i)
+                if node.op == "__cond":
+                    pred = env[node.inputs[0]]
+                    args = [env[nm] for nm in node.inputs[1:]]
+                    res = jax.lax.cond(
+                        jnp.asarray(pred, bool).reshape(()),
+                        lambda a: tuple(subruns[id(node)]["true"](a, key)),
+                        lambda a: tuple(subruns[id(node)]["false"](a, key)),
+                        tuple(args))
+                elif node.op == "__while":
+                    carry = tuple(env[nm] for nm in node.inputs)
+
+                    def w_cond(c, _n=node):
+                        return jnp.asarray(
+                            subruns[id(_n)]["cond"](c, key)[0],
+                            bool).reshape(())
+
+                    def w_body(c, _n=node):
+                        return tuple(subruns[id(_n)]["body"](c, key))
+
+                    res = jax.lax.while_loop(w_cond, w_body, carry)
+                elif node.op == "__scan":
+                    n_carry = node.kwargs["n_carry"]
+                    carry = tuple(env[nm] for nm in node.inputs[:n_carry])
+                    xs = tuple(env[nm] for nm in node.inputs[n_carry:])
+
+                    def s_body(c, x, _n=node, _nc=n_carry):
+                        outs = subruns[id(_n)]["body"](tuple(c) + tuple(x),
+                                                       key)
+                        return tuple(outs[:_nc]), tuple(outs[_nc:])
+
+                    final, ys = jax.lax.scan(s_body, carry, xs)
+                    res = tuple(final) + tuple(ys)
+                else:
+                    o = op_objs[node.op]
+                    args = [env[node.inputs[t[1]]]
+                            if isinstance(t, tuple) and len(t) == 2
+                            and t[0] == "$" else t
+                            for t in node.arg_template]
+                    kws = {k: v for k, v in node.kwargs.items()
+                           if k != "__kw_inputs__"}
+                    for k, idx in node.kwargs.get("__kw_inputs__", {}).items():
+                        kws[k] = env[node.inputs[idx]]
+                    if node.op == "dropout":
+                        # dropout takes rng as a kwarg, not first-positional
+                        res = o.fn(*args, rng=key, **kws)
+                    elif o.category == "random":
+                        res = o.fn(key, *args, **kws)
+                    else:
+                        res = o.fn(*args, **kws)
+                if len(node.outputs) == 1:
+                    env[node.outputs[0]] = res if not isinstance(
+                        res, (tuple, list)) else res[0]
+                else:
+                    for nm, r in zip(node.outputs, res):
+                        env[nm] = r
+            return [env[nm] for nm in outputs]
+
+        self._fn_cache[outputs] = fn
+        return fn
+
+    def _exec_values(self, placeholders: Dict[str, Any]) -> Dict[str, Any]:
+        vals = dict(self._values)
+        for k, v in placeholders.items():
+            vals[k] = jnp.asarray(v)
+        return vals
+
+    def output(self, placeholders: Dict[str, Any], outputs: Sequence[str],
+               rng=None) -> Dict[str, Any]:
+        """Execute the graph (ref: SameDiff.output / batchOutput)."""
+        outputs = tuple(o.name if isinstance(o, SDVariable) else o
+                        for o in outputs)
+        fn = self._build(outputs)
+        rng = rng if rng is not None else jax.random.PRNGKey(self.seed)
+        res = fn(self._exec_values(placeholders), rng)
+        return dict(zip(outputs, res))
+
+    batch_output = output
+
+    def exec(self, placeholders=None, *outputs):
+        return self.output(placeholders or {}, list(outputs))
+
+    # ------------------------------------------------------------------
+    # autodiff (ref: createGradFunction SameDiff.java:2915, execBackwards)
+    # ------------------------------------------------------------------
+    def set_loss_variables(self, *names):
+        self._loss_variables = [n.name if isinstance(n, SDVariable) else n
+                                for n in names]
+
+    setLossVariables = set_loss_variables
+
+    def _loss_fn(self, wrt: Tuple[str, ...]):
+        loss_names = tuple(self._loss_variables)
+        if not loss_names:
+            raise ValueError("no loss variables set "
+                             "(use set_loss_variables)")
+        fn = self._build(loss_names)
+
+        def loss_fn(diff_vals, nondiff_vals, rng):
+            outs = fn({**nondiff_vals, **diff_vals}, rng)
+            return sum(jnp.sum(o) for o in outs)
+        return loss_fn
+
+    def calculate_gradients(self, placeholders: Dict[str, Any],
+                            wrt: Sequence[str], rng=None) -> Dict[str, Any]:
+        """Ref: SameDiff.calculateGradients / execBackwards — gradients of
+        the summed loss variables w.r.t. `wrt`."""
+        wrt = tuple(n.name if isinstance(n, SDVariable) else n for n in wrt)
+        loss_fn = self._loss_fn(wrt)
+        vals = self._exec_values(placeholders)
+        diff = {n: vals.pop(n) for n in wrt}
+        rng = rng if rng is not None else jax.random.PRNGKey(self.seed)
+        grads = jax.grad(loss_fn)(diff, vals, rng)
+        self._grads.update(grads)
+        return grads
+
+    exec_backwards = calculate_gradients
+
+    def grad(self, name: str):
+        """Last computed gradient for a variable (ref: SDVariable.getGradient
+        after execBackwards)."""
+        name = name.name if isinstance(name, SDVariable) else name
+        return self._grads.get(name)
+
+    # ------------------------------------------------------------------
+    # training (ref: SameDiff.fit :1450-1523)
+    # ------------------------------------------------------------------
+    def set_training_config(self, cfg: TrainingConfig):
+        self._training_config = cfg
+
+    setTrainingConfig = set_training_config
+
+    def _trainable(self) -> List[str]:
+        return [n for n, v in self._vars.items()
+                if v.vtype == VariableType.VARIABLE]
+
+    def initialize_training(self):
+        """Per-variable updater state (ref: initializeTraining :1620)."""
+        cfg = self._training_config
+        if cfg is None:
+            raise ValueError("no TrainingConfig set")
+        if self._updater_state is None:
+            tvars = {n: self._values[n] for n in self._trainable()}
+            self._updater_state = cfg.updater.init_state(tvars)
+
+    def _train_step_fn(self):
+        cfg = self._training_config
+        tnames = tuple(self._trainable())
+        loss_fn = self._loss_fn(tnames)
+        updater = cfg.updater
+        l1, l2 = cfg.l1, cfg.l2
+
+        def step(tvars, upd_state, step_no, feed, rng):
+            loss, grads = jax.value_and_grad(loss_fn)(tvars, feed, rng)
+            if not cfg.minimize:
+                grads = jax.tree_util.tree_map(lambda g: -g, grads)
+            if l1 or l2:
+                # ref: BaseMultiLayerUpdater.preApply regularization :395
+                grads = jax.tree_util.tree_map(
+                    lambda g, p: g + l2 * p + l1 * jnp.sign(p), grads, tvars)
+            upd_state, updates = updater.apply(upd_state, grads, step_no)
+            tvars = jax.tree_util.tree_map(lambda p, u: p - u, tvars, updates)
+            return tvars, upd_state, loss
+
+        return jax.jit(step)
+
+    def fit(self, data, epochs: int = 1, listeners: Sequence = (),
+            key=None) -> History:
+        """Train on a DataSetIterator / iterable of (features, labels) /
+        DataSet objects. Placeholder feed follows the TrainingConfig
+        feature/label mappings (ref: SameDiff.fit :1450-1523)."""
+        cfg = self._training_config
+        if cfg is None:
+            raise ValueError("no TrainingConfig set")
+        self.initialize_training()
+        step = self._train_step_fn()
+        tnames = tuple(self._trainable())
+        tvars = {n: self._values[n] for n in tnames}
+        rng = key if key is not None else jax.random.PRNGKey(self.seed)
+        history = History()
+        nondiff = {k: v for k, v in self._values.items() if k not in tnames}
+        for epoch in range(epochs):
+            ep_losses = []
+            for batch in data:
+                feed = dict(nondiff)
+                feed.update(self._feed_from_batch(batch, cfg))
+                rng, sub = jax.random.split(rng)
+                tvars, self._updater_state, loss = step(
+                    tvars, self._updater_state, self._step, feed, sub)
+                self._step += 1
+                loss = float(loss)
+                history.loss_curve.append(loss)
+                ep_losses.append(loss)
+                for lst in listeners:
+                    if hasattr(lst, "iteration_done"):
+                        lst.iteration_done(self, self._step, epoch)
+            history.epoch_losses.append(
+                float(np.mean(ep_losses)) if ep_losses else float("nan"))
+            if hasattr(data, "reset"):
+                data.reset()
+        self._values.update(tvars)
+        return history
+
+    def _feed_from_batch(self, batch, cfg: TrainingConfig) -> Dict[str, Any]:
+        if hasattr(batch, "features"):
+            feats = batch.features
+            labs = batch.labels
+            feats = feats if isinstance(feats, (list, tuple)) else [feats]
+            labs = labs if isinstance(labs, (list, tuple)) else [labs]
+        elif isinstance(batch, (tuple, list)):
+            feats, labs = batch[0], batch[1]
+            feats = feats if isinstance(feats, (list, tuple)) else [feats]
+            labs = labs if isinstance(labs, (list, tuple)) else [labs]
+        else:
+            raise TypeError(f"unsupported batch type {type(batch)}")
+        feed = {}
+        fmap = cfg.data_set_feature_mapping
+        lmap = cfg.data_set_label_mapping
+        if not fmap or not lmap:
+            raise ValueError("TrainingConfig needs dataSetFeatureMapping "
+                             "and dataSetLabelMapping")
+        for nm, arr in zip(fmap, feats):
+            feed[nm] = jnp.asarray(arr)
+        for nm, arr in zip(lmap, labs):
+            feed[nm] = jnp.asarray(arr)
+        return feed
+
+    def evaluate(self, iterator, output_var: Union[str, SDVariable],
+                 evaluation, label_name: Optional[str] = None):
+        """Ref: SameDiff.evaluate — run forward over the iterator feeding
+        features, accumulate into the evaluation object."""
+        cfg = self._training_config
+        out = output_var.name if isinstance(output_var, SDVariable) \
+            else output_var
+        for batch in iterator:
+            feed = self._feed_from_batch(batch, cfg)
+            lname = label_name or cfg.data_set_label_mapping[0]
+            labels = feed.pop(lname)
+            preds = self.output(feed, [out])[out]
+            evaluation.eval(np.asarray(labels), np.asarray(preds))
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        return evaluation
+
+    # ------------------------------------------------------------------
+    # variable management
+    # ------------------------------------------------------------------
+    def variables(self) -> List[SDVariable]:
+        return list(self._vars.values())
+
+    def get_variable(self, name: str) -> SDVariable:
+        return self._vars[name]
+
+    def has_variable(self, name: str) -> bool:
+        return name in self._vars
+
+    def convert_to_constant(self, var: Union[str, SDVariable]):
+        """Ref: SameDiff.convertToConstant (transfer-learning freeze)."""
+        v = self._vars[var.name if isinstance(var, SDVariable) else var]
+        if v.vtype != VariableType.VARIABLE:
+            raise ValueError(f"{v.name} is {v.vtype}, not VARIABLE")
+        v.vtype = VariableType.CONSTANT
+        self._fn_cache.clear()
+        return v
+
+    def convert_to_variable(self, var: Union[str, SDVariable]):
+        v = self._vars[var.name if isinstance(var, SDVariable) else var]
+        if v.vtype != VariableType.CONSTANT:
+            raise ValueError(f"{v.name} is {v.vtype}, not CONSTANT")
+        v.vtype = VariableType.VARIABLE
+        self._fn_cache.clear()
+        self._updater_state = None
+        return v
+
+    def _rename(self, old: str, new: str):
+        if new in self._vars:
+            raise ValueError(f"{new!r} already exists")
+        v = self._vars.pop(old)
+        v.name = new
+        self._vars[new] = v
+        if old in self._values:
+            self._values[new] = self._values.pop(old)
+        for node in self._nodes:
+            node.inputs = [new if n == old else n for n in node.inputs]
+            node.outputs = [new if n == old else n for n in node.outputs]
+        if old in self._producer:
+            self._producer[new] = self._producer.pop(old)
+        self._loss_variables = [new if n == old else n
+                                for n in self._loss_variables]
+        self._fn_cache.clear()
+
+    def summary(self) -> str:
+        lines = [f"SameDiff: {len(self._vars)} variables, "
+                 f"{len(self._nodes)} ops"]
+        for v in self._vars.values():
+            lines.append(f"  {v.vtype.value:<12} {v.name:<24} "
+                         f"shape={v.shape} dtype={v.dtype}")
+        for n in self._nodes:
+            lines.append(f"  op {n.op:<24} {n.inputs} -> {n.outputs}")
+        return "\n".join(lines)
+
+    # ------------------------------------------------------------------
+    # serde (replaces FlatBuffersMapper: JSON graph + npz arrays in a zip)
+    # ------------------------------------------------------------------
+    def _to_dict(self, arrays: Dict[str, np.ndarray], prefix="") -> dict:
+        vars_d = []
+        for v in self._vars.values():
+            vars_d.append({"name": v.name, "type": v.vtype.value,
+                           "shape": list(v.shape) if v.shape is not None
+                           else None,
+                           "dtype": str(v.dtype) if v.dtype else None})
+            if v.name in self._values:
+                arrays[prefix + v.name] = np.asarray(self._values[v.name])
+        nodes_d = []
+        for i, n in enumerate(self._nodes):
+            nd = {"op": n.op, "inputs": n.inputs, "outputs": n.outputs,
+                  "args": [list(t) if isinstance(t, tuple) else t
+                           for t in n.arg_template],
+                  "kwargs": _jsonable(n.kwargs)}
+            if n.subgraphs:
+                nd["subgraphs"] = {
+                    k: {"graph": child._to_dict(
+                        arrays, f"{prefix}__sub{i}_{k}/"),
+                        "outputs": onames}
+                    for k, (child, onames) in n.subgraphs.items()}
+            nodes_d.append(nd)
+        return {"variables": vars_d, "nodes": nodes_d,
+                "lossVariables": self._loss_variables,
+                "trainingConfig": self._training_config.to_json()
+                if self._training_config else None,
+                "seed": self.seed, "step": self._step}
+
+    @staticmethod
+    def _from_dict(d: dict, arrays: Dict[str, np.ndarray],
+                   prefix="") -> "SameDiff":
+        sd = SameDiff()
+        for vd in d["variables"]:
+            v = SDVariable(sd, vd["name"], VariableType(vd["type"]),
+                           vd["shape"], vd["dtype"])
+            sd._vars[vd["name"]] = v
+            key = prefix + vd["name"]
+            if key in arrays:
+                sd._values[vd["name"]] = jnp.asarray(arrays[key])
+        for i, nd in enumerate(d["nodes"]):
+            subgraphs = None
+            if nd.get("subgraphs"):
+                subgraphs = {}
+                for k, sub in nd["subgraphs"].items():
+                    child = SameDiff._from_dict(
+                        sub["graph"], arrays, f"{prefix}__sub{i}_{k}/")
+                    subgraphs[k] = (child, sub["outputs"])
+            template = [tuple(t) if isinstance(t, list) and len(t) == 2
+                        and t[0] == "$" else t for t in nd["args"]]
+            node = _Node(nd["op"], nd["inputs"], nd["outputs"], template,
+                         nd["kwargs"], subgraphs)
+            sd._nodes.append(node)
+            for nm in node.outputs:
+                sd._producer[nm] = node
+        sd._loss_variables = d.get("lossVariables", [])
+        if d.get("trainingConfig"):
+            sd._training_config = TrainingConfig.from_json(d["trainingConfig"])
+        sd.seed = d.get("seed", 0)
+        sd._step = d.get("step", 0)
+        sd._counter = len(sd._vars) + len(sd._nodes) + 1
+        return sd
+
+    def save(self, path: str, save_updater_state: bool = False):
+        """Ref: SameDiff.save / asFlatBuffers (incl. training state)."""
+        arrays: Dict[str, np.ndarray] = {}
+        meta = self._to_dict(arrays)
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+            z.writestr("graph.json", json.dumps(meta))
+            buf = io.BytesIO()
+            np.savez(buf, **{k.replace("/", "\\"): v
+                             for k, v in arrays.items()})
+            z.writestr("arrays.npz", buf.getvalue())
+            if save_updater_state and self._updater_state is not None:
+                leaves, treedef = jax.tree_util.tree_flatten(
+                    self._updater_state)
+                ubuf = io.BytesIO()
+                np.savez(ubuf, **{f"leaf{i}": np.asarray(l)
+                                  for i, l in enumerate(leaves)})
+                z.writestr("updater.npz", ubuf.getvalue())
+
+    @staticmethod
+    def load(path: str) -> "SameDiff":
+        with zipfile.ZipFile(path) as z:
+            meta = json.loads(z.read("graph.json"))
+            with np.load(io.BytesIO(z.read("arrays.npz"))) as npz:
+                arrays = {k.replace("\\", "/"): npz[k] for k in npz.files}
+            sd = SameDiff._from_dict(meta, arrays)
+            if "updater.npz" in z.namelist() and sd._training_config:
+                sd.initialize_training()
+                leaves, treedef = jax.tree_util.tree_flatten(
+                    sd._updater_state)
+                with np.load(io.BytesIO(z.read("updater.npz"))) as npz:
+                    new_leaves = [jnp.asarray(npz[f"leaf{i}"])
+                                  for i in range(len(npz.files))]
+                if len(new_leaves) == len(leaves):
+                    sd._updater_state = jax.tree_util.tree_unflatten(
+                        treedef, new_leaves)
+        return sd
+
+    # convenience: sd.<op>(...) records directly, mirroring the reference's
+    # base-class op methods on SameDiff itself
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        try:
+            _resolve(name)
+        except AttributeError:
+            raise AttributeError(
+                f"SameDiff has no attribute/op {name!r}") from None
+        return lambda *a, **kw: self._record(name, a, kw)
+
+
+def _jsonable(d: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for k, v in d.items():
+        if isinstance(v, tuple):
+            v = list(v)
+        if isinstance(v, (np.integer,)):
+            v = int(v)
+        if isinstance(v, (np.floating,)):
+            v = float(v)
+        out[k] = v
+    return out

@@ -24,7 +24,7 @@ from jax import lax
 
 from ..conf import InputType
 from ..layers import Layer, from_json as layer_from_json
-from ..multilayer import _clip_grads
+from ..multilayer import _clip_grads, _regularization_penalty
 from ... import learning as U
 
 Params = Dict[str, Any]
@@ -559,18 +559,7 @@ class ComputationGraph:
             m = None if masks is None else masks.get(out_name)
             total = total + node.layer.compute_loss(
                 params.get(out_name, {}), feats, y, m, train=train, rng=r_out)
-        reg = 0.0
-        for key, meta in self._layers_meta.items():
-            if key not in params:
-                continue
-            for pname, w in params[key].items():
-                is_bias = pname in ("b", "beta")
-                l1 = meta["l1_bias"] if is_bias else meta["l1"]
-                l2 = meta["l2_bias"] if is_bias else meta["l2"]
-                if l2:
-                    reg = reg + 0.5 * l2 * jnp.sum(jnp.square(w))
-                if l1:
-                    reg = reg + l1 * jnp.sum(jnp.abs(w))
+        reg = _regularization_penalty(params, self._layers_meta)
         return total + reg, new_state
 
     # NOTE: output layers' loss consumes the activation of their INPUT node

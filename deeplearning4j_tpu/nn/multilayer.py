@@ -43,6 +43,24 @@ def _clip_grads(grads, max_norm, clip_value):
     return grads
 
 
+def _regularization_penalty(params, layers_meta):
+    """Ref: BaseMultiLayerUpdater.preApply :395 — L1/L2 penalty over layer
+    params; biases use the *_bias coefficients."""
+    reg = 0.0
+    for key, meta in layers_meta.items():
+        if key not in params:
+            continue
+        for pname, w in params[key].items():
+            is_bias = pname in ("b", "beta")
+            l1 = meta["l1_bias"] if is_bias else meta["l1"]
+            l2 = meta["l2_bias"] if is_bias else meta["l2"]
+            if l2:
+                reg = reg + 0.5 * l2 * jnp.sum(jnp.square(w))
+            if l1:
+                reg = reg + l1 * jnp.sum(jnp.abs(w))
+    return reg
+
+
 class MultiLayerNetwork:
     """Sequential network. Public surface mirrors the reference class."""
 
@@ -177,18 +195,7 @@ class MultiLayerNetwork:
             lmask = None
         data_loss = out_layer.compute_loss(params.get(out_key, {}), feats, y,
                                            lmask, train=train, rng=r_out)
-        reg = 0.0
-        for key, meta in self._layers_meta.items():
-            if key not in params:
-                continue
-            for pname, w in params[key].items():
-                is_bias = pname in ("b", "beta")
-                l1 = meta["l1_bias"] if is_bias else meta["l1"]
-                l2 = meta["l2_bias"] if is_bias else meta["l2"]
-                if l2:
-                    reg = reg + 0.5 * l2 * jnp.sum(jnp.square(w))
-                if l1:
-                    reg = reg + l1 * jnp.sum(jnp.abs(w))
+        reg = _regularization_penalty(params, self._layers_meta)
         return data_loss + reg, (new_state, new_carries)
 
     # -- the one true train step (jitted) ------------------------------
