@@ -41,7 +41,8 @@ def check_gradients(sd, placeholders: Dict[str, np.ndarray],
         outs = fn(vals, rng)
         return float(sum(np.sum(np.asarray(o)) for o in outs))
 
-    base_vals = sd._exec_values(placeholders)
+    base_vals = sd._filter_values(sd._exec_values(placeholders), fn,
+                                  extra=wrt)
     failures = []
     rs = np.random.RandomState(seed)
     for name in wrt:

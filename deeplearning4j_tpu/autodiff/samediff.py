@@ -153,7 +153,9 @@ class SDVariable:
     def __truediv__(self, o): return self._bin("divide", o)
     def __rtruediv__(self, o): return self._bin("divide", o, True)
     def __pow__(self, o): return self._bin("pow", o)
+    def __rpow__(self, o): return self._bin("pow", o, True)
     def __mod__(self, o): return self._bin("mod", o)
+    def __rmod__(self, o): return self._bin("mod", o, True)
     def __matmul__(self, o): return self._bin("matmul", o)
     def __neg__(self): return self.sd._record("legacy.neg", (self,), {})
     def __gt__(self, o): return self._bin("greater", o)
@@ -772,29 +774,39 @@ class SameDiff:
                         lambda a: tuple(subruns[id(node)]["false"](a, key)),
                         tuple(args))
                 elif node.op == "__while":
-                    carry = tuple(env[nm] for nm in node.inputs)
+                    # carry a step counter so random ops inside the body
+                    # get a fresh folded key each iteration
+                    carry = (jnp.asarray(0, jnp.int32),) + tuple(
+                        env[nm] for nm in node.inputs)
 
                     def w_cond(c, _n=node):
                         return jnp.asarray(
-                            subruns[id(_n)]["cond"](c, key)[0],
+                            subruns[id(_n)]["cond"](c[1:], key)[0],
                             bool).reshape(())
 
                     def w_body(c, _n=node):
-                        return tuple(subruns[id(_n)]["body"](c, key))
+                        it, rest = c[0], c[1:]
+                        outs = subruns[id(_n)]["body"](
+                            rest, jax.random.fold_in(key, it))
+                        return (it + 1,) + tuple(outs)
 
-                    res = jax.lax.while_loop(w_cond, w_body, carry)
+                    res = jax.lax.while_loop(w_cond, w_body, carry)[1:]
                 elif node.op == "__scan":
                     n_carry = node.kwargs["n_carry"]
-                    carry = tuple(env[nm] for nm in node.inputs[:n_carry])
+                    carry = (jnp.asarray(0, jnp.int32),) + tuple(
+                        env[nm] for nm in node.inputs[:n_carry])
                     xs = tuple(env[nm] for nm in node.inputs[n_carry:])
 
                     def s_body(c, x, _n=node, _nc=n_carry):
-                        outs = subruns[id(_n)]["body"](tuple(c) + tuple(x),
-                                                       key)
-                        return tuple(outs[:_nc]), tuple(outs[_nc:])
+                        it, rest = c[0], c[1:]
+                        outs = subruns[id(_n)]["body"](
+                            tuple(rest) + tuple(x),
+                            jax.random.fold_in(key, it))
+                        return ((it + 1,) + tuple(outs[:_nc]),
+                                tuple(outs[_nc:]))
 
                     final, ys = jax.lax.scan(s_body, carry, xs)
-                    res = tuple(final) + tuple(ys)
+                    res = tuple(final[1:]) + tuple(ys)
                 else:
                     o = op_objs[node.op]
                     args = [env[node.inputs[t[1]]]
@@ -820,8 +832,33 @@ class SameDiff:
                         env[nm] = r
             return [env[nm] for nm in outputs]
 
-        self._fn_cache[outputs] = fn
-        return fn
+        # whole-graph compilation: everything XLA-expressible goes through
+        # jit (one fused program per shape signature); graphs touching the
+        # host-side eager list ops stay uncompiled
+        needed = set(outputs)
+        for node in plan:
+            needed.update(node.inputs)
+        needed -= {nm for node in plan for nm in node.outputs}
+        jittable = all(o.category != "list" for o in op_objs.values())
+        if jittable:
+            jitted = jax.jit(fn)
+
+            def out_fn(values, rng):
+                return jitted(values, rng)
+        else:
+            out_fn = fn
+        out_fn.needed = frozenset(needed)
+        self._fn_cache[outputs] = out_fn
+        return out_fn
+
+    def _filter_values(self, vals, fn, extra=()):
+        keep = set(fn.needed) | set(extra)
+        missing = [nm for nm in fn.needed
+                   if nm not in vals
+                   and self._vars[nm].vtype == VariableType.PLACEHOLDER]
+        if missing:
+            raise ValueError(f"missing placeholder values for {missing}")
+        return {k: v for k, v in vals.items() if k in keep}
 
     def _exec_values(self, placeholders: Dict[str, Any]) -> Dict[str, Any]:
         vals = dict(self._values)
@@ -836,7 +873,8 @@ class SameDiff:
                         for o in outputs)
         fn = self._build(outputs)
         rng = rng if rng is not None else jax.random.PRNGKey(self.seed)
-        res = fn(self._exec_values(placeholders), rng)
+        vals = self._filter_values(self._exec_values(placeholders), fn)
+        res = fn(vals, rng)
         return dict(zip(outputs, res))
 
     batch_output = output
@@ -863,6 +901,7 @@ class SameDiff:
         def loss_fn(diff_vals, nondiff_vals, rng):
             outs = fn({**nondiff_vals, **diff_vals}, rng)
             return sum(jnp.sum(o) for o in outs)
+        loss_fn.needed = fn.needed
         return loss_fn
 
     def calculate_gradients(self, placeholders: Dict[str, Any],
@@ -871,7 +910,8 @@ class SameDiff:
         the summed loss variables w.r.t. `wrt`."""
         wrt = tuple(n.name if isinstance(n, SDVariable) else n for n in wrt)
         loss_fn = self._loss_fn(wrt)
-        vals = self._exec_values(placeholders)
+        vals = self._filter_values(self._exec_values(placeholders),
+                                   loss_fn, extra=wrt)
         diff = {n: vals.pop(n) for n in wrt}
         rng = rng if rng is not None else jax.random.PRNGKey(self.seed)
         grads = jax.grad(loss_fn)(diff, vals, rng)
@@ -942,7 +982,9 @@ class SameDiff:
         tvars = {n: self._values[n] for n in tnames}
         rng = key if key is not None else jax.random.PRNGKey(self.seed)
         history = History()
-        nondiff = {k: v for k, v in self._values.items() if k not in tnames}
+        needed = self._loss_fn(tnames).needed
+        nondiff = {k: v for k, v in self._values.items()
+                   if k not in tnames and k in needed}
         for epoch in range(epochs):
             ep_losses = []
             for batch in data:
@@ -1025,6 +1067,7 @@ class SameDiff:
             raise ValueError(f"{v.name} is {v.vtype}, not VARIABLE")
         v.vtype = VariableType.CONSTANT
         self._fn_cache.clear()
+        self._updater_state = None  # trainable set changed
         return v
 
     def convert_to_variable(self, var: Union[str, SDVariable]):

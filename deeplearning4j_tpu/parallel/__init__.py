@@ -20,6 +20,17 @@ map:
 - DummyTransport loopback tests                   → virtual CPU mesh via
   --xla_force_host_platform_device_count (tests/conftest.py)
 - ParallelInference                               → :class:`ParallelInference`
+
+Beyond the reference (absent there per SURVEY.md §2.4, first-class here):
+- sequence parallel / long context → :mod:`.longseq` (ring_attention,
+  blockwise_attention)
+- tensor parallel                  → :mod:`.tensor` (Megatron column/row)
+- pipeline parallel                → :mod:`.pipeline` (GPipe microbatching)
+- expert parallel                  → :mod:`.moe` (Switch top-1, all_to_all)
+- threshold+residual compression   → :mod:`.compression` (the reference's
+  Strom-2015 pipeline, re-scoped to the DCN path)
+- the composed 4D flagship         → :mod:`.transformer`
+  (DistributedTransformer over a ("dp","sp","pp","tp") mesh)
 """
 from __future__ import annotations
 
@@ -146,3 +157,15 @@ class ParallelInference:
         with self.mesh:
             return self._jit_out(m._params, m._net_state,
                                  m._reshape_input(jnp.asarray(x)))
+
+
+from .compression import (EncodedGradientsAccumulator, EncodingHandler,
+                          LoopbackBus, threshold_decode, threshold_encode,
+                          topk_decode, topk_encode)
+from .longseq import (blockwise_attention, dot_product_attention,
+                      ring_attention)
+from .moe import moe_ffn
+from .pipeline import pipeline_apply, stack_stage_params
+from .tensor import (all_gather_features, column_parallel_matmul,
+                     reduce_scatter_features, row_parallel_matmul, tp_mlp)
+from .transformer import DistributedTransformer, make_4d_mesh

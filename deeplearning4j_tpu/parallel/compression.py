@@ -1,0 +1,182 @@
+"""Threshold gradient compression + residual carry + update bus.
+
+Ref: the Strom-2015 quantized-update pipeline in the reference —
+`EncodingHandler.java:51` (threshold encode), `ResidualPostProcessor`
+(`accumulation/encoding/`), `EncodedGradientsAccumulator.java:59`
+(applyUpdate :286, externalSource :312), native encode kernels
+(`NativeOpExecutioner.thresholdEncode` :1328), and the adaptive
+`ThresholdAlgorithm` variants.
+
+TPU scoping (SURVEY.md §2.4/§5.8): ON-slice, ICI bandwidth makes
+compression pointless — the compiled psum is the data plane. Compression
+survives as the CROSS-slice/DCN option: updates leave the device anyway,
+so the host-side encode here rides along, and the loopback bus mirrors
+the reference's DummyTransport test philosophy (§4.2). A fixed-k
+(top-k) jit-side variant is provided for in-graph use where static
+shapes are required.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# host-side (DCN path): exact threshold encoding, variable length
+# ---------------------------------------------------------------------------
+def threshold_encode(update: np.ndarray, threshold: float):
+    """Encode |u|>=t entries as a flat int64 index array with the sign in
+    the low bit (ref encoding: compressed integer stream). Returns
+    (encoded indices, residual) — residual = update - decoded(encoded)."""
+    flat = np.asarray(update).ravel()
+    mask = np.abs(flat) >= threshold
+    idx = np.nonzero(mask)[0]
+    neg = (flat[idx] < 0).astype(np.int64)
+    encoded = (idx.astype(np.int64) << 1) | neg
+    residual = flat.copy()
+    residual[idx] -= np.where(neg == 1, -threshold, threshold)
+    return encoded, residual.reshape(update.shape)
+
+
+def threshold_decode(encoded: np.ndarray, shape, threshold: float,
+                     out: Optional[np.ndarray] = None) -> np.ndarray:
+    """Decode into a dense array (accumulating into `out` if given)."""
+    if out is None:
+        out = np.zeros(int(np.prod(shape)), np.float32)
+    else:
+        out = out.ravel()
+    idx = (encoded >> 1).astype(np.int64)
+    sign = np.where((encoded & 1) == 1, -1.0, 1.0).astype(np.float32)
+    np.add.at(out, idx, sign * threshold)
+    return out.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# jit-side: fixed-k sparsification (static shapes for in-graph use)
+# ---------------------------------------------------------------------------
+def topk_encode(update, k: int):
+    """Keep the k largest-magnitude entries (jit-friendly static size).
+    Returns (indices [k] int32, values [k], residual)."""
+    flat = update.ravel()
+    vals, idx = jax.lax.top_k(jnp.abs(flat), k)
+    kept = flat[idx]
+    residual = flat.at[idx].set(0.0).reshape(update.shape)
+    return idx.astype(jnp.int32), kept, residual
+
+
+def topk_decode(indices, values, shape):
+    return jnp.zeros(int(np.prod(shape)),
+                     values.dtype).at[indices].add(values).reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# adaptive threshold (ref: ThresholdAlgorithm + AdaptiveThresholdAlgorithm)
+# ---------------------------------------------------------------------------
+class EncodingHandler:
+    """Per-worker encode pipeline with residual carry and adaptive
+    threshold targeting a sparsity band (ref: `EncodingHandler.java:51`,
+    `AdaptiveThresholdAlgorithm`)."""
+
+    def __init__(self, threshold: float = 1e-3,
+                 min_sparsity: float = 1e-4, max_sparsity: float = 1e-2,
+                 adapt_factor: float = 1.2):
+        self.threshold = float(threshold)
+        self.min_sparsity = min_sparsity
+        self.max_sparsity = max_sparsity
+        self.adapt_factor = adapt_factor
+        self._residual: Optional[np.ndarray] = None
+        self.last_sparsity = 0.0
+
+    def encode(self, update: np.ndarray) -> np.ndarray:
+        u = np.asarray(update, np.float32)
+        if self._residual is not None:
+            u = u + self._residual
+        encoded, self._residual = threshold_encode(u, self.threshold)
+        self.last_sparsity = encoded.size / max(u.size, 1)
+        # adapt: re-target the threshold to the |u| quantile that lands in
+        # the sparsity band (converges in one step, unlike a fixed
+        # multiplicative nudge on wildly mis-scaled initial thresholds)
+        if not (self.min_sparsity <= self.last_sparsity
+                <= self.max_sparsity):
+            target = 0.5 * (self.min_sparsity + self.max_sparsity)
+            q = float(np.quantile(np.abs(u), 1.0 - target))
+            if q > 0:
+                self.threshold = q
+        return encoded
+
+    def residual(self) -> Optional[np.ndarray]:
+        return self._residual
+
+
+# ---------------------------------------------------------------------------
+# update bus (ref: EncodedGradientsAccumulator + IndexedTail + transports)
+# ---------------------------------------------------------------------------
+class LoopbackBus:
+    """In-process broadcast bus — the test fake standing in for the DCN
+    transport (ref: `DummyTransport.java`, SURVEY.md §4.2). Thread-safe;
+    each node sees every other node's messages exactly once (ref:
+    `IndexedTail` fan-out queue semantics)."""
+
+    def __init__(self):
+        self._queues: Dict[int, deque] = {}
+        self._lock = threading.Lock()
+
+    def register(self, node_id: int):
+        with self._lock:
+            self._queues[node_id] = deque()
+
+    def broadcast(self, sender: int, message):
+        with self._lock:
+            for nid, q in self._queues.items():
+                if nid != sender:
+                    q.append((sender, message))
+
+    def drain(self, node_id: int) -> List:
+        with self._lock:
+            q = self._queues[node_id]
+            out = list(q)
+            q.clear()
+        return out
+
+
+class EncodedGradientsAccumulator:
+    """Gradient-sharing endpoint for one worker (ref:
+    `EncodedGradientsAccumulator.java:59`): local updates are threshold-
+    encoded (with residual carry) and broadcast; external updates are
+    decoded and accumulated, then folded into the next step via
+    `apply_update` (ref: applyUpdate :286 / externalSource :312 feeding
+    `StochasticGradientDescent.optimize:53-60`)."""
+
+    def __init__(self, node_id: int, bus: LoopbackBus, shapes: Dict,
+                 threshold: float = 1e-3, **handler_kw):
+        self.node_id = node_id
+        self.bus = bus
+        bus.register(node_id)
+        self.shapes = shapes
+        self.handlers = {k: EncodingHandler(threshold, **handler_kw)
+                         for k in shapes}
+
+    def store_update(self, grads: Dict[str, np.ndarray]):
+        """Encode + broadcast this worker's update (the worker applies its
+        own update locally, like the reference)."""
+        msg = {}
+        for k, g in grads.items():
+            h = self.handlers[k]
+            thr = h.threshold  # capture BEFORE encode() adapts it
+            msg[k] = (h.encode(np.asarray(g)), thr)
+        self.bus.broadcast(self.node_id, msg)
+
+    def apply_update(self, grads: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """Fold queued external updates into `grads` (decoded sum)."""
+        out = {k: np.asarray(g, np.float32).copy() for k, g in grads.items()}
+        for _, msg in self.bus.drain(self.node_id):
+            for k, (encoded, thr) in msg.items():
+                # sender adapts its threshold AFTER encoding; decode with
+                # the threshold that produced the message
+                threshold_decode(encoded, self.shapes[k], thr, out[k])
+        return out

@@ -1,0 +1,171 @@
+"""Long-sequence attention: blockwise (single-chip) and ring (sequence-
+parallel) attention.
+
+The reference has NO attention op and no sequence parallelism (SURVEY.md
+§5.7 — long sequences are handled by truncated BPTT + masks only). This
+module is the TPU-native capability that replaces that gap for
+long-context work, per the ring-attention / blockwise-parallel-transformer
+construction (Liu et al., 2023; see PAPERS.md): the sequence is sharded
+over a mesh axis, K/V blocks rotate around the ring via
+`lax.ppermute` while each device accumulates its queries' attention with
+numerically-stable log-sum-exp rescaling — memory per device stays
+O(T_local), communication overlaps with compute, and the whole loop is a
+`lax.scan` so it is reverse-differentiable and compiles to one XLA
+program.
+
+All accumulation is float32 regardless of input dtype (bf16-safe).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def match_vma(x, *refs):
+    """Promote `x` to vary over the union of the manual axes the `refs`
+    (arrays or pytrees) vary over — needed for scan carries inside
+    shard_map: constant inits are 'unvarying' and must be pvary'd to
+    match varying loop outputs. No-op outside shard_map."""
+    vma = set()
+    for ref in refs:
+        for leaf in jax.tree_util.tree_leaves(ref):
+            v = getattr(leaf, "vma", None)  # ShapeDtypeStruct carries it
+            if v is None:
+                try:
+                    v = jax.typeof(leaf).vma
+                except Exception:
+                    continue
+            vma |= set(v)
+    try:
+        vma -= set(jax.typeof(x).vma)  # only add the missing axes
+    except Exception:
+        pass
+    if vma:
+        return jax.lax.pcast(x, tuple(sorted(vma)), to="varying")
+    return x
+
+
+def _attn_block(q, k, v, bias, m_prev, l_prev, o_prev):
+    """One (q-block, kv-block) update of stable softmax accumulation.
+
+    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; bias: [Tq, Tk] additive or None.
+    Carries m (running max) [B, H, Tq], l (running denom) [B, H, Tq],
+    o (running numerator) [B, Tq, H, D]. Everything f32.
+    """
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if bias is not None:
+        s = s + bias[None, None, :, :]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    corr = jnp.exp(m_prev - m_new)
+    l_new = l_prev * corr + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    o_new = o_prev * jnp.transpose(corr, (0, 2, 1))[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def _finalize(m, l, o):
+    return o / jnp.transpose(l, (0, 2, 1))[..., None]
+
+
+def _init_carry(q):
+    B, Tq, H, D = q.shape
+    return (jnp.full((B, H, Tq), _NEG_INF, jnp.float32),
+            jnp.zeros((B, H, Tq), jnp.float32),
+            jnp.zeros((B, Tq, H, D), jnp.float32))
+
+
+def blockwise_attention(q, k, v, block_size: int = 512,
+                        causal: bool = False):
+    """Memory-efficient chunked attention on one device.
+
+    q/k/v: [B, T, H, D]. K/V are processed in `block_size` chunks under a
+    `lax.scan`, so peak memory is O(T * block) instead of O(T^2). Exact
+    (not an approximation) thanks to LSE rescaling."""
+    B, T, H, D = q.shape
+    nb = -(-T // block_size)
+    pad = nb * block_size - T
+    if pad:
+        kp = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        vp = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    else:
+        kp, vp = k, v
+    kb = kp.reshape(B, nb, block_size, H, D).transpose(1, 0, 2, 3, 4)
+    vb = vp.reshape(B, nb, block_size, H, D).transpose(1, 0, 2, 3, 4)
+    q_pos = jnp.arange(T)
+
+    def step(carry, inp):
+        j, kj, vj = inp
+        k_pos = j * block_size + jnp.arange(block_size)
+        bias = jnp.where(k_pos[None, :] >= T, _NEG_INF, 0.0)
+        if causal:
+            bias = bias + jnp.where(k_pos[None, :] > q_pos[:, None],
+                                    _NEG_INF, 0.0)
+        m, l, o = _attn_block(q, kj, vj, bias, *carry)
+        return (m, l, o), None
+
+    carry, _ = lax.scan(step, _init_carry(q),
+                        (jnp.arange(nb), kb, vb))
+    return _finalize(*carry).astype(q.dtype)
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False):
+    """Sequence-parallel exact attention over a mesh axis.
+
+    Call INSIDE shard_map with the sequence dimension sharded over
+    `axis_name`: q/k/v are the local [B, T_local, H, D] shards. Each of
+    the S ring steps attends the local queries to one K/V block, then
+    rotates K/V to the next device with `lax.ppermute` — after S steps
+    every query has seen every key. Global causal masking uses the ring
+    position to recover absolute token positions."""
+    S = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    Tl = q.shape[1]
+    q_pos = my * Tl + jnp.arange(Tl)
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def step(carry, j):
+        m, l, o, kj, vj = carry
+        # after j forward rotations, this device holds the block that
+        # originated on device (my - j) mod S
+        src = (my - j) % S
+        if causal:
+            k_pos = src * Tl + jnp.arange(Tl)
+            bias = jnp.where(k_pos[None, :] > q_pos[:, None], _NEG_INF, 0.0)
+        else:
+            bias = None
+        m, l, o = _attn_block(q, kj, vj, bias, m, l, o)
+        kj = lax.ppermute(kj, axis_name, perm)
+        vj = lax.ppermute(vj, axis_name, perm)
+        return (m, l, o, kj, vj), None
+
+    m0, l0, o0 = (match_vma(c, q) for c in _init_carry(q))
+    (m, l, o, _, _), _ = lax.scan(step, (m0, l0, o0, k, v),
+                                  jnp.arange(S))
+    return _finalize(m, l, o).astype(q.dtype)
+
+
+def dot_product_attention(q, k, v, mask=None, causal: bool = False):
+    """Plain fused attention (the XLA-fusible reference path for short
+    sequences). q/k/v: [B, T, H, D]; mask: broadcastable to
+    [B, H, Tq, Tk], True = keep."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        Tq, Tk = s.shape[-2], s.shape[-1]
+        cm = jnp.arange(Tk)[None, :] <= jnp.arange(Tq)[:, None]
+        s = jnp.where(cm[None, None], s, _NEG_INF)
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)

@@ -1,0 +1,66 @@
+"""Expert parallelism (EP): Switch-style top-1 MoE with all_to_all
+dispatch.
+
+The reference has NO expert parallelism (SURVEY.md §2.4 — absent). New
+TPU-native capability following the Mesh-TensorFlow/Switch dense-dispatch
+recipe: tokens pick an expert via a learned router, are packed into
+fixed-capacity buckets (static shapes — XLA-friendly), exchanged across
+the ep mesh axis with `lax.all_to_all`, processed by the local experts,
+and returned. Dropped-token overflow and the load-balancing auxiliary
+loss follow Switch Transformer (Fedus et al., 2021; see PAPERS.md).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def moe_ffn(x, wg, w1, b1, w2, b2, axis_name: str,
+            capacity_factor: float = 1.5, activation=jax.nn.gelu):
+    """Top-1 routed expert FFN. Call INSIDE shard_map over the ep axis.
+
+    x: [N_local, d] local tokens; wg: [d, E] router (replicated);
+    w1/b1: [E_local, d, f]/[E_local, f] LOCAL expert shards;
+    w2/b2: [E_local, f, d]/[E_local, d].
+    Returns (y [N_local, d], aux_loss scalar).
+    """
+    S = lax.psum(1, axis_name)
+    E_local = w1.shape[0]
+    E = E_local * S
+    N = x.shape[0]
+    C = max(1, int(capacity_factor * N / E))
+
+    logits = x.astype(jnp.float32) @ wg.astype(jnp.float32)   # [N, E]
+    gates = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(gates, axis=-1)                        # [N]
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)      # [N, E]
+
+    # Switch load-balancing loss: E * sum_e fraction_tokens_e * mean_gate_e
+    density = onehot.mean(axis=0)
+    density_proxy = gates.mean(axis=0)
+    aux_loss = E * jnp.sum(density * density_proxy)
+
+    # position of each token within its expert's bucket; overflow dropped
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot           # [N, E]
+    keep = (pos < C) & (onehot > 0)
+    pos_idx = pos.sum(axis=-1).astype(jnp.int32)                # [N]
+    dispatch = (keep[..., None].astype(jnp.float32)
+                * jax.nn.one_hot(pos_idx, C,
+                                 dtype=jnp.float32)[:, None, :])  # [N, E, C]
+    gate_val = (gates * onehot).sum(axis=-1)                    # [N]
+
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch,
+                           x.astype(jnp.float32))               # [E, C, d]
+    # exchange: every rank keeps its E_local experts, gains all ranks'
+    # tokens for them -> [E_local, S*C, d]
+    expert_in = lax.all_to_all(expert_in, axis_name,
+                               split_axis=0, concat_axis=1, tiled=True)
+    h = activation(jnp.einsum("ecd,edf->ecf", expert_in,
+                              w1.astype(jnp.float32)) + b1[:, None, :])
+    y = jnp.einsum("ecf,efd->ecd", h,
+                   w2.astype(jnp.float32)) + b2[:, None, :]
+    y = lax.all_to_all(y, axis_name, split_axis=1, concat_axis=0,
+                       tiled=True)                               # [E, C, d]
+    out = jnp.einsum("nec,ecd->nd", dispatch, y) * gate_val[:, None]
+    return out.astype(x.dtype), aux_loss

@@ -1,0 +1,76 @@
+"""Pipeline parallelism (PP): GPipe-style microbatched stage pipeline.
+
+The reference has NO pipeline parallelism (SURVEY.md §2.4 — absent). New
+TPU-native capability: homogeneous stages are sharded over a mesh axis
+(one stage per pp-rank, stage params stacked on a leading [n_stages, ...]
+dim), microbatches flow stage-to-stage via `lax.ppermute`, and the whole
+schedule (fill + steady state + drain = n_micro + S - 1 ticks) is a
+`lax.scan`, so it compiles to one XLA program and is
+reverse-differentiable (the backward pipeline falls out of the scan/
+ppermute transpose rules).
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .longseq import match_vma
+
+
+def stack_stage_params(params_list):
+    """Stack per-stage param pytrees onto a leading [n_stages, ...] axis —
+    shard that axis over the pp mesh axis."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params_list)
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x, axis_name: str):
+    """Run `x` microbatches through the stage pipeline.
+
+    Call INSIDE shard_map over the pp axis with:
+    - stage_params: this rank's LOCAL stage params (leading stage axis
+      already sharded away, i.e. spec P('pp', ...) squeezed to the local
+      stage by the caller);
+    - x: [n_micro, mb, ...] microbatches (replicated over pp);
+    - stage_fn(params, act) -> act, with matching activation shapes across
+      stages (homogeneous pipeline, e.g. transformer blocks).
+
+    Returns [n_micro, mb, ...] outputs, replicated over pp.
+    """
+    S = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    n_micro = x.shape[0]
+    is_first = idx == 0
+    is_last = idx == S - 1
+    perm = [(i, (i + 1) % S) for i in range(S)]
+    # probe (abstractly, no compute) which mesh axes the stage output
+    # varies over, so the scan carries match it exactly — over-promoting
+    # would leak spurious vma into the pipeline outputs
+    out_aval = jax.eval_shape(stage_fn, stage_params, x[0])
+    zero_act = match_vma(jnp.zeros_like(x[0]), out_aval)
+    outputs0 = match_vma(jnp.zeros((n_micro,) + x.shape[1:], x.dtype),
+                         out_aval)
+
+    def tick(carry, t):
+        recv, outputs = carry
+        x_t = lax.dynamic_index_in_dim(
+            x, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+        inp = jnp.where(is_first, x_t, recv)
+        out = stage_fn(stage_params, inp)
+        # stage `idx` is processing microbatch t - idx at tick t
+        mb = t - idx
+        valid = (mb >= 0) & (mb < n_micro)
+        slot = jnp.clip(t - (S - 1), 0, n_micro - 1)
+        updated = lax.dynamic_update_index_in_dim(outputs, out, slot, 0)
+        outputs = jnp.where(is_last & valid, updated, outputs)
+        recv = lax.ppermute(jnp.where(valid, out, zero_act),
+                            axis_name, perm)
+        return (recv, outputs), None
+
+    (recv, outputs), _ = lax.scan(tick, (zero_act, outputs0),
+                                  jnp.arange(n_micro + S - 1))
+    # results live on the last stage; replicate them over the pp axis
+    return lax.psum(jnp.where(is_last, outputs, jnp.zeros_like(outputs)),
+                    axis_name)

@@ -1,0 +1,61 @@
+"""Tensor-parallel (TP) building blocks.
+
+The reference has NO tensor parallelism (SURVEY.md §2.4 — absent). This is
+new TPU-native capability: Megatron-style column/row-parallel matmuls
+expressed with `shard_map` collectives so a Dense/MLP/attention projection
+can be split across a mesh axis and ride ICI.
+
+Pattern (How-to-Scale-Your-Model recipe): column-parallel keeps the output
+feature dim sharded (no comm on forward), row-parallel contracts the
+sharded feature dim and `psum`s the partial products — one all-reduce per
+MLP block instead of per matmul.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def column_parallel_matmul(x, w, b=None):
+    """x: [..., d_in] replicated over the TP axis; w: LOCAL shard
+    [d_in, d_out_local]. Output [..., d_out_local] stays sharded — no
+    communication."""
+    y = x @ w
+    if b is not None:
+        y = y + b
+    return y
+
+
+def row_parallel_matmul(x, w, axis_name: str, b=None):
+    """x: [..., d_in_local] sharded over the TP axis; w: LOCAL shard
+    [d_in_local, d_out]. Partial products are all-reduced over
+    `axis_name`. Bias (replicated) is added AFTER the psum so it is not
+    multiplied by the axis size."""
+    y = lax.psum(x @ w, axis_name)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def tp_mlp(x, w1, b1, w2, b2, axis_name: str, activation=jax.nn.gelu):
+    """Column-parallel -> activation -> row-parallel: the canonical
+    Megatron MLP with exactly one all-reduce."""
+    h = activation(column_parallel_matmul(x, w1, b1))
+    return row_parallel_matmul(h, w2, axis_name, b2)
+
+
+def all_gather_features(x, axis_name: str):
+    """Gather a feature-sharded activation to replicated (tiled on the
+    last axis)."""
+    return lax.all_gather(x, axis_name, axis=-1, tiled=True)
+
+
+def reduce_scatter_features(x, axis_name: str):
+    """Reduce partial sums and leave the result feature-sharded — the
+    bandwidth-optimal half of an all-reduce when the next op consumes a
+    shard."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=x.ndim - 1,
+                            tiled=True)

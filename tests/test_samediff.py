@@ -355,6 +355,46 @@ class TestReviewRegressions:
         assert res[c.name].shape == (B, H)
 
 
+class TestReviewRegressions2:
+    def test_refit_after_convert_to_constant(self, np_rng):
+        sd = _mlp_graph(np_rng)
+        X = np_rng.randn(16, 4).astype(np.float32)
+        Y = np.eye(3, dtype=np.float32)[np_rng.randint(0, 3, 16)]
+        sd.set_training_config(TrainingConfig(
+            updater=Adam(0.01),
+            data_set_feature_mapping=["x"],
+            data_set_label_mapping=["labels"]))
+        sd.fit(ArrayDataSetIterator(X, Y, batch=8), epochs=1)
+        sd.convert_to_constant("w0")
+        # must rebuild updater state for the reduced trainable set
+        h = sd.fit(ArrayDataSetIterator(X, Y, batch=8), epochs=1)
+        assert np.isfinite(h.last_loss())
+
+    def test_scan_random_differs_per_step(self):
+        sd = SameDiff.create()
+        c0 = sd.constant(np.zeros(3, np.float32))
+        xs = sd.constant(np.zeros((4, 3), np.float32))
+        fin, ys = sd.scan(
+            lambda s, c, x: (c, s.random.random_normal(shape=(3,))),
+            [c0], [xs])
+        draws = np.asarray(ys.eval())
+        # each scan step must get a distinct folded key
+        for i in range(1, 4):
+            assert np.abs(draws[i] - draws[0]).max() > 0
+
+    def test_scalar_left_pow(self):
+        sd = SameDiff.create()
+        x = sd.constant(np.array([1.0, 2.0, 3.0], np.float32))
+        y = 2.0 ** x
+        np.testing.assert_allclose(np.asarray(y.eval()), [2.0, 4.0, 8.0],
+                                   rtol=1e-6)
+
+    def test_missing_placeholder_message(self, np_rng):
+        sd = _mlp_graph(np_rng)
+        with pytest.raises(ValueError, match="missing placeholder"):
+            sd.output({}, ["pred"])
+
+
 class TestRandom:
     def test_random_ops_keyed(self):
         sd = SameDiff.create()
