@@ -1,0 +1,33 @@
+"""ETL pipeline — the DataVec-class layer (ref: L4, `datavec/`).
+
+Re-implements the reference's record-oriented ETL surface
+(`datavec-api/.../records/reader/RecordReader.java:40`, `Writable` types,
+`transform/TransformProcess.java:86`, `Schema`, and
+`datavec-local/.../LocalTransformExecutor.java`) the TPU-native way:
+records flow as python/numpy values through lazy reader + transform
+pipelines, and the terminal iterators emit FIXED-SHAPE numpy batches that
+feed the device via the async double-buffered path
+(`datasets.AsyncDataSetIterator`) — static shapes keep XLA from
+recompiling, and ETL stays on host threads off the device critical path
+(the reference's AsyncDataSetIterator philosophy, SURVEY.md §2.3 D8).
+"""
+from .schema import ColumnType, Schema
+from .records import (CSVRecordReader, CSVSequenceRecordReader,
+                      CollectionRecordReader, ImageRecordReader,
+                      LineRecordReader, NumpyRecordReader, RecordReader)
+from .transform import (Condition, Filter, LocalTransformExecutor,
+                        TransformProcess)
+from .iterators import (RecordReaderDataSetIterator,
+                        SequenceRecordReaderDataSetIterator)
+from .normalize import (ImagePreProcessingScaler, NormalizerMinMaxScaler,
+                        NormalizerStandardize)
+
+__all__ = [
+    "Schema", "ColumnType", "RecordReader", "CSVRecordReader",
+    "CSVSequenceRecordReader", "CollectionRecordReader", "LineRecordReader",
+    "ImageRecordReader", "NumpyRecordReader", "TransformProcess",
+    "LocalTransformExecutor", "Filter", "Condition",
+    "RecordReaderDataSetIterator", "SequenceRecordReaderDataSetIterator",
+    "NormalizerStandardize", "NormalizerMinMaxScaler",
+    "ImagePreProcessingScaler",
+]

@@ -1,0 +1,152 @@
+"""Reader -> DataSet bridge iterators.
+
+Ref: `deeplearning4j-data` `RecordReaderDataSetIterator.java` and
+`SequenceRecordReaderDataSetIterator.java` (alignment + masking), the
+glue between DataVec readers and network `fit()`.
+
+TPU-first: emits fixed-shape numpy batches (sequences padded to the
+longest length in the DATASET, not per-batch, so every batch has one
+static shape and XLA compiles the step exactly once).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..datasets import DataSet, DataSetIterator
+from .records import RecordReader
+
+
+def _one_hot(idx: int, n: int) -> np.ndarray:
+    v = np.zeros(n, np.float32)
+    v[int(idx)] = 1.0
+    return v
+
+
+class RecordReaderDataSetIterator(DataSetIterator):
+    """Ref: RecordReaderDataSetIterator.java — batches records, splitting
+    features/labels at `label_index` (one-hot for classification,
+    passthrough for regression)."""
+
+    def __init__(self, reader: RecordReader, batch_size: int,
+                 label_index: Optional[int] = None,
+                 num_classes: Optional[int] = None,
+                 regression: bool = False):
+        self.reader = reader
+        self._batch = batch_size
+        self.label_index = label_index
+        self.num_classes = num_classes
+        self.regression = regression
+        self._records: Optional[List[list]] = None
+        self._pos = 0
+
+    def _load(self):
+        if self._records is None:
+            self._records = list(self.reader)
+
+    def reset(self):
+        self._pos = 0
+
+    def batch_size(self):
+        return self._batch
+
+    def has_next(self):
+        self._load()
+        return self._pos < len(self._records)
+
+    def next(self):
+        self._load()
+        chunk = self._records[self._pos:self._pos + self._batch]
+        self._pos += len(chunk)
+        if self.label_index is None:
+            feats = np.asarray([[float(v) for v in r] for r in chunk],
+                               np.float32)
+            return feats, None
+        li = self.label_index
+        feats, labels = [], []
+        for r in chunk:
+            f = [float(v) for i, v in enumerate(r) if i != li]
+            feats.append(f)
+            if self.regression:
+                labels.append([float(r[li])])
+            else:
+                labels.append(_one_hot(int(r[li]), self.num_classes))
+        return (np.asarray(feats, np.float32),
+                np.asarray(labels, np.float32))
+
+    def __next__(self):
+        if not self.has_next():
+            raise StopIteration
+        return self.next()
+
+
+class SequenceRecordReaderDataSetIterator(DataSetIterator):
+    """Ref: SequenceRecordReaderDataSetIterator.java — sequence records
+    to [B, T, F] batches. Variable-length sequences are padded to the
+    dataset-wide max length with ALIGN_END semantics and a [B, T] mask
+    (the reference's masking contract for RNNs, SURVEY.md §5.7)."""
+
+    def __init__(self, reader, batch_size: int,
+                 label_index: Optional[int] = None,
+                 num_classes: Optional[int] = None,
+                 regression: bool = False, align_end: bool = False):
+        self.reader = reader
+        self._batch = batch_size
+        self.label_index = label_index
+        self.num_classes = num_classes
+        self.regression = regression
+        self.align_end = align_end
+        self._seqs: Optional[List[List[list]]] = None
+        self._max_len = 0
+        self._pos = 0
+
+    def _load(self):
+        if self._seqs is None:
+            self._seqs = list(self.reader)
+            self._max_len = max((len(s) for s in self._seqs), default=0)
+
+    def reset(self):
+        self._pos = 0
+
+    def batch_size(self):
+        return self._batch
+
+    def has_next(self):
+        self._load()
+        return self._pos < len(self._seqs)
+
+    def next(self):
+        self._load()
+        chunk = self._seqs[self._pos:self._pos + self._batch]
+        self._pos += len(chunk)
+        T = self._max_len
+        li = self.label_index
+        n_feat = len(chunk[0][0]) - (0 if li is None else 1)
+        B = len(chunk)
+        feats = np.zeros((B, T, n_feat), np.float32)
+        mask = np.zeros((B, T), np.float32)
+        if li is not None:
+            ldim = 1 if self.regression else self.num_classes
+            labels = np.zeros((B, T, ldim), np.float32)
+        for b, seq in enumerate(chunk):
+            L = len(seq)
+            off = T - L if self.align_end else 0
+            for t, rec in enumerate(seq):
+                f = [float(v) for i, v in enumerate(rec) if i != li]
+                feats[b, off + t] = f
+                mask[b, off + t] = 1.0
+                if li is not None:
+                    if self.regression:
+                        labels[b, off + t, 0] = float(rec[li])
+                    else:
+                        labels[b, off + t] = _one_hot(int(rec[li]),
+                                                      self.num_classes)
+        if li is None:
+            return feats, None, mask
+        return feats, labels, mask
+
+    def __next__(self):
+        if not self.has_next():
+            raise StopIteration
+        return self.next()

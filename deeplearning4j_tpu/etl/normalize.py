@@ -1,0 +1,142 @@
+"""Data normalizers with fit/transform/revert + serialization.
+
+Ref: nd4j `linalg/dataset/api/preprocessor/{NormalizerStandardize,
+NormalizerMinMaxScaler,ImagePreProcessingScaler}.java` — the reference
+persists the fitted normalizer inside the model zip
+(`ModelSerializer.addNormalizerToModel`), and restores it with the model;
+`save()/load()` here produce the npz payload the serializer embeds.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class _FittedNormalizer:
+    _fields: tuple = ()
+
+    def _stats_axes(self, x):
+        # statistics per-feature over all leading axes (batch, time, ...)
+        return tuple(range(x.ndim - 1))
+
+    def fit(self, data):
+        """`data`: array [N, ...features] or a DataSetIterator."""
+        if hasattr(data, "reset") or hasattr(data, "has_next"):
+            feats = []
+            for batch in data:
+                feats.append(np.asarray(
+                    batch[0] if isinstance(batch, (tuple, list))
+                    else batch.features))
+            if hasattr(data, "reset"):
+                data.reset()
+            x = np.concatenate(feats, axis=0)
+        else:
+            x = np.asarray(data)
+        self._fit_array(x)
+        return self
+
+    def transform(self, x):
+        raise NotImplementedError
+
+    def revert(self, x):
+        raise NotImplementedError
+
+    def pre_process(self, dataset):
+        """In-place DataSet feature transform (ref: preProcess)."""
+        dataset.features = self.transform(np.asarray(dataset.features))
+        return dataset
+
+    def save(self, path: str):
+        np.savez(path, __class__=type(self).__name__,
+                 **{f: getattr(self, f) for f in self._fields})
+
+    @staticmethod
+    def load(path: str):
+        with np.load(path, allow_pickle=False) as z:
+            cls_name = str(z["__class__"])
+            cls = {c.__name__: c for c in
+                   (NormalizerStandardize, NormalizerMinMaxScaler,
+                    ImagePreProcessingScaler)}[cls_name]
+            obj = cls.__new__(cls)
+            for f in cls._fields:
+                setattr(obj, f, z[f])
+        return obj
+
+
+class NormalizerStandardize(_FittedNormalizer):
+    """Zero-mean unit-variance per feature (ref:
+    NormalizerStandardize.java)."""
+
+    _fields = ("mean", "std")
+
+    def __init__(self):
+        self.mean: Optional[np.ndarray] = None
+        self.std: Optional[np.ndarray] = None
+
+    def _fit_array(self, x):
+        axes = self._stats_axes(x)
+        self.mean = x.mean(axis=axes)
+        self.std = x.std(axis=axes) + 1e-8
+
+    def transform(self, x):
+        return ((np.asarray(x) - self.mean) / self.std).astype(np.float32)
+
+    def revert(self, x):
+        return np.asarray(x) * self.std + self.mean
+
+
+class NormalizerMinMaxScaler(_FittedNormalizer):
+    """Scale features to [min_range, max_range] (ref:
+    NormalizerMinMaxScaler.java)."""
+
+    _fields = ("data_min", "data_max", "range")
+
+    def __init__(self, min_range: float = 0.0, max_range: float = 1.0):
+        self.range = np.asarray([min_range, max_range], np.float64)
+        self.data_min: Optional[np.ndarray] = None
+        self.data_max: Optional[np.ndarray] = None
+
+    def _fit_array(self, x):
+        axes = self._stats_axes(x)
+        self.data_min = x.min(axis=axes)
+        self.data_max = x.max(axis=axes)
+
+    def transform(self, x):
+        lo, hi = self.range
+        denom = np.where(self.data_max > self.data_min,
+                         self.data_max - self.data_min, 1.0)
+        z = (np.asarray(x) - self.data_min) / denom
+        return (z * (hi - lo) + lo).astype(np.float32)
+
+    def revert(self, x):
+        lo, hi = self.range
+        z = (np.asarray(x) - lo) / (hi - lo)
+        return z * (self.data_max - self.data_min) + self.data_min
+
+
+class ImagePreProcessingScaler(_FittedNormalizer):
+    """Pixel scaling [0, max_pixel] -> [min, max] with no fitting needed
+    (ref: ImagePreProcessingScaler.java)."""
+
+    _fields = ("lo", "hi", "max_pixel")
+
+    def __init__(self, min_range: float = 0.0, max_range: float = 1.0,
+                 max_pixel: float = 255.0):
+        self.lo = np.float64(min_range)
+        self.hi = np.float64(max_range)
+        self.max_pixel = np.float64(max_pixel)
+
+    def fit(self, data):
+        return self  # stateless
+
+    def _fit_array(self, x):
+        pass
+
+    def transform(self, x):
+        z = np.asarray(x) / self.max_pixel
+        return (z * (self.hi - self.lo) + self.lo).astype(np.float32)
+
+    def revert(self, x):
+        z = (np.asarray(x) - self.lo) / (self.hi - self.lo)
+        return z * self.max_pixel

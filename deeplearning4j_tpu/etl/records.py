@@ -1,0 +1,254 @@
+"""Record readers: streaming sources of (lists of) column values.
+
+Ref: `datavec-api/.../records/reader/RecordReader.java:40` SPI and its
+implementations (`impl/csv/CSVRecordReader.java`,
+`impl/csv/CSVSequenceRecordReader.java`, `impl/LineRecordReader.java`,
+`impl/collection/CollectionRecordReader.java`) plus the media reader
+`datavec-data/datavec-data-image/.../NativeImageLoader.java` (JavaCPP
+OpenCV there; PIL/numpy here).
+
+A "record" is a list of python/numpy values (the reference's
+List<Writable>); a sequence record is a list of records. Readers are
+restartable iterators (`reset()`), matching the SPI contract.
+"""
+from __future__ import annotations
+
+import csv
+import io
+import os
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+class RecordReader:
+    """SPI (ref: RecordReader.java:40 — hasNext/next/reset)."""
+
+    def __iter__(self) -> Iterator[list]:
+        self.reset()
+        while self.has_next():
+            yield self.next()
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next(self) -> list:
+        raise NotImplementedError
+
+    def reset(self):
+        raise NotImplementedError
+
+
+def _parse_cell(s: str):
+    """CSV cells come out typed like the reference's Writables: int if it
+    parses, else float, else string."""
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        return s
+
+
+class CSVRecordReader(RecordReader):
+    """Ref: CSVRecordReader.java — skipNumLines + delimiter config."""
+
+    def __init__(self, path: Optional[str] = None, skip_lines: int = 0,
+                 delimiter: str = ",", text: Optional[str] = None,
+                 parse: bool = True):
+        if (path is None) == (text is None):
+            raise ValueError("provide exactly one of path= or text=")
+        self.path, self.text = path, text
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+        self.parse = parse
+        self._rows: Optional[List[list]] = None
+        self._pos = 0
+
+    def _load(self):
+        if self._rows is not None:
+            return
+        if self.path is not None:
+            with open(self.path, newline="") as f:
+                raw = list(csv.reader(f, delimiter=self.delimiter))
+        else:
+            raw = list(csv.reader(io.StringIO(self.text),
+                                  delimiter=self.delimiter))
+        raw = [r for r in raw[self.skip_lines:] if r]
+        self._rows = [[_parse_cell(c) for c in r] if self.parse else r
+                      for r in raw]
+
+    def has_next(self) -> bool:
+        self._load()
+        return self._pos < len(self._rows)
+
+    def next(self) -> list:
+        self._load()
+        row = self._rows[self._pos]
+        self._pos += 1
+        return list(row)
+
+    def reset(self):
+        self._pos = 0
+
+
+class LineRecordReader(RecordReader):
+    """Ref: LineRecordReader.java — one record per line, single string."""
+
+    def __init__(self, path: Optional[str] = None,
+                 text: Optional[str] = None):
+        if (path is None) == (text is None):
+            raise ValueError("provide exactly one of path= or text=")
+        self.path, self.text = path, text
+        self._lines: Optional[List[str]] = None
+        self._pos = 0
+
+    def _load(self):
+        if self._lines is None:
+            src = open(self.path).read() if self.path else self.text
+            self._lines = src.splitlines()
+
+    def has_next(self):
+        self._load()
+        return self._pos < len(self._lines)
+
+    def next(self):
+        self._load()
+        line = self._lines[self._pos]
+        self._pos += 1
+        return [line]
+
+    def reset(self):
+        self._pos = 0
+
+
+class CollectionRecordReader(RecordReader):
+    """Ref: CollectionRecordReader.java — in-memory records."""
+
+    def __init__(self, records: Sequence[Sequence]):
+        self.records = [list(r) for r in records]
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < len(self.records)
+
+    def next(self):
+        r = self.records[self._pos]
+        self._pos += 1
+        return list(r)
+
+    def reset(self):
+        self._pos = 0
+
+
+class NumpyRecordReader(RecordReader):
+    """Rows of a feature matrix (+ optional label vector) as records —
+    the nd4j RecordConverter.toRecords analogue."""
+
+    def __init__(self, features: np.ndarray,
+                 labels: Optional[np.ndarray] = None):
+        self.features = np.asarray(features)
+        self.labels = None if labels is None else np.asarray(labels)
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < self.features.shape[0]
+
+    def next(self):
+        row = list(self.features[self._pos])
+        if self.labels is not None:
+            row.append(self.labels[self._pos])
+        self._pos += 1
+        return row
+
+    def reset(self):
+        self._pos = 0
+
+
+class CSVSequenceRecordReader(RecordReader):
+    """Ref: CSVSequenceRecordReader.java — one sequence per FILE (or per
+    text blob); each line is one time step."""
+
+    def __init__(self, paths: Optional[Sequence[str]] = None,
+                 skip_lines: int = 0, delimiter: str = ",",
+                 texts: Optional[Sequence[str]] = None):
+        if (paths is None) == (texts is None):
+            raise ValueError("provide exactly one of paths= or texts=")
+        self.paths, self.texts = paths, texts
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+        self._pos = 0
+
+    def _n(self):
+        return len(self.paths if self.paths is not None else self.texts)
+
+    def has_next(self):
+        return self._pos < self._n()
+
+    def next(self) -> List[list]:
+        if self.paths is not None:
+            rr = CSVRecordReader(path=self.paths[self._pos],
+                                 skip_lines=self.skip_lines,
+                                 delimiter=self.delimiter)
+        else:
+            rr = CSVRecordReader(text=self.texts[self._pos],
+                                 skip_lines=self.skip_lines,
+                                 delimiter=self.delimiter)
+        self._pos += 1
+        return list(rr)
+
+    def reset(self):
+        self._pos = 0
+
+
+class ImageRecordReader(RecordReader):
+    """Ref: datavec-data-image `ImageRecordReader` + `NativeImageLoader` —
+    reads image files to [H, W, C] float arrays with the label taken from
+    the parent directory name (ParentPathLabelGenerator semantics).
+
+    TPU-first: emits NHWC float32 (channels-last matches the conv stack's
+    native layout) resized to a FIXED height x width so downstream batches
+    are static-shaped for XLA."""
+
+    def __init__(self, height: int, width: int, channels: int = 3,
+                 paths: Optional[Sequence[str]] = None,
+                 root_dir: Optional[str] = None,
+                 labels: Optional[Sequence[str]] = None):
+        self.height, self.width, self.channels = height, width, channels
+        if root_dir is not None:
+            paths = sorted(
+                os.path.join(dp, f)
+                for dp, _, fs in os.walk(root_dir) for f in fs
+                if f.lower().split(".")[-1] in
+                ("png", "jpg", "jpeg", "bmp", "gif"))
+        self.paths = list(paths or [])
+        dirs = sorted({os.path.basename(os.path.dirname(p))
+                       for p in self.paths})
+        self.labels = list(labels) if labels is not None else dirs
+        self._pos = 0
+
+    def _load_image(self, path) -> np.ndarray:
+        from PIL import Image
+        img = Image.open(path)
+        img = img.convert("L" if self.channels == 1 else "RGB")
+        img = img.resize((self.width, self.height))
+        arr = np.asarray(img, np.float32)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr
+
+    def has_next(self):
+        return self._pos < len(self.paths)
+
+    def next(self):
+        path = self.paths[self._pos]
+        self._pos += 1
+        arr = self._load_image(path)
+        label = os.path.basename(os.path.dirname(path))
+        idx = self.labels.index(label) if label in self.labels else -1
+        return [arr, idx]
+
+    def reset(self):
+        self._pos = 0

@@ -1,0 +1,153 @@
+"""Schema: typed column metadata for record pipelines.
+
+Ref: `datavec-api/.../transform/schema/Schema.java` (builder DSL with
+addColumnInteger/Double/Categorical/String/Time/NDArray) — the anchor of
+every TransformProcess: each transform maps an input schema to an output
+schema, so pipelines are shape/type-checked before any data moves.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import List, Optional, Sequence, Tuple
+
+
+class ColumnType(Enum):
+    INTEGER = "Integer"
+    LONG = "Long"
+    DOUBLE = "Double"
+    FLOAT = "Float"
+    CATEGORICAL = "Categorical"
+    STRING = "String"
+    TIME = "Time"
+    NDARRAY = "NDArray"
+    BOOLEAN = "Boolean"
+
+
+@dataclass
+class ColumnMetaData:
+    name: str
+    type: ColumnType
+    state: dict = field(default_factory=dict)  # categories, shape, ranges
+
+    def to_json(self):
+        return {"name": self.name, "type": self.type.value,
+                "state": self.state}
+
+    @staticmethod
+    def from_json(d):
+        return ColumnMetaData(d["name"], ColumnType(d["type"]),
+                              d.get("state", {}))
+
+
+class Schema:
+    """Immutable-ish column schema with the reference's builder DSL."""
+
+    def __init__(self, columns: Sequence[ColumnMetaData]):
+        self.columns = list(columns)
+        names = [c.name for c in self.columns]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate column names in {names}")
+
+    # -- lookups -------------------------------------------------------
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def column_names(self) -> List[str]:
+        return [c.name for c in self.columns]
+
+    def index_of(self, name: str) -> int:
+        for i, c in enumerate(self.columns):
+            if c.name == name:
+                return i
+        raise KeyError(f"no column {name!r}; have {self.column_names()}")
+
+    def column(self, name: str) -> ColumnMetaData:
+        return self.columns[self.index_of(name)]
+
+    def column_type(self, name: str) -> ColumnType:
+        return self.column(name).type
+
+    def has_column(self, name: str) -> bool:
+        return any(c.name == name for c in self.columns)
+
+    # -- serde (JSON round-trip like the reference's Jackson serde) ----
+    def to_json(self) -> str:
+        return json.dumps({"columns": [c.to_json() for c in self.columns]})
+
+    @staticmethod
+    def from_json(s: str) -> "Schema":
+        d = json.loads(s)
+        return Schema([ColumnMetaData.from_json(c) for c in d["columns"]])
+
+    def __eq__(self, other):
+        return isinstance(other, Schema) and self.to_json() == other.to_json()
+
+    def __repr__(self):
+        cols = ", ".join(f"{c.name}:{c.type.value}" for c in self.columns)
+        return f"Schema({cols})"
+
+    # -- builder (ref: Schema.Builder) ---------------------------------
+    class Builder:
+        def __init__(self):
+            self._cols: List[ColumnMetaData] = []
+
+        def _add(self, name, ctype, **state):
+            self._cols.append(ColumnMetaData(name, ctype, dict(state)))
+            return self
+
+        def add_column_integer(self, name, min_value=None, max_value=None):
+            return self._add(name, ColumnType.INTEGER,
+                             min=min_value, max=max_value)
+
+        def add_column_long(self, name):
+            return self._add(name, ColumnType.LONG)
+
+        def add_column_double(self, name, min_value=None, max_value=None):
+            return self._add(name, ColumnType.DOUBLE,
+                             min=min_value, max=max_value)
+
+        def add_column_float(self, name):
+            return self._add(name, ColumnType.FLOAT)
+
+        def add_column_categorical(self, name, *categories):
+            if len(categories) == 1 and isinstance(categories[0],
+                                                   (list, tuple)):
+                categories = tuple(categories[0])
+            return self._add(name, ColumnType.CATEGORICAL,
+                             categories=list(categories))
+
+        def add_column_string(self, name):
+            return self._add(name, ColumnType.STRING)
+
+        def add_column_time(self, name):
+            return self._add(name, ColumnType.TIME)
+
+        def add_column_boolean(self, name):
+            return self._add(name, ColumnType.BOOLEAN)
+
+        def add_column_ndarray(self, name, shape: Tuple[int, ...]):
+            return self._add(name, ColumnType.NDARRAY, shape=list(shape))
+
+        def add_columns_double(self, *names):
+            for n in names:
+                self.add_column_double(n)
+            return self
+
+        def add_columns_integer(self, *names):
+            for n in names:
+                self.add_column_integer(n)
+            return self
+
+        def add_columns_string(self, *names):
+            for n in names:
+                self.add_column_string(n)
+            return self
+
+        def build(self) -> "Schema":
+            return Schema(self._cols)
+
+    @staticmethod
+    def builder() -> "Schema.Builder":
+        return Schema.Builder()

@@ -1,0 +1,309 @@
+"""ETL (DataVec-class) tests: schema, readers, TransformProcess,
+reader->DataSet iterators, normalizers (SURVEY.md §2 L4 / D8)."""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.etl import (CSVRecordReader, CSVSequenceRecordReader,
+                                    CollectionRecordReader, ColumnType,
+                                    Condition, Filter, ImageRecordReader,
+                                    ImagePreProcessingScaler,
+                                    LineRecordReader,
+                                    LocalTransformExecutor,
+                                    NormalizerMinMaxScaler,
+                                    NormalizerStandardize,
+                                    NumpyRecordReader,
+                                    RecordReaderDataSetIterator, Schema,
+                                    SequenceRecordReaderDataSetIterator,
+                                    TransformProcess)
+
+
+class TestSchema:
+    def test_builder_and_lookup(self):
+        s = (Schema.builder()
+             .add_column_integer("age")
+             .add_column_double("height")
+             .add_column_categorical("city", "NYC", "SF", "LA")
+             .add_column_string("name")
+             .build())
+        assert s.num_columns() == 4
+        assert s.column_type("city") == ColumnType.CATEGORICAL
+        assert s.column("city").state["categories"] == ["NYC", "SF", "LA"]
+        assert s.index_of("name") == 3
+        with pytest.raises(KeyError):
+            s.index_of("nope")
+
+    def test_json_round_trip(self):
+        s = (Schema.builder().add_column_double("x")
+             .add_column_categorical("c", "a", "b").build())
+        s2 = Schema.from_json(s.to_json())
+        assert s == s2
+
+    def test_duplicate_names_rejected(self):
+        with pytest.raises(ValueError):
+            Schema.builder().add_column_double("x") \
+                .add_column_integer("x").build()
+
+
+class TestReaders:
+    def test_csv(self, tmp_path):
+        p = tmp_path / "data.csv"
+        p.write_text("h1,h2,h3\n1,2.5,abc\n4,5.0,def\n")
+        rr = CSVRecordReader(path=str(p), skip_lines=1)
+        rows = list(rr)
+        assert rows == [[1, 2.5, "abc"], [4, 5.0, "def"]]
+        rr.reset()
+        assert rr.has_next() and rr.next() == [1, 2.5, "abc"]
+
+    def test_csv_text(self):
+        rows = list(CSVRecordReader(text="1,2\n3,4\n"))
+        assert rows == [[1, 2], [3, 4]]
+
+    def test_line(self):
+        assert list(LineRecordReader(text="a\nb\n")) == [["a"], ["b"]]
+
+    def test_collection_and_numpy(self):
+        assert list(CollectionRecordReader([[1, 2], [3, 4]])) == \
+            [[1, 2], [3, 4]]
+        X = np.arange(6, dtype=np.float32).reshape(3, 2)
+        y = np.array([0, 1, 0])
+        recs = list(NumpyRecordReader(X, y))
+        assert len(recs) == 3 and recs[0][-1] == 0 and len(recs[0]) == 3
+
+    def test_csv_sequence(self):
+        seqs = list(CSVSequenceRecordReader(
+            texts=["1,0\n2,0\n3,1\n", "4,1\n5,0\n"]))
+        assert len(seqs) == 2
+        assert seqs[0] == [[1, 0], [2, 0], [3, 1]]
+        assert len(seqs[1]) == 2
+
+    def test_image_reader(self, tmp_path):
+        from PIL import Image
+        for label in ("cat", "dog"):
+            d = tmp_path / label
+            d.mkdir()
+            arr = np.full((10, 12, 3),
+                          80 if label == "cat" else 160, np.uint8)
+            Image.fromarray(arr).save(str(d / f"{label}1.png"))
+        rr = ImageRecordReader(height=8, width=8, channels=3,
+                               root_dir=str(tmp_path))
+        recs = list(rr)
+        assert len(recs) == 2
+        img, label_idx = recs[0]
+        assert img.shape == (8, 8, 3) and img.dtype == np.float32
+        assert rr.labels == ["cat", "dog"]
+        assert {r[1] for r in recs} == {0, 1}
+        assert abs(recs[0][0].mean() - 80) < 2  # sorted: cat first
+
+
+class TestTransformProcess:
+    def _schema(self):
+        return (Schema.builder()
+                .add_column_integer("id")
+                .add_column_double("value")
+                .add_column_categorical("state", "CA", "NY", "TX")
+                .add_column_string("note")
+                .build())
+
+    def test_remove_and_schema_threading(self):
+        tp = (TransformProcess.builder(self._schema())
+              .remove_columns("note")
+              .build())
+        assert tp.final_schema.column_names() == ["id", "value", "state"]
+        assert tp.execute([1, 2.0, "CA", "x"]) == [1, 2.0, "CA"]
+
+    def test_categorical_to_one_hot(self):
+        tp = (TransformProcess.builder(self._schema())
+              .remove_columns("note")
+              .categorical_to_one_hot("state")
+              .build())
+        assert tp.final_schema.column_names() == \
+            ["id", "value", "state[CA]", "state[NY]", "state[TX]"]
+        assert tp.execute([7, 1.5, "NY", "x"]) == [7, 1.5, 0, 1, 0]
+
+    def test_categorical_to_integer_and_back(self):
+        tp = (TransformProcess.builder(self._schema())
+              .categorical_to_integer("state")
+              .integer_to_categorical("state", ["CA", "NY", "TX"])
+              .build())
+        assert tp.execute([1, 1.0, "TX", ""])[2] == "TX"
+
+    def test_math_ops(self):
+        tp = (TransformProcess.builder(self._schema())
+              .double_math_op("value", "Multiply", 10.0)
+              .double_math_function("value", "log")
+              .build())
+        out = tp.execute([1, 2.718281828, "CA", ""])
+        assert out[1] == pytest.approx(np.log(27.18281828))
+
+    def test_filter(self):
+        tp = (TransformProcess.builder(self._schema())
+              .filter(Condition("value", "LessThan", 0.0))
+              .build())
+        records = [[1, 1.0, "CA", ""], [2, -1.0, "NY", ""],
+                   [3, 5.0, "TX", ""]]
+        out = LocalTransformExecutor.execute(records, tp)
+        assert [r[0] for r in out] == [1, 3]
+
+    def test_string_ops_and_conditional(self):
+        tp = (TransformProcess.builder(self._schema())
+              .replace_string("note", "bad", "good")
+              .append_string("note", "!")
+              .conditional_replace_value(
+                  "value", 0.0, Condition("value", "LessThan", 0.0))
+              .build())
+        out = tp.execute([1, -3.0, "CA", "bad day"])
+        assert out[3] == "good day!"
+        assert out[1] == 0.0
+
+    def test_rename_reorder_duplicate_convert(self):
+        tp = (TransformProcess.builder(self._schema())
+              .rename_column("value", "v")
+              .reorder_columns("v", "id")
+              .duplicate_column("v", "v2")
+              .convert_to_string("id")
+              .build())
+        assert tp.final_schema.column_names() == \
+            ["v", "id", "state", "note", "v2"]
+        out = tp.execute([1, 2.5, "CA", "n"])
+        assert out == [2.5, "1", "CA", "n", 2.5]
+
+    def test_json_round_trip_executes_identically(self):
+        tp = (TransformProcess.builder(self._schema())
+              .remove_columns("note")
+              .categorical_to_one_hot("state")
+              .double_math_op("value", "Add", 1.0)
+              .filter(Condition("id", "GreaterThan", 10))
+              .build())
+        tp2 = TransformProcess.from_json(tp.to_json())
+        rec = [3, 2.0, "TX", "x"]
+        assert tp.execute(rec) == tp2.execute(rec)
+        assert tp2.execute([11, 2.0, "TX", "x"]) is None
+        assert tp.final_schema == tp2.final_schema
+
+    def test_invalid_pipeline_rejected_at_build(self):
+        with pytest.raises(ValueError):
+            (TransformProcess.builder(self._schema())
+             .categorical_to_one_hot("value")  # not categorical
+             .build())
+        with pytest.raises(KeyError):
+            (TransformProcess.builder(self._schema())
+             .remove_columns("missing").build())
+
+
+class TestIterators:
+    def test_classification_batches(self):
+        recs = [[0.1, 0.2, 0], [0.3, 0.4, 1], [0.5, 0.6, 2],
+                [0.7, 0.8, 1], [0.9, 1.0, 0]]
+        it = RecordReaderDataSetIterator(
+            CollectionRecordReader(recs), batch_size=2, label_index=2,
+            num_classes=3)
+        batches = list(it)
+        assert len(batches) == 3
+        f, l = batches[0]
+        assert f.shape == (2, 2) and l.shape == (2, 3)
+        np.testing.assert_array_equal(l[1], [0, 1, 0])
+        it.reset()
+        assert it.has_next()
+
+    def test_regression_batches(self):
+        recs = [[1.0, 2.0, 3.5], [2.0, 3.0, 5.5]]
+        it = RecordReaderDataSetIterator(
+            CollectionRecordReader(recs), batch_size=2, label_index=2,
+            regression=True)
+        f, l = next(iter(it))
+        assert l.shape == (2, 1) and l[0, 0] == 3.5
+
+    def test_sequence_batches_with_masks(self):
+        seqs = CSVSequenceRecordReader(
+            texts=["1,0\n2,0\n3,1\n", "4,1\n5,0\n"])
+        it = SequenceRecordReaderDataSetIterator(
+            seqs, batch_size=2, label_index=1, num_classes=2)
+        f, l, m = next(iter(it))
+        assert f.shape == (2, 3, 1) and l.shape == (2, 3, 2)
+        np.testing.assert_array_equal(m, [[1, 1, 1], [1, 1, 0]])
+        # padded step is zero
+        assert f[1, 2, 0] == 0.0
+
+    def test_sequence_align_end(self):
+        seqs = CSVSequenceRecordReader(texts=["1,0\n2,0\n3,1\n", "4,1\n"])
+        it = SequenceRecordReaderDataSetIterator(
+            seqs, batch_size=2, label_index=1, num_classes=2,
+            align_end=True)
+        f, l, m = next(iter(it))
+        np.testing.assert_array_equal(m, [[1, 1, 1], [0, 0, 1]])
+        assert f[1, 2, 0] == 4.0
+
+    def test_end_to_end_train_on_csv(self, tmp_path):
+        # CSV -> TransformProcess -> iterator -> MultiLayerNetwork.fit
+        rs = np.random.RandomState(0)
+        X = rs.randn(120, 3).astype(np.float32)
+        y = (X[:, 0] + X[:, 1] > 0).astype(int)
+        lines = "".join(f"{a},{b},{c},{'pos' if t else 'neg'}\n"
+                        for (a, b, c), t in zip(X, y))
+        schema = (Schema.builder().add_columns_double("a", "b", "c")
+                  .add_column_categorical("label", "neg", "pos").build())
+        tp = (TransformProcess.builder(schema)
+              .categorical_to_integer("label").build())
+        recs = LocalTransformExecutor.execute_reader(
+            CSVRecordReader(text=lines), tp)
+        it = RecordReaderDataSetIterator(
+            CollectionRecordReader(recs), batch_size=32, label_index=3,
+            num_classes=2)
+
+        from deeplearning4j_tpu.learning import Adam
+        from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.05))
+                .list()
+                .layer(DenseLayer(n_out=16, activation="relu"))
+                .layer(OutputLayer(n_out=2, loss="mcxent",
+                                   activation="softmax"))
+                .input_type_feed_forward(3).build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(it, epochs=12)
+        ev = net.evaluate(it)
+        assert ev.accuracy() > 0.85
+
+
+class TestNormalizers:
+    def test_standardize(self, np_rng, tmp_path):
+        x = np_rng.randn(200, 4).astype(np.float32) * 3 + 5
+        n = NormalizerStandardize().fit(x)
+        z = n.transform(x)
+        assert abs(z.mean()) < 0.05 and abs(z.std() - 1) < 0.05
+        np.testing.assert_allclose(n.revert(z), x, rtol=1e-4, atol=1e-3)
+        p = str(tmp_path / "norm.npz")
+        n.save(p)
+        n2 = NormalizerStandardize.load(p)
+        np.testing.assert_allclose(n2.transform(x), z, rtol=1e-6)
+
+    def test_standardize_fit_iterator(self, np_rng):
+        from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+        X = np_rng.randn(64, 3).astype(np.float32) * 2 + 1
+        Y = np.zeros((64, 2), np.float32)
+        n = NormalizerStandardize().fit(ArrayDataSetIterator(X, Y, batch=16))
+        z = n.transform(X)
+        assert abs(z.mean()) < 0.1
+
+    def test_min_max(self, np_rng):
+        x = np_rng.rand(100, 2).astype(np.float32) * 10 - 3
+        n = NormalizerMinMaxScaler(0.0, 1.0).fit(x)
+        z = n.transform(x)
+        assert z.min() >= -1e-6 and z.max() <= 1 + 1e-6
+        np.testing.assert_allclose(n.revert(z), x, rtol=1e-4, atol=1e-4)
+
+    def test_image_scaler(self):
+        x = np.array([[0.0, 127.5, 255.0]])
+        n = ImagePreProcessingScaler(0, 1)
+        np.testing.assert_allclose(n.transform(x), [[0, 0.5, 1]], rtol=1e-6)
+        np.testing.assert_allclose(n.revert(n.transform(x)), x, rtol=1e-5)
+
+    def test_pre_process_dataset(self, np_rng):
+        from deeplearning4j_tpu.datasets import DataSet
+        x = np_rng.randn(10, 3).astype(np.float32) * 4 + 2
+        ds = DataSet(x.copy(), np.zeros((10, 2), np.float32))
+        NormalizerStandardize().fit(x).pre_process(ds)
+        assert abs(np.asarray(ds.features).mean()) < 0.3
