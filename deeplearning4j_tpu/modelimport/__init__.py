@@ -1,0 +1,11 @@
+"""Model import — the deeplearning4j-modelimport / nd4j-imports layer.
+
+Ref: `deeplearning4j-modelimport/.../keras/KerasModelImport.java:50,88`
+(h5 -> MultiLayerNetwork / ComputationGraph, 60+ layer mappers) and
+`nd4j-api/.../imports/graphmapper/tf/TFGraphMapper.java:59`
+(TF GraphDef -> SameDiff).
+"""
+from .keras import KerasModelImport
+from .tf import TFGraphMapper
+
+__all__ = ["KerasModelImport", "TFGraphMapper"]

@@ -1,0 +1,394 @@
+"""Keras h5 import.
+
+Ref: `deeplearning4j-modelimport/.../keras/KerasModelImport.java`
+(`importKerasSequentialModelAndWeights` :88 -> MultiLayerNetwork,
+`importKerasModelAndWeights` :50 -> ComputationGraph), the per-layer
+mappers under `keras/layers/**`, and `KerasModel`/`KerasSequentialModel`.
+
+Reads the h5 directly (config JSON + weight groups) — no TF/Keras runtime
+needed at import time, mirroring the reference's JavaCPP-hdf5 approach.
+Weight layouts transfer verbatim: this framework is channels-last with
+Keras-identical Dense [in,out], Conv [kh,kw,in,out], and LSTM gate order
+(i,f,c,o), so import is a copy, not a transpose dance.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+import h5py
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn import NeuralNetConfiguration
+from ..nn.graph import (ComputationGraph, ElementWiseVertex, GraphBuilder,
+                        MergeVertex)
+from ..nn.layers import (ActivationLayer, BatchNormalization,
+                         ConvolutionLayer, DenseLayer, DropoutLayer,
+                         EmbeddingLayer, GlobalPoolingLayer, Layer,
+                         OutputLayer, SubsamplingLayer, Upsampling2D,
+                         ZeroPaddingLayer)
+from ..nn.layers.recurrent import LSTM, LastTimeStep, SimpleRnn
+from ..nn.multilayer import MultiLayerNetwork
+
+_ACTIVATIONS = {
+    "linear": "identity", "relu": "relu", "relu6": "relu6",
+    "sigmoid": "sigmoid", "softmax": "softmax", "tanh": "tanh",
+    "elu": "elu", "selu": "selu", "softplus": "softplus",
+    "softsign": "softsign", "hard_sigmoid": "hardsigmoid",
+    "swish": "swish", "silu": "swish", "gelu": "gelu",
+    "leaky_relu": "leakyrelu", "mish": "mish", "exponential": "identity",
+}
+
+
+def _act(cfg) -> str:
+    a = cfg.get("activation", "linear")
+    if isinstance(a, dict):  # serialized activation object
+        a = a.get("class_name", "linear").lower()
+    if a not in _ACTIVATIONS:
+        raise ValueError(f"unsupported Keras activation {a!r}")
+    return _ACTIVATIONS[a]
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+
+class _Skip:
+    """Marker for config-only Keras layers with no runtime op here
+    (InputLayer, Flatten — dense auto-flattens)."""
+
+
+_LOSS_BY_ACTIVATION = {"softmax": "mcxent", "sigmoid": "xent"}
+
+
+def _as_output_layer(d: DenseLayer) -> OutputLayer:
+    act = d.activation.to_json()
+    act_name = act.get("@class", act) if isinstance(act, dict) else act
+    loss = _LOSS_BY_ACTIVATION.get(act_name, "mse")
+    return OutputLayer(n_out=d.n_out, loss=loss, activation=d.activation,
+                       has_bias=d.has_bias, name=d.name)
+
+
+def _map_layer(class_name: str, cfg: dict) -> Optional[object]:
+    """One Keras layer config -> framework Layer (or _Skip / None).
+    Ref: the 60+ mappers under `keras/layers/**` — same dispatch shape."""
+    name = cfg.get("name")
+    if class_name == "InputLayer" or class_name == "Flatten":
+        return _Skip()
+    if class_name == "Dense":
+        return DenseLayer(n_out=cfg["units"], activation=_act(cfg),
+                          has_bias=cfg.get("use_bias", True), name=name)
+    if class_name in ("Conv2D", "Convolution2D"):
+        return ConvolutionLayer(
+            n_out=cfg["filters"], kernel=_pair(cfg["kernel_size"]),
+            stride=_pair(cfg.get("strides", 1)),
+            padding=cfg.get("padding", "valid"),
+            dilation=_pair(cfg.get("dilation_rate", 1)),
+            activation=_act(cfg), has_bias=cfg.get("use_bias", True),
+            name=name)
+    if class_name in ("MaxPooling2D", "AveragePooling2D"):
+        return SubsamplingLayer(
+            kernel=_pair(cfg.get("pool_size", 2)),
+            stride=_pair(cfg.get("strides") or cfg.get("pool_size", 2)),
+            padding=cfg.get("padding", "valid"),
+            pooling="max" if class_name.startswith("Max") else "avg",
+            name=name)
+    if class_name in ("GlobalMaxPooling2D", "GlobalAveragePooling2D",
+                      "GlobalMaxPooling1D", "GlobalAveragePooling1D"):
+        return GlobalPoolingLayer(
+            pooling="max" if "Max" in class_name else "avg", name=name)
+    if class_name == "BatchNormalization":
+        return BatchNormalization(decay=cfg.get("momentum", 0.99),
+                                  eps=cfg.get("epsilon", 1e-3), name=name)
+    if class_name == "Dropout":
+        return DropoutLayer(dropout=cfg["rate"], name=name)
+    if class_name == "Activation":
+        return ActivationLayer(activation=_act(cfg), name=name)
+    if class_name == "ZeroPadding2D":
+        p = cfg.get("padding", 1)
+        return ZeroPaddingLayer(padding=p, name=name)
+    if class_name == "UpSampling2D":
+        return Upsampling2D(size=_pair(cfg.get("size", 2)), name=name)
+    if class_name == "Embedding":
+        return EmbeddingLayer(n_in=cfg["input_dim"], n_out=cfg["output_dim"],
+                              name=name)
+    if class_name == "LSTM":
+        lstm = LSTM(n_out=cfg["units"], activation=_act(cfg),
+                    gate_activation=_ACTIVATIONS.get(
+                        cfg.get("recurrent_activation", "sigmoid"),
+                        "sigmoid"),
+                    name=name)
+        if not cfg.get("return_sequences", False):
+            return LastTimeStep(lstm, name=name)
+        return lstm
+    if class_name == "SimpleRNN":
+        rnn = SimpleRnn(n_out=cfg["units"], activation=_act(cfg), name=name)
+        if not cfg.get("return_sequences", False):
+            return LastTimeStep(rnn, name=name)
+        return rnn
+    raise ValueError(f"unsupported Keras layer type {class_name!r} "
+                     f"(layer {name!r})")
+
+
+# merge layers -> graph vertices (functional models only)
+_MERGE_VERTICES = {
+    "Concatenate": lambda cfg: MergeVertex(),
+    "Add": lambda cfg: ElementWiseVertex("add"),
+    "Subtract": lambda cfg: ElementWiseVertex("subtract"),
+    "Multiply": lambda cfg: ElementWiseVertex("product"),
+    "Average": lambda cfg: ElementWiseVertex("average"),
+    "Maximum": lambda cfg: ElementWiseVertex("max"),
+}
+
+
+# ---------------------------------------------------------------------------
+# weights
+# ---------------------------------------------------------------------------
+def _layer_weights(f: h5py.File, layer_name: str) -> Dict[str, np.ndarray]:
+    """Collect datasets under model_weights/<layer> keyed by basename
+    (Keras 3 nests groups; Keras 2 uses weight_names attrs — walking the
+    tree handles both)."""
+    out: Dict[str, np.ndarray] = {}
+    grp = f["model_weights"]
+    if layer_name not in grp:
+        return out
+
+    def visit(name, obj):
+        if isinstance(obj, h5py.Dataset):
+            base = name.split("/")[-1].split(":")[0]
+            out[base] = np.asarray(obj)
+    grp[layer_name].visititems(visit)
+    return out
+
+
+_PARAM_MAP = {
+    # our param name -> keras dataset basename, per layer kind
+    "dense": {"W": "kernel", "b": "bias"},
+    "output": {"W": "kernel", "b": "bias"},
+    "conv2d": {"W": "kernel", "b": "bias"},
+    "batchnorm": {"gamma": "gamma", "beta": "beta"},
+    "embedding": {"W": "embeddings"},
+    "lstm": {"W": "kernel", "U": "recurrent_kernel", "b": "bias"},
+    "simplernn": {"W": "kernel", "U": "recurrent_kernel", "b": "bias"},
+}
+
+
+def _translate_params(kind: str, ours: dict, keras_w: Dict[str, np.ndarray],
+                      layer_name: str) -> dict:
+    mapping = _PARAM_MAP.get(kind)
+    if mapping is None:
+        if ours:
+            raise ValueError(f"no weight mapping for layer kind {kind!r} "
+                             f"({layer_name!r})")
+        return ours
+    new = {}
+    for pname, template in ours.items():
+        kname = mapping.get(pname)
+        if kname is None or kname not in keras_w:
+            new[pname] = template  # keep init (e.g. missing bias)
+            continue
+        w = keras_w[kname]
+        if tuple(w.shape) != tuple(np.shape(template)):
+            raise ValueError(
+                f"shape mismatch importing {layer_name!r}.{pname}: "
+                f"keras {w.shape} vs model {np.shape(template)}")
+        new[pname] = jnp.asarray(w)
+    return new
+
+
+def _bn_state(keras_w) -> Optional[dict]:
+    if "moving_mean" in keras_w:
+        return {"mean": jnp.asarray(keras_w["moving_mean"]),
+                "var": jnp.asarray(keras_w["moving_variance"])}
+    return None
+
+
+def _wrapped_kind(layer) -> str:
+    if isinstance(layer, LastTimeStep):
+        return layer.layer.kind
+    return layer.kind
+
+
+def _input_type(list_builder, batch_shape):
+    dims = [d for d in batch_shape[1:]]
+    if len(dims) == 3:
+        return list_builder.input_type_convolutional(*dims)
+    if len(dims) == 2:
+        return list_builder.input_type_recurrent(dims[1], timesteps=dims[0])
+    return list_builder.input_type_feed_forward(dims[0])
+
+
+class KerasModelImport:
+    """Ref: KerasModelImport.java:50 (functional) / :88 (sequential)."""
+
+    # -- sequential -> MultiLayerNetwork -------------------------------
+    @staticmethod
+    def import_keras_sequential_model_and_weights(
+            path: str, enforce_training_config: bool = False
+    ) -> MultiLayerNetwork:
+        with h5py.File(path, "r") as f:
+            cfg = json.loads(f.attrs["model_config"])
+            if cfg["class_name"] != "Sequential":
+                raise ValueError(
+                    f"{path} is a {cfg['class_name']} model; use "
+                    "import_keras_model_and_weights")
+            layer_cfgs = cfg["config"]["layers"]
+            batch_shape = None
+            mapped: List[Tuple[str, object]] = []
+            for lc in layer_cfgs:
+                c = lc["config"]
+                if lc["class_name"] == "InputLayer":
+                    batch_shape = c.get("batch_shape") or c.get(
+                        "batch_input_shape")
+                if batch_shape is None:
+                    bs = c.get("batch_shape") or c.get("batch_input_shape")
+                    if bs:
+                        batch_shape = bs
+                layer = _map_layer(lc["class_name"], c)
+                if not isinstance(layer, _Skip):
+                    mapped.append((c.get("name"), layer))
+            if batch_shape is None:
+                raise ValueError("could not determine model input shape")
+
+            # make the head trainable: final Dense -> OutputLayer with the
+            # loss implied by its activation (ref: KerasLoss mapping /
+            # enforceTrainingConfig behavior)
+            if mapped and type(mapped[-1][1]) is DenseLayer:
+                nm, d = mapped[-1]
+                mapped[-1] = (nm, _as_output_layer(d))
+
+            lb = NeuralNetConfiguration.builder().list()
+            for _, layer in mapped:
+                lb = lb.layer(layer)
+            lb = _input_type(lb, batch_shape)
+            net = MultiLayerNetwork(lb.build()).init()
+
+            # copy weights
+            for i, (kname, layer) in enumerate(mapped):
+                key = net._layer_keys[i]
+                keras_w = _layer_weights(f, kname)
+                kind = _wrapped_kind(layer)
+                if key in net._params:
+                    net._params[key] = _translate_params(
+                        kind, net._params[key], keras_w, kname)
+                if kind == "batchnorm":
+                    st = _bn_state(keras_w)
+                    if st is not None:
+                        net._net_state[key] = st
+        return net
+
+    # -- functional -> ComputationGraph --------------------------------
+    @staticmethod
+    def import_keras_model_and_weights(path: str) -> ComputationGraph:
+        with h5py.File(path, "r") as f:
+            cfg = json.loads(f.attrs["model_config"])
+            if cfg["class_name"] == "Sequential":
+                raise ValueError(
+                    f"{path} is Sequential; use "
+                    "import_keras_sequential_model_and_weights")
+            gcfg = cfg["config"]
+            builder = GraphBuilder()
+            input_names = []
+            mapped: Dict[str, object] = {}
+            shapes: Dict[str, list] = {}
+            for lc in gcfg["layers"]:
+                c = lc["config"]
+                nm = c["name"]
+                inbound = _inbound_names(lc)
+                if lc["class_name"] == "InputLayer":
+                    input_names.append(nm)
+                    shapes[nm] = c.get("batch_shape") or c.get(
+                        "batch_input_shape")
+                    continue
+                if lc["class_name"] in _MERGE_VERTICES:
+                    builder.add_vertex(nm, _MERGE_VERTICES[lc["class_name"]](c),
+                                       *inbound)
+                    continue
+                layer = _map_layer(lc["class_name"], c)
+                if isinstance(layer, _Skip):
+                    # passthrough: alias by scale-1 vertex
+                    from ..nn.graph import ScaleVertex
+                    builder.add_vertex(nm, ScaleVertex(1.0), *inbound)
+                    continue
+                mapped[nm] = layer
+                builder.add_layer(nm, layer, *inbound)
+            builder.add_inputs(*input_names)
+            outs = gcfg["output_layers"]
+            if (len(outs) >= 2 and isinstance(outs[0], str)
+                    and isinstance(outs[1], int)):
+                outs = [outs]  # single output stored flat: [name, 0, 0]
+            out_names = [_node_name(o) for o in outs]
+            builder.set_outputs(*out_names)
+            from ..nn.conf import InputType
+            types = []
+            for nm in input_names:
+                dims = shapes[nm][1:]
+                if len(dims) == 3:
+                    types.append(InputType.convolutional(*dims))
+                elif len(dims) == 2:
+                    types.append(InputType.recurrent(dims[1], dims[0]))
+                else:
+                    types.append(InputType.feed_forward(dims[0]))
+            builder.set_input_types(*types)
+            graph = ComputationGraph(builder.build()).init()
+
+            for nm, layer in mapped.items():
+                keras_w = _layer_weights(f, nm)
+                kind = _wrapped_kind(layer)
+                if nm in graph._params:
+                    graph._params[nm] = _translate_params(
+                        kind, graph._params[nm], keras_w, nm)
+                if kind == "batchnorm":
+                    st = _bn_state(keras_w)
+                    if st is not None:
+                        graph._net_state[nm] = st
+        return graph
+
+    # convenience dispatch (ref: importKerasModelAndWeights handles both)
+    @staticmethod
+    def import_model(path: str):
+        with h5py.File(path, "r") as f:
+            cls = json.loads(f.attrs["model_config"])["class_name"]
+        if cls == "Sequential":
+            return KerasModelImport.\
+                import_keras_sequential_model_and_weights(path)
+        return KerasModelImport.import_keras_model_and_weights(path)
+
+
+def _node_name(entry) -> str:
+    """output_layers entries: [name, node_idx, tensor_idx] (Keras 2/3)."""
+    if isinstance(entry, (list, tuple)):
+        return entry[0]
+    return entry
+
+
+def _inbound_names(layer_cfg: dict) -> List[str]:
+    """Extract predecessor layer names from inbound_nodes — handles both
+    Keras 2 nested lists and Keras 3 keras_history dicts."""
+    names: List[str] = []
+
+    def walk(obj):
+        if isinstance(obj, dict):
+            if "keras_history" in obj:
+                names.append(obj["keras_history"][0])
+            else:
+                for v in obj.values():
+                    walk(v)
+        elif isinstance(obj, (list, tuple)):
+            if (len(obj) >= 3 and isinstance(obj[0], str)
+                    and isinstance(obj[1], int)):
+                names.append(obj[0])  # Keras 2: [name, node, tensor, {}]
+            else:
+                for v in obj:
+                    walk(v)
+
+    walk(layer_cfg.get("inbound_nodes", []))
+    # dedupe preserving order (multi-arg merges list each input once)
+    seen = set()
+    out = []
+    for n in names:
+        if n not in seen:
+            seen.add(n)
+            out.append(n)
+    return out
