@@ -1,0 +1,29 @@
+"""NLP — the deeplearning4j-nlp layer (ref: D14, ~49k LoC).
+
+Ref: `deeplearning4j-nlp-parent/.../models/sequencevectors/
+SequenceVectors.java:244` (the fit loop all embedding models share),
+`models/word2vec/Word2Vec.java:71`, `models/glove/Glove.java`,
+`models/paragraphvectors/ParagraphVectors.java`, tokenizers under
+`text/tokenization/`, vocab + Huffman under `models/word2vec/wordstore/`.
+
+TPU-first redesign: the reference trains one (center, context) pair at a
+time with per-row axpy updates on the JVM. Here training batches
+thousands of pairs into dense gather->dot->scatter-add steps — one jitted
+program whose matmuls land on the MXU. Negative sampling is the
+TPU-shaped default; the reference's hierarchical-softmax Huffman path is
+implemented too (`use_hierarchic_softmax=True` trains against padded
+Huffman-path tables).
+"""
+from .tokenization import (CommonPreprocessor, DefaultTokenizerFactory,
+                           NGramTokenizerFactory)
+from .vocab import HuffmanTree, VocabCache, VocabWord
+from .word2vec import Word2Vec
+from .paragraph_vectors import ParagraphVectors
+from .glove import Glove
+from .graph import DeepWalk, Node2Vec
+from .serializer import WordVectorSerializer
+
+__all__ = ["Word2Vec", "ParagraphVectors", "Glove", "DeepWalk", "Node2Vec",
+           "VocabCache", "VocabWord", "HuffmanTree", "WordVectorSerializer",
+           "DefaultTokenizerFactory", "NGramTokenizerFactory",
+           "CommonPreprocessor"]

@@ -1,0 +1,124 @@
+"""GloVe: global co-occurrence weighted least squares.
+
+Ref: `models/glove/Glove.java` + `glove/count/` (co-occurrence counting)
+— AdaGrad on f(X_ij)(w_i·w̃_j + b_i + b̃_j − log X_ij)² per Pennington
+et al., which the reference implements pair-at-a-time.
+
+TPU-first: co-occurrences accumulate on host into a COO map once, then
+training consumes the nonzeros in dense index batches under one jitted
+AdaGrad step (gather -> fused elementwise -> scatter-add).
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tokenization import CommonPreprocessor, DefaultTokenizerFactory
+from .vocab import VocabCache
+from .word2vec import _EmbeddingModel, _as_sentences
+
+
+class Glove(_EmbeddingModel):
+    """Ref: Glove.java builder surface (layerSize/windowSize/xMax/alpha/
+    learningRate/epochs/minWordFrequency)."""
+
+    def __init__(self, layer_size: int = 100, window_size: int = 5,
+                 min_word_frequency: int = 1, learning_rate: float = 0.05,
+                 x_max: float = 100.0, alpha: float = 0.75,
+                 epochs: int = 25, batch_size: int = 4096, seed: int = 42,
+                 symmetric: bool = True, tokenizer_factory=None):
+        self.layer_size = layer_size
+        self.window_size = window_size
+        self.min_word_frequency = min_word_frequency
+        self.learning_rate = learning_rate
+        self.x_max = x_max
+        self.alpha = alpha
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.seed = seed
+        self.symmetric = symmetric
+        self.tokenizer = tokenizer_factory or DefaultTokenizerFactory(
+            CommonPreprocessor())
+        self.vocab = VocabCache(min_word_frequency)
+        self.syn0: Optional[np.ndarray] = None
+
+    def _cooccurrences(self, sent_idx) -> Tuple[np.ndarray, ...]:
+        co: Dict[Tuple[int, int], float] = defaultdict(float)
+        for s in sent_idx:
+            n = len(s)
+            for i in range(n):
+                for j in range(max(0, i - self.window_size), i):
+                    w = 1.0 / (i - j)  # distance weighting (GloVe paper)
+                    co[(int(s[i]), int(s[j]))] += w
+                    if self.symmetric:
+                        co[(int(s[j]), int(s[i]))] += w
+        if not co:
+            return (np.zeros(0, np.int32),) * 2 + (np.zeros(0, np.float32),)
+        rows = np.asarray([k[0] for k in co], np.int32)
+        cols = np.asarray([k[1] for k in co], np.int32)
+        vals = np.asarray(list(co.values()), np.float32)
+        return rows, cols, vals
+
+    def fit(self, data) -> "Glove":
+        sentences = _as_sentences(data, self.tokenizer)
+        self.vocab.fit(sentences)
+        V, D = self.vocab.num_words(), self.layer_size
+        sent_idx = [np.asarray([self.vocab.index_of(t) for t in s
+                                if self.vocab.contains_word(t)], np.int64)
+                    for s in sentences]
+        rows, cols, vals = self._cooccurrences(sent_idx)
+        if len(rows) == 0:
+            self.syn0 = np.zeros((V, D), np.float32)
+            return self
+        rng = np.random.RandomState(self.seed)
+        w = ((rng.rand(V, D) - 0.5) / D).astype(np.float32)
+        wt = ((rng.rand(V, D) - 0.5) / D).astype(np.float32)
+        b = np.zeros(V, np.float32)
+        bt = np.zeros(V, np.float32)
+        # AdaGrad accumulators (ref: Glove uses AdaGrad)
+        state = [jnp.full_like(jnp.asarray(a), 1e-8)
+                 for a in (w, wt, b, bt)]
+        params = [jnp.asarray(a) for a in (w, wt, b, bt)]
+        x_max, alpha, lr = self.x_max, self.alpha, self.learning_rate
+
+        def step(params, state, i, j, x):
+            w, wt, b, bt = params
+            gw, gwt, gb, gbt = state
+            wi, wtj = w[i], wt[j]
+            diff = (wi * wtj).sum(-1) + b[i] + bt[j] - jnp.log(x)
+            f = jnp.minimum(1.0, (x / x_max) ** alpha)
+            fd = f * diff                                  # [B]
+            loss = 0.5 * (fd * diff).mean()
+            d_wi = fd[:, None] * wtj
+            d_wtj = fd[:, None] * wi
+            # AdaGrad scatter updates
+            gw = gw.at[i].add(d_wi ** 2)
+            gwt = gwt.at[j].add(d_wtj ** 2)
+            gb = gb.at[i].add(fd ** 2)
+            gbt = gbt.at[j].add(fd ** 2)
+            w = w.at[i].add(-lr * d_wi / jnp.sqrt(gw[i]))
+            wt = wt.at[j].add(-lr * d_wtj / jnp.sqrt(gwt[j]))
+            b = b.at[i].add(-lr * fd / jnp.sqrt(gb[i]))
+            bt = bt.at[j].add(-lr * fd / jnp.sqrt(gbt[j]))
+            return (w, wt, b, bt), (gw, gwt, gb, gbt), loss
+
+        jstep = jax.jit(step, donate_argnums=(0, 1))
+        B = min(self.batch_size, len(rows))
+        for epoch in range(self.epochs):
+            perm = rng.permutation(len(rows))
+            r, c, v = rows[perm], cols[perm], vals[perm]
+            for off in range(0, len(r), B):
+                sl = [a[off:off + B] for a in (r, c, v)]
+                if len(sl[0]) < B:
+                    sl = [np.resize(a, B) for a in sl]
+                params, state, _ = jstep(params, state,
+                                         jnp.asarray(sl[0]),
+                                         jnp.asarray(sl[1]),
+                                         jnp.asarray(sl[2]))
+        w, wt, b, bt = [np.asarray(p) for p in params]
+        self.syn0 = w + wt  # GloVe paper: sum of both sets
+        return self

@@ -1,0 +1,122 @@
+"""Graph embeddings: DeepWalk and node2vec.
+
+Ref: `deeplearning4j-graph/.../models/deepwalk/DeepWalk.java` (random
+walks + skip-gram) and the sequencevectors graph walkers
+(`models/sequencevectors/graph/walkers/impl/{RandomWalker,
+NearestVertexWalker}.java`); node2vec's p/q-biased second-order walks
+(Grover & Leskovec) generalize DeepWalk's uniform walker.
+
+Walk generation is host-side; embedding training reuses the batched
+Word2Vec skip-gram engine (walks are sentences over node-id tokens).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .word2vec import Word2Vec
+
+
+class _WalkModel:
+    def __init__(self, layer_size=64, window_size=5, walk_length=20,
+                 walks_per_node=10, epochs=1, learning_rate=0.025,
+                 negative=5, seed=42, **w2v_kw):
+        self.walk_length = walk_length
+        self.walks_per_node = walks_per_node
+        self.seed = seed
+        self.w2v = Word2Vec(layer_size=layer_size, window_size=window_size,
+                            min_word_frequency=1, epochs=epochs,
+                            learning_rate=learning_rate, negative=negative,
+                            seed=seed, **w2v_kw)
+
+    def _adj(self, edges: Sequence[Tuple[int, int]],
+             n_nodes: Optional[int]) -> List[List[int]]:
+        n = n_nodes if n_nodes is not None else (
+            max(max(a, b) for a, b in edges) + 1)
+        adj: List[List[int]] = [[] for _ in range(n)]
+        for a, b in edges:
+            adj[a].append(b)
+            adj[b].append(a)
+        return adj
+
+    def _walks(self, adj, rng) -> List[List[str]]:
+        raise NotImplementedError
+
+    def fit(self, edges: Sequence[Tuple[int, int]],
+            n_nodes: Optional[int] = None):
+        adj = self._adj(edges, n_nodes)
+        rng = np.random.RandomState(self.seed)
+        walks = self._walks(adj, rng)
+        self.w2v.fit(walks)
+        return self
+
+    def vertex_vector(self, node: int) -> Optional[np.ndarray]:
+        return self.w2v.word_vector(str(node))
+
+    def similarity(self, a: int, b: int) -> float:
+        return self.w2v.similarity(str(a), str(b))
+
+    def verts_nearest(self, node: int, top_n: int = 5) -> List[int]:
+        return [int(w) for w in self.w2v.words_nearest(str(node), top_n)]
+
+
+class DeepWalk(_WalkModel):
+    """Uniform random walks (ref: DeepWalk.java / RandomWalker)."""
+
+    def _walks(self, adj, rng) -> List[List[str]]:
+        walks = []
+        n = len(adj)
+        for _ in range(self.walks_per_node):
+            for start in range(n):
+                if not adj[start]:
+                    continue
+                walk = [start]
+                for _ in range(self.walk_length - 1):
+                    nbrs = adj[walk[-1]]
+                    if not nbrs:
+                        break
+                    walk.append(int(nbrs[rng.randint(len(nbrs))]))
+                walks.append([str(v) for v in walk])
+        return walks
+
+
+class Node2Vec(_WalkModel):
+    """p/q-biased second-order walks (return parameter p, in-out q)."""
+
+    def __init__(self, p: float = 1.0, q: float = 1.0, **kw):
+        super().__init__(**kw)
+        self.p, self.q = float(p), float(q)
+
+    def _walks(self, adj, rng) -> List[List[str]]:
+        walks = []
+        n = len(adj)
+        neighbor_sets = [set(a) for a in adj]
+        for _ in range(self.walks_per_node):
+            for start in range(n):
+                if not adj[start]:
+                    continue
+                walk = [start]
+                prev = None
+                for _ in range(self.walk_length - 1):
+                    cur = walk[-1]
+                    nbrs = adj[cur]
+                    if not nbrs:
+                        break
+                    if prev is None:
+                        nxt = nbrs[rng.randint(len(nbrs))]
+                    else:
+                        weights = np.empty(len(nbrs))
+                        for k, x in enumerate(nbrs):
+                            if x == prev:
+                                weights[k] = 1.0 / self.p
+                            elif x in neighbor_sets[prev]:
+                                weights[k] = 1.0
+                            else:
+                                weights[k] = 1.0 / self.q
+                        weights /= weights.sum()
+                        nxt = nbrs[rng.choice(len(nbrs), p=weights)]
+                    walk.append(int(nxt))
+                    prev = cur
+                walks.append([str(v) for v in walk])
+        return walks

@@ -1,0 +1,195 @@
+"""ParagraphVectors (doc2vec): PV-DBOW and PV-DM.
+
+Ref: `models/paragraphvectors/ParagraphVectors.java` (extends Word2Vec;
+sequence learning algorithms `models/embeddings/learning/impl/sequence/
+{DBOW,DM}.java`), label awareness via LabelsSource, and
+`inferVector` (frozen word weights, gradient steps on a fresh doc
+vector).
+
+TPU-first: doc vectors live in the same lookup tables and train through
+the same batched negative-sampling step as Word2Vec — a document id is
+just one more "word" in the input vocabulary (the reference's
+shared-lookup-table design, done densely).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tokenization import CommonPreprocessor, DefaultTokenizerFactory
+from .vocab import VocabCache
+from .word2vec import (Word2Vec, _EmbeddingModel, _as_sentences, _gen_pairs,
+                       _neg_table)
+
+
+class ParagraphVectors(Word2Vec):
+    """Ref: ParagraphVectors.java. sequence_learning_algorithm:
+    'dbow' (doc vector predicts its words, PV-DBOW) or 'dm' (doc vector
+    joins the averaged context, PV-DM)."""
+
+    def __init__(self, sequence_learning_algorithm: str = "dbow",
+                 **kw):
+        kw.setdefault("elements_learning_algorithm", "skipgram")
+        super().__init__(**kw)
+        self.sequence_algorithm = sequence_learning_algorithm.lower()
+        if self.sequence_algorithm not in ("dbow", "dm"):
+            raise ValueError(
+                f"unknown sequence algorithm {self.sequence_algorithm!r}")
+        self.labels: List[str] = []
+        self.doc_vectors: Optional[np.ndarray] = None
+        self._label_index: Dict[str, int] = {}
+
+    # -- training ------------------------------------------------------
+    def fit(self, documents, labels: Optional[Sequence[str]] = None):
+        """`documents`: iterable of strings / token lists; `labels`: one
+        per document (auto 'doc_N' otherwise — ref: LabelsSource)."""
+        docs = _as_sentences(documents, self.tokenizer)
+        self.labels = list(labels) if labels is not None else \
+            [f"doc_{i}" for i in range(len(docs))]
+        self._label_index = {l: i for i, l in enumerate(self.labels)}
+        self.vocab.fit(docs)
+        V, D = self.vocab.num_words(), self.layer_size
+        rng = np.random.RandomState(self.seed)
+        self.syn0 = ((rng.rand(V, D).astype(np.float32) - 0.5) / D)
+        self.syn1 = np.zeros((V, D), np.float32)
+        self.doc_vectors = ((rng.rand(len(docs), D).astype(np.float32)
+                             - 0.5) / D)
+        doc_idx = [np.asarray([self.vocab.index_of(t) for t in s
+                               if self.vocab.contains_word(t)], np.int64)
+                   for s in docs]
+        table = jnp.asarray(_neg_table(self.vocab))
+        step = self._pv_step()
+        dv = jnp.asarray(self.doc_vectors)
+        syn0 = jnp.asarray(self.syn0)
+        syn1 = jnp.asarray(self.syn1)
+        key = jax.random.PRNGKey(self.seed)
+        B = self.batch_size
+        for epoch in range(self.epochs):
+            d_ids, words, ctxs = self._pv_examples(doc_idx, rng)
+            perm = rng.permutation(len(d_ids))
+            d_ids, words, ctxs = d_ids[perm], words[perm], ctxs[perm]
+            Bz = min(B, max(1, len(d_ids)))
+            lr = self.learning_rate * (1 - epoch / max(1, self.epochs))
+            lr = max(lr, self.min_learning_rate)
+            for off in range(0, len(d_ids), Bz):
+                sl = [a[off:off + Bz] for a in (d_ids, words, ctxs)]
+                if len(sl[0]) < Bz:
+                    sl = [np.resize(a, (Bz,) + a.shape[1:]) for a in sl]
+                key, sub = jax.random.split(key)
+                dv, syn0, syn1 = step(dv, syn0, syn1,
+                                      *[jnp.asarray(a) for a in sl],
+                                      table, jnp.float32(lr), sub)
+        self.doc_vectors = np.asarray(dv)
+        self.syn0 = np.asarray(syn0)
+        self.syn1 = np.asarray(syn1)
+        return self
+
+    def _pv_examples(self, doc_idx, rng):
+        """(doc_id, target word, context word) triples. DBOW ignores the
+        context entry; DM averages doc+context."""
+        d_ids, words, ctxs = [], [], []
+        for di, s in enumerate(doc_idx):
+            if len(s) < 2:
+                continue
+            c, x = _gen_pairs([s], self.window_size, rng)
+            d_ids.extend([di] * len(c))
+            words.extend(c)
+            ctxs.extend(x)
+        return (np.asarray(d_ids, np.int32), np.asarray(words, np.int32),
+                np.asarray(ctxs, np.int32))
+
+    def _pv_step(self):
+        neg = self.negative
+        D = self.layer_size
+        dm = self.sequence_algorithm == "dm"
+
+        def step(dv, syn0, syn1, d_ids, words, ctxs, table, lr, key):
+            B = d_ids.shape[0]
+            if dm:
+                v = 0.5 * (dv[d_ids] + syn0[ctxs])
+            else:
+                v = dv[d_ids]
+            negs = table[jax.random.randint(key, (B, neg), 0,
+                                            table.shape[0])]
+            tgt = jnp.concatenate([words[:, None], negs], 1)
+            u = syn1[tgt]
+            score = jnp.einsum("bd,bkd->bk", v, u)
+            label = jnp.zeros_like(score).at[:, 0].set(1.0)
+            sig = jax.nn.sigmoid(score)
+            g = sig - label
+            gv = jnp.einsum("bk,bkd->bd", g, u)
+            gu = g[:, :, None] * v[:, None, :]
+            # per-row mean updates (see word2vec._make_step: summed
+            # scatter collisions blow up the effective lr)
+            cnt_d = jnp.zeros(dv.shape[0]).at[d_ids].add(1.0)
+            gdv = gv / cnt_d[d_ids][:, None]
+            if dm:
+                cnt_c = jnp.zeros(syn0.shape[0]).at[ctxs].add(1.0)
+                dv = dv.at[d_ids].add(-lr * 0.5 * gdv)
+                syn0 = syn0.at[ctxs].add(
+                    -lr * 0.5 * gv / cnt_c[ctxs][:, None])
+            else:
+                dv = dv.at[d_ids].add(-lr * gdv)
+            tflat = tgt.reshape(-1)
+            cnt_t = jnp.zeros(syn1.shape[0]).at[tflat].add(1.0)
+            syn1 = syn1.at[tflat].add(
+                -lr * gu.reshape(-1, D) / cnt_t[tflat][:, None])
+            return dv, syn0, syn1
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    # -- lookup / inference --------------------------------------------
+    def doc_vector(self, label: str) -> Optional[np.ndarray]:
+        i = self._label_index.get(label)
+        return None if i is None else np.asarray(self.doc_vectors[i])
+
+    def similarity_docs(self, l1: str, l2: str) -> float:
+        a, b = self.doc_vector(l1), self.doc_vector(l2)
+        if a is None or b is None:
+            return float("nan")
+        denom = (np.linalg.norm(a) * np.linalg.norm(b)) + 1e-12
+        return float(a @ b / denom)
+
+    def docs_nearest(self, label_or_vec, top_n: int = 5) -> List[str]:
+        if isinstance(label_or_vec, str):
+            vec = self.doc_vector(label_or_vec)
+            if vec is None:
+                return []
+            exclude = {label_or_vec}
+        else:
+            vec, exclude = np.asarray(label_or_vec), set()
+        m = self.doc_vectors
+        sims = (m @ vec) / ((np.linalg.norm(m, axis=1) + 1e-12)
+                            * (np.linalg.norm(vec) + 1e-12))
+        out = [self.labels[i] for i in np.argsort(-sims)
+               if self.labels[i] not in exclude]
+        return out[:top_n]
+
+    def infer_vector(self, text, steps: int = 25,
+                     lr: float = 0.05) -> np.ndarray:
+        """Ref: ParagraphVectors.inferVector — word weights frozen, SGD on
+        a fresh doc vector only."""
+        toks = self.tokenizer.tokenize(text) if isinstance(text, str) \
+            else list(text)
+        idx = np.asarray([self.vocab.index_of(t) for t in toks
+                          if self.vocab.contains_word(t)], np.int64)
+        rng = np.random.RandomState(self.seed)
+        v = ((rng.rand(self.layer_size) - 0.5)
+             / self.layer_size).astype(np.float32)
+        if len(idx) == 0:
+            return v
+        syn1 = self.syn1  # both DBOW and DM predict into the output table
+        u = np.asarray(syn1[idx])
+        table = _neg_table(self.vocab)
+        for s in range(steps):
+            negs = table[rng.randint(0, len(table), 5 * len(idx))]
+            un = np.asarray(syn1[negs])
+            sig_p = 1 / (1 + np.exp(-u @ v))
+            sig_n = 1 / (1 + np.exp(-un @ v))
+            grad = ((sig_p - 1)[:, None] * u).sum(0) + \
+                (sig_n[:, None] * un).sum(0)
+            v -= lr * grad / len(idx)
+        return v

@@ -1,0 +1,52 @@
+"""Word-vector persistence.
+
+Ref: `models/embeddings/loader/WordVectorSerializer.java` — the
+`writeWord2VecModel` / `readWord2VecModel` text + binary formats the
+whole ecosystem round-trips through (and which interop with original
+word2vec / gensim text vectors).
+"""
+from __future__ import annotations
+
+import gzip
+from typing import Optional
+
+import numpy as np
+
+from .vocab import VocabCache, VocabWord
+from .word2vec import Word2Vec
+
+
+class WordVectorSerializer:
+    @staticmethod
+    def write_word_vectors(model, path: str):
+        """Standard text format: header 'V D', then 'word v1 v2 ...'."""
+        opener = gzip.open if path.endswith(".gz") else open
+        V, D = model.syn0.shape
+        with opener(path, "wt", encoding="utf-8") as f:
+            f.write(f"{V} {D}\n")
+            for i in range(V):
+                word = model.vocab.word_at_index(i)
+                vec = " ".join(f"{x:.6f}" for x in model.syn0[i])
+                f.write(f"{word} {vec}\n")
+
+    @staticmethod
+    def read_word_vectors(path: str) -> Word2Vec:
+        """Load text vectors into a query-only Word2Vec (no syn1)."""
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rt", encoding="utf-8") as f:
+            header = f.readline().split()
+            V, D = int(header[0]), int(header[1])
+            model = Word2Vec(layer_size=D)
+            model.syn0 = np.zeros((V, D), np.float32)
+            vocab = VocabCache()
+            for i in range(V):
+                parts = f.readline().rstrip("\n").split(" ")
+                # tokens may contain spaces (n-grams): the vector is the
+                # last D fields, the word is everything before
+                word = " ".join(parts[:-D])
+                model.syn0[i] = [float(x) for x in parts[-D:]]
+                vw = VocabWord(word, count=V - i, index=i)
+                vocab.words[word] = vw
+                vocab._index.append(word)
+            model.vocab = vocab
+        return model

@@ -1,0 +1,75 @@
+"""Tokenizers (ref: `text/tokenization/tokenizerfactory/
+DefaultTokenizerFactory.java`, `NGramTokenizerFactory.java`,
+`tokenizer/preprocessor/CommonPreprocessor.java`)."""
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional
+
+
+class CommonPreprocessor:
+    """Lowercase + strip punctuation/digits (ref:
+    CommonPreprocessor.java)."""
+
+    _PUNCT = re.compile(r"[\d.:,\"'()\[\]|/?!;]+")
+
+    def pre_process(self, token: str) -> str:
+        return self._PUNCT.sub("", token).lower()
+
+
+class _Tokenizer:
+    def __init__(self, tokens: List[str]):
+        self._tokens = tokens
+        self._pos = 0
+
+    def has_more_tokens(self) -> bool:
+        return self._pos < len(self._tokens)
+
+    def next_token(self) -> str:
+        t = self._tokens[self._pos]
+        self._pos += 1
+        return t
+
+    def get_tokens(self) -> List[str]:
+        return list(self._tokens)
+
+    def count_tokens(self) -> int:
+        return len(self._tokens)
+
+
+class DefaultTokenizerFactory:
+    """Whitespace tokenizer + optional preprocessor (ref:
+    DefaultTokenizerFactory.java)."""
+
+    def __init__(self, preprocessor: Optional[CommonPreprocessor] = None):
+        self.preprocessor = preprocessor
+
+    def set_token_pre_processor(self, p):
+        self.preprocessor = p
+        return self
+
+    def create(self, text: str) -> _Tokenizer:
+        toks = text.split()
+        if self.preprocessor is not None:
+            toks = [self.preprocessor.pre_process(t) for t in toks]
+        return _Tokenizer([t for t in toks if t])
+
+    def tokenize(self, text: str) -> List[str]:
+        return self.create(text).get_tokens()
+
+
+class NGramTokenizerFactory(DefaultTokenizerFactory):
+    """Emit n-grams of the base tokens (ref: NGramTokenizerFactory.java)."""
+
+    def __init__(self, min_n: int = 1, max_n: int = 2,
+                 preprocessor: Optional[CommonPreprocessor] = None):
+        super().__init__(preprocessor)
+        self.min_n, self.max_n = min_n, max_n
+
+    def create(self, text: str) -> _Tokenizer:
+        base = super().create(text).get_tokens()
+        out = []
+        for n in range(self.min_n, self.max_n + 1):
+            for i in range(len(base) - n + 1):
+                out.append(" ".join(base[i:i + n]))
+        return _Tokenizer(out)

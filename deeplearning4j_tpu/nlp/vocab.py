@@ -1,0 +1,108 @@
+"""Vocabulary cache + Huffman coding.
+
+Ref: `models/word2vec/wordstore/inmemory/AbstractCache.java` (VocabCache),
+`models/word2vec/VocabWord.java`, `models/sequencevectors/huffman/` — the
+Huffman tree backs the reference's hierarchical-softmax path; kept here
+for parity (codes/points per word) while TPU training defaults to
+negative sampling.
+"""
+from __future__ import annotations
+
+import heapq
+from collections import Counter
+from typing import Dict, List, Optional, Sequence
+
+
+class VocabWord:
+    def __init__(self, word: str, count: int = 1, index: int = -1):
+        self.word = word
+        self.count = count
+        self.index = index
+        self.codes: List[int] = []    # Huffman code bits
+        self.points: List[int] = []   # inner-node indices
+
+    def __repr__(self):
+        return f"VocabWord({self.word!r}, count={self.count}, " \
+               f"index={self.index})"
+
+
+class VocabCache:
+    """Word <-> index store with frequency filtering (ref:
+    AbstractCache.java)."""
+
+    def __init__(self, min_word_frequency: int = 1):
+        self.min_word_frequency = min_word_frequency
+        self.words: Dict[str, VocabWord] = {}
+        self._index: List[str] = []
+
+    def fit(self, sentences: Sequence[Sequence[str]]) -> "VocabCache":
+        counts = Counter(t for s in sentences for t in s)
+        kept = [(w, c) for w, c in counts.items()
+                if c >= self.min_word_frequency]
+        # descending count, then lexicographic for determinism
+        kept.sort(key=lambda wc: (-wc[1], wc[0]))
+        for i, (w, c) in enumerate(kept):
+            vw = VocabWord(w, c, i)
+            self.words[w] = vw
+            self._index.append(w)
+        return self
+
+    def num_words(self) -> int:
+        return len(self._index)
+
+    def contains_word(self, word: str) -> bool:
+        return word in self.words
+
+    def index_of(self, word: str) -> int:
+        return self.words[word].index if word in self.words else -1
+
+    def word_at_index(self, idx: int) -> str:
+        return self._index[idx]
+
+    def word_frequency(self, word: str) -> int:
+        return self.words[word].count if word in self.words else 0
+
+    def total_word_count(self) -> int:
+        return sum(v.count for v in self.words.values())
+
+    def counts_array(self):
+        import numpy as np
+        return np.asarray([self.words[w].count for w in self._index],
+                          np.float64)
+
+
+class HuffmanTree:
+    """Binary Huffman coding over vocab counts (ref:
+    `sequencevectors/huffman/Huffman.java` — assigns codes/points to each
+    VocabWord for hierarchical softmax)."""
+
+    def __init__(self, vocab: VocabCache):
+        self.vocab = vocab
+        n = vocab.num_words()
+        if n == 0:
+            return
+        heap = [(vocab.words[w].count, i, None) for i, w in
+                enumerate(vocab._index)]
+        heapq.heapify(heap)
+        next_id = n
+        parents: Dict[int, tuple] = {}
+        while len(heap) > 1:
+            c1, i1, _ = heapq.heappop(heap)
+            c2, i2, _ = heapq.heappop(heap)
+            parents[i1] = (next_id, 0)
+            parents[i2] = (next_id, 1)
+            heapq.heappush(heap, (c1 + c2, next_id, None))
+            next_id += 1
+        self.num_inner = next_id - n
+        root = heap[0][1] if heap else None
+        for i, w in enumerate(vocab._index):
+            codes, points = [], []
+            node = i
+            while node != root:
+                parent, bit = parents[node]
+                codes.append(bit)
+                points.append(parent - n)  # inner node id
+                node = parent
+            vw = vocab.words[w]
+            vw.codes = codes[::-1]
+            vw.points = points[::-1]

@@ -1,0 +1,223 @@
+"""NLP tests: tokenizers, vocab/Huffman, Word2Vec (skipgram+cbow), GloVe,
+ParagraphVectors, DeepWalk/node2vec, serialization (SURVEY.md D14/D18).
+
+Correctness bar: on a synthetic two-topic corpus, words from the same
+topic must embed closer than words across topics — the semantic property
+the reference's Word2Vec tests (`Word2VecTests.java`) assert via
+wordsNearest on the raven corpus."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (CommonPreprocessor, DeepWalk,
+                                    DefaultTokenizerFactory, Glove,
+                                    HuffmanTree, NGramTokenizerFactory,
+                                    Node2Vec, ParagraphVectors, VocabCache,
+                                    Word2Vec, WordVectorSerializer)
+
+
+def _topic_corpus(np_rng, n=300):
+    """Sentences drawn from two disjoint topic vocabularies."""
+    topics = [["cat", "dog", "pet", "fur", "paw", "tail"],
+              ["stock", "bond", "market", "trade", "price", "fund"]]
+    out = []
+    for _ in range(n):
+        t = topics[np_rng.randint(2)]
+        out.append(list(np_rng.choice(t, size=8)))
+    return out
+
+
+def _intra_inter(model):
+    intra = np.mean([model.similarity("cat", "dog"),
+                     model.similarity("pet", "fur"),
+                     model.similarity("stock", "bond"),
+                     model.similarity("market", "trade")])
+    inter = np.mean([model.similarity("cat", "stock"),
+                     model.similarity("dog", "market"),
+                     model.similarity("pet", "bond"),
+                     model.similarity("fur", "price")])
+    return intra, inter
+
+
+class TestTokenization:
+    def test_default_tokenizer_with_preprocessor(self):
+        tf = DefaultTokenizerFactory(CommonPreprocessor())
+        toks = tf.tokenize("Hello, World! 42 times.")
+        assert toks == ["hello", "world", "times"]
+        t = tf.create("a b c")
+        assert t.count_tokens() == 3
+        assert t.has_more_tokens() and t.next_token() == "a"
+
+    def test_ngram(self):
+        tf = NGramTokenizerFactory(min_n=1, max_n=2)
+        toks = tf.tokenize("a b c")
+        assert toks == ["a", "b", "c", "a b", "b c"]
+
+
+class TestVocab:
+    def test_fit_and_filtering(self):
+        v = VocabCache(min_word_frequency=2)
+        v.fit([["a", "a", "b", "b", "b", "c"]])
+        assert v.num_words() == 2
+        assert v.index_of("b") == 0  # most frequent first
+        assert not v.contains_word("c")
+        assert v.word_frequency("a") == 2
+
+    def test_huffman_codes(self):
+        v = VocabCache().fit([["a"] * 8 + ["b"] * 4 + ["c"] * 2 + ["d"]])
+        HuffmanTree(v)
+        # more frequent -> shorter code; codes are prefix-free
+        assert len(v.words["a"].codes) <= len(v.words["d"].codes)
+        codes = {w: "".join(map(str, vw.codes))
+                 for w, vw in v.words.items()}
+        for w1, c1 in codes.items():
+            for w2, c2 in codes.items():
+                if w1 != w2:
+                    assert not c2.startswith(c1)
+
+
+class TestWord2Vec:
+    @pytest.mark.parametrize("algo", ["skipgram", "cbow"])
+    def test_topic_separation(self, np_rng, algo):
+        budget = {"skipgram": (20, 0.15), "cbow": (40, 0.3)}[algo]
+        model = Word2Vec(layer_size=24, window_size=3, epochs=budget[0],
+                         learning_rate=budget[1], negative=5, seed=3,
+                         batch_size=512, elements_learning_algorithm=algo)
+        model.fit(_topic_corpus(np_rng))
+        intra, inter = _intra_inter(model)
+        assert intra > inter + 0.2, (algo, intra, inter)
+
+    def test_words_nearest(self, np_rng):
+        model = Word2Vec(layer_size=24, window_size=3, epochs=20,
+                         learning_rate=0.15, seed=3).fit(
+            _topic_corpus(np_rng))
+        near = model.words_nearest("cat", 3)
+        topic0 = {"dog", "pet", "fur", "paw", "tail"}
+        assert len(set(near) & topic0) >= 2
+
+    def test_builder_and_raw_strings(self):
+        model = (Word2Vec.builder().layer_size(8).window_size(2)
+                 .epochs(2).seed(1).build())
+        model.fit(["the cat sat on the mat", "the dog sat on the rug"])
+        assert model.has_word("cat")
+        assert model.word_vector("cat").shape == (8,)
+        assert np.isnan(model.similarity("cat", "zebra"))
+
+    def test_words_nearest_sum_analogy_api(self, np_rng):
+        model = Word2Vec(layer_size=16, epochs=4, seed=0).fit(
+            _topic_corpus(np_rng))
+        out = model.words_nearest_sum(["cat", "dog"], top_n=3)
+        assert "cat" not in out and "dog" not in out and len(out) == 3
+
+    def test_hierarchical_softmax_topic_separation(self, np_rng):
+        model = Word2Vec(layer_size=24, window_size=3, epochs=25,
+                         learning_rate=0.2, seed=3, batch_size=512,
+                         use_hierarchic_softmax=True)
+        model.fit(_topic_corpus(np_rng))
+        intra, inter = _intra_inter(model)
+        assert intra > inter + 0.1, ("hs", intra, inter)
+        # syn1 holds Huffman inner nodes, not word rows
+        assert model.syn1.shape[0] == model.vocab.num_words() - 1
+
+    def test_serialization_handles_ngram_tokens(self, tmp_path):
+        model = Word2Vec(layer_size=4, epochs=1, seed=0,
+                         tokenizer_factory=NGramTokenizerFactory(1, 2))
+        model.fit(["a b c a b"])
+        assert model.has_word("a b")
+        p = str(tmp_path / "ng.txt")
+        WordVectorSerializer.write_word_vectors(model, p)
+        loaded = WordVectorSerializer.read_word_vectors(p)
+        np.testing.assert_allclose(loaded.word_vector("a b"),
+                                   model.word_vector("a b"), atol=1e-5)
+
+    def test_serialization_round_trip(self, np_rng, tmp_path):
+        model = Word2Vec(layer_size=12, epochs=2, seed=0).fit(
+            _topic_corpus(np_rng, 50))
+        p = str(tmp_path / "vecs.txt")
+        WordVectorSerializer.write_word_vectors(model, p)
+        loaded = WordVectorSerializer.read_word_vectors(p)
+        np.testing.assert_allclose(loaded.word_vector("cat"),
+                                   model.word_vector("cat"), atol=1e-5)
+        assert loaded.words_nearest("cat", 2) == \
+            model.words_nearest("cat", 2)
+
+
+class TestGlove:
+    def test_topic_separation(self, np_rng):
+        model = Glove(layer_size=16, window_size=3, epochs=30,
+                      learning_rate=0.1, x_max=10, seed=3)
+        model.fit(_topic_corpus(np_rng))
+        intra, inter = _intra_inter(model)
+        assert intra > inter + 0.2, (intra, inter)
+
+
+class TestParagraphVectors:
+    @pytest.mark.parametrize("algo", ["dbow", "dm"])
+    def test_doc_clustering(self, np_rng, algo):
+        docs = _topic_corpus(np_rng, 80)
+        # label docs by topic to check clustering
+        labels = [f"{'animal' if d[0] in ('cat','dog','pet','fur','paw','tail') else 'finance'}_{i}"
+                  for i, d in enumerate(docs)]
+        pv = ParagraphVectors(layer_size=16, window_size=3, epochs=60,
+                              learning_rate=0.3, seed=3,
+                              sequence_learning_algorithm=algo)
+        pv.fit(docs, labels)
+        a = [l for l in labels if l.startswith("animal")][:8]
+        f = [l for l in labels if l.startswith("finance")][:8]
+        intra = np.mean([pv.similarity_docs(a[i], a[i + 1])
+                         for i in range(0, 6, 2)] +
+                        [pv.similarity_docs(f[i], f[i + 1])
+                         for i in range(0, 6, 2)])
+        inter = np.mean([pv.similarity_docs(a[i], f[i]) for i in range(6)])
+        assert intra > inter, (algo, intra, inter)
+
+    def test_unknown_label_is_nan_not_crash(self, np_rng):
+        pv = ParagraphVectors(layer_size=8, epochs=2, seed=1)
+        pv.fit(_topic_corpus(np_rng, 10))
+        assert np.isnan(pv.similarity_docs("nope", "doc_0"))
+        assert pv.docs_nearest("nope") == []
+
+    def test_infer_vector(self, np_rng):
+        docs = _topic_corpus(np_rng, 60)
+        pv = ParagraphVectors(layer_size=16, epochs=60, seed=3,
+                              learning_rate=0.3)
+        pv.fit(docs)
+        v_animal = pv.infer_vector(["cat", "dog", "pet", "fur"] * 3)
+        v_fin = pv.infer_vector(["stock", "bond", "market", "trade"] * 3)
+        # inferred vectors must differ meaningfully by topic
+        cos = float(v_animal @ v_fin /
+                    (np.linalg.norm(v_animal) * np.linalg.norm(v_fin)
+                     + 1e-12))
+        assert cos < 0.9
+        assert v_animal.shape == (16,)
+
+
+class TestGraphEmbeddings:
+    def _two_cliques(self):
+        """Two 6-cliques joined by one bridge edge."""
+        edges = []
+        for base in (0, 6):
+            for i in range(6):
+                for j in range(i + 1, 6):
+                    edges.append((base + i, base + j))
+        edges.append((0, 6))
+        return edges
+
+    def test_deepwalk_community_structure(self):
+        dw = DeepWalk(layer_size=16, window_size=4, walk_length=10,
+                      walks_per_node=12, epochs=10, seed=3,
+                      learning_rate=0.15)
+        dw.fit(self._two_cliques(), n_nodes=12)
+        intra = np.mean([dw.similarity(1, 2), dw.similarity(3, 4),
+                         dw.similarity(7, 8), dw.similarity(9, 10)])
+        inter = np.mean([dw.similarity(1, 7), dw.similarity(2, 9),
+                         dw.similarity(3, 10), dw.similarity(4, 8)])
+        assert intra > inter, (intra, inter)
+        near = dw.verts_nearest(1, 4)
+        assert len(set(near) & {0, 2, 3, 4, 5}) >= 2
+
+    def test_node2vec_runs_with_bias(self):
+        nv = Node2Vec(p=0.5, q=2.0, layer_size=8, walk_length=8,
+                      walks_per_node=4, epochs=2, seed=1)
+        nv.fit(self._two_cliques(), n_nodes=12)
+        assert nv.vertex_vector(0).shape == (8,)
+        assert np.isfinite(nv.similarity(0, 1))
