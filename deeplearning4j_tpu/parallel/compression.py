@@ -32,28 +32,20 @@ import numpy as np
 def threshold_encode(update: np.ndarray, threshold: float):
     """Encode |u|>=t entries as a flat int64 index array with the sign in
     the low bit (ref encoding: compressed integer stream). Returns
-    (encoded indices, residual) — residual = update - decoded(encoded)."""
-    flat = np.asarray(update).ravel()
-    mask = np.abs(flat) >= threshold
-    idx = np.nonzero(mask)[0]
-    neg = (flat[idx] < 0).astype(np.int64)
-    encoded = (idx.astype(np.int64) << 1) | neg
-    residual = flat.copy()
-    residual[idx] -= np.where(neg == 1, -threshold, threshold)
-    return encoded, residual.reshape(update.shape)
+    (encoded indices, residual) — residual = update - decoded(encoded).
+
+    Delegates to the native codec (deeplearning4j_tpu.runtime, the
+    counterpart of the reference's NativeOpExecutioner.thresholdEncode
+    :1328 native kernels) with a numpy fallback inside."""
+    from .. import runtime as rt
+    return rt.threshold_encode(np.asarray(update, np.float32), threshold)
 
 
 def threshold_decode(encoded: np.ndarray, shape, threshold: float,
                      out: Optional[np.ndarray] = None) -> np.ndarray:
     """Decode into a dense array (accumulating into `out` if given)."""
-    if out is None:
-        out = np.zeros(int(np.prod(shape)), np.float32)
-    else:
-        out = out.ravel()
-    idx = (encoded >> 1).astype(np.int64)
-    sign = np.where((encoded & 1) == 1, -1.0, 1.0).astype(np.float32)
-    np.add.at(out, idx, sign * threshold)
-    return out.reshape(shape)
+    from .. import runtime as rt
+    return rt.threshold_decode(encoded, shape, threshold, out)
 
 
 # ---------------------------------------------------------------------------
@@ -176,7 +168,7 @@ class EncodedGradientsAccumulator:
         out = {k: np.asarray(g, np.float32).copy() for k, g in grads.items()}
         for _, msg in self.bus.drain(self.node_id):
             for k, (encoded, thr) in msg.items():
-                # sender adapts its threshold AFTER encoding; decode with
-                # the threshold that produced the message
-                threshold_decode(encoded, self.shapes[k], thr, out[k])
+                # decode with the threshold that produced the message
+                out[k] = threshold_decode(encoded, self.shapes[k], thr,
+                                          out[k])
         return out

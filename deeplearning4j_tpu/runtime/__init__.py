@@ -1,0 +1,326 @@
+"""Native runtime bindings (ctypes over the C ABI in
+`native/include/dl4jtpu_runtime.h`).
+
+Ref: this layer plays the role of nd4j's JavaCPP bindings over
+`blas/NativeOps.h` (N1) — a thin typed veneer over a flat C ABI — for
+the host-side runtime pieces that stay native on TPU (workspaces,
+threshold codec, npy IO, CSV fast path; SURVEY.md §2.1 mapping note).
+
+The shared library is built on demand from `native/` with g++ (cached
+next to the sources). Every binding has a pure-numpy fallback so the
+framework functions without a toolchain; `available()` reports which
+path is active.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libdl4jtpu_runtime.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        r = subprocess.run(["make", "-C", _NATIVE_DIR, "-s"],
+                           capture_output=True, timeout=120)
+        return r.returncode == 0 and os.path.exists(_SO_PATH)
+    except Exception:
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_SO_PATH) and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+    except OSError:
+        return None
+    c = ctypes
+    lib.dl4j_abi_version.restype = c.c_int32
+    lib.ws_create.restype = c.c_void_p
+    lib.ws_create.argtypes = [c.c_int64]
+    lib.ws_destroy.argtypes = [c.c_void_p]
+    lib.ws_alloc.restype = c.c_void_p
+    lib.ws_alloc.argtypes = [c.c_void_p, c.c_int64, c.c_int32]
+    lib.ws_reset.argtypes = [c.c_void_p]
+    lib.ws_cycle.argtypes = [c.c_void_p]
+    for fn in ("ws_capacity", "ws_used", "ws_spilled", "ws_cycles"):
+        getattr(lib, fn).restype = c.c_int64
+        getattr(lib, fn).argtypes = [c.c_void_p]
+    f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    lib.thr_encode.restype = c.c_int64
+    lib.thr_encode.argtypes = [f32p, c.c_int64, c.c_float, i64p, c.c_int64]
+    lib.thr_decode.argtypes = [i64p, c.c_int64, c.c_float, f32p, c.c_int64]
+    lib.bitmap_encode.restype = c.c_int64
+    lib.bitmap_encode.argtypes = [f32p, c.c_int64, c.c_float, i32p]
+    lib.bitmap_decode.argtypes = [i32p, c.c_int64, c.c_float, f32p]
+    lib.npy_save.restype = c.c_int32
+    lib.npy_save.argtypes = [c.c_char_p, c.c_void_p, c.c_int32, i64p,
+                             c.c_int32]
+    lib.npy_header.restype = c.c_int32
+    lib.npy_header.argtypes = [c.c_char_p, i64p,
+                               c.POINTER(c.c_int32), c.POINTER(c.c_int64)]
+    lib.npy_read.restype = c.c_int32
+    lib.npy_read.argtypes = [c.c_char_p, c.c_void_p, c.c_int64]
+    lib.csv_parse_floats.restype = c.c_int64
+    lib.csv_parse_floats.argtypes = [c.c_char_p, c.c_int64, c.c_char,
+                                     f32p, c.c_int64,
+                                     c.POINTER(c.c_int64),
+                                     c.POINTER(c.c_int64)]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# ---------------------------------------------------------------------------
+# workspaces
+# ---------------------------------------------------------------------------
+class Workspace:
+    """Ring-buffer arena with cyclic learning (ref: Nd4jWorkspace.java:59;
+    native include/memory/Workspace.h). Python-fallback keeps the same
+    accounting so tests/semantics hold without the .so."""
+
+    def __init__(self, initial_bytes: int = 1 << 20):
+        self._lib = _load()
+        if self._lib is not None:
+            self._h = self._lib.ws_create(initial_bytes)
+        else:
+            self._capacity = max(1024, initial_bytes)
+            self._offset = 0
+            self._spilled = 0
+            self._cycles = 0
+
+    def alloc(self, nbytes: int, alignment: int = 64) -> int:
+        """Returns an address (native) or offset (fallback) — the tests
+        exercise the accounting, callers use numpy buffers on top."""
+        if self._lib is not None:
+            return int(self._lib.ws_alloc(self._h, nbytes, alignment))
+        off = (self._offset + alignment - 1) & ~(alignment - 1)
+        if off + nbytes <= self._capacity:
+            self._offset = off + nbytes
+            return off
+        self._spilled += nbytes
+        return -1
+
+    def reset(self):
+        if self._lib is not None:
+            self._lib.ws_reset(self._h)
+        else:
+            self._offset = 0
+
+    def cycle(self):
+        if self._lib is not None:
+            self._lib.ws_cycle(self._h)
+        else:
+            self._cycles += 1
+            if self._spilled:
+                self._capacity += self._spilled
+            self._spilled = 0
+            self._offset = 0
+
+    @property
+    def capacity(self) -> int:
+        if self._lib is not None:
+            return self._lib.ws_capacity(self._h)
+        return self._capacity
+
+    @property
+    def used(self) -> int:
+        if self._lib is not None:
+            return self._lib.ws_used(self._h)
+        return self._offset
+
+    @property
+    def spilled(self) -> int:
+        if self._lib is not None:
+            return self._lib.ws_spilled(self._h)
+        return self._spilled
+
+    def close(self):
+        if self._lib is not None and self._h:
+            self._lib.ws_destroy(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.reset()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# threshold codec
+# ---------------------------------------------------------------------------
+def threshold_encode(grad: np.ndarray, threshold: float,
+                     cap: Optional[int] = None
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """Native Strom encode. Returns (encoded int64 array, residual).
+    `grad` is not modified; the residual is returned separately."""
+    flat = np.ascontiguousarray(grad, np.float32).ravel().copy()
+    cap = int(cap if cap is not None else flat.size)
+    lib = _load()
+    if lib is not None:
+        out = np.empty(cap, np.int64)
+        n = lib.thr_encode(flat, flat.size, np.float32(threshold), out, cap)
+        return out[:n].copy(), flat.reshape(grad.shape)
+    mask = np.abs(flat) >= threshold
+    idx = np.nonzero(mask)[0][:cap]
+    neg = (flat[idx] < 0).astype(np.int64)
+    encoded = (idx.astype(np.int64) << 1) | neg
+    flat[idx] -= np.where(neg == 1, -threshold, threshold)
+    return encoded, flat.reshape(grad.shape)
+
+
+def threshold_decode(encoded: np.ndarray, shape, threshold: float,
+                     out: Optional[np.ndarray] = None) -> np.ndarray:
+    n = int(np.prod(shape))
+    if out is None:
+        out = np.zeros(n, np.float32)
+    else:
+        out = np.ascontiguousarray(out, np.float32).ravel()
+    enc = np.ascontiguousarray(encoded, np.int64)
+    lib = _load()
+    if lib is not None:
+        lib.thr_decode(enc, enc.size, np.float32(threshold), out, n)
+    else:
+        idx = (enc >> 1).astype(np.int64)
+        sign = np.where((enc & 1) == 1, -1.0, 1.0).astype(np.float32)
+        np.add.at(out, idx, sign * threshold)
+    return out.reshape(shape)
+
+
+def bitmap_encode(grad: np.ndarray, threshold: float
+                  ) -> Tuple[np.ndarray, np.ndarray, int]:
+    """2-bit bitmap encode (ref: bitmapEncode). Returns
+    (words int32, residual, nonzero count)."""
+    flat = np.ascontiguousarray(grad, np.float32).ravel().copy()
+    nwords = (flat.size + 15) // 16
+    words = np.zeros(nwords, np.int32)
+    lib = _load()
+    if lib is not None:
+        cnt = lib.bitmap_encode(flat, flat.size, np.float32(threshold),
+                                words)
+        return words, flat.reshape(grad.shape), int(cnt)
+    pos = flat >= threshold
+    negm = flat <= -threshold
+    idx = np.arange(flat.size)
+    shifts = ((idx & 15) * 2).astype(np.int64)
+    w = np.zeros(nwords, np.int64)
+    np.bitwise_or.at(w, idx[pos] >> 4, np.int64(1) << shifts[pos])
+    np.bitwise_or.at(w, idx[negm] >> 4, np.int64(2) << shifts[negm])
+    flat[pos] -= threshold
+    flat[negm] += threshold
+    words = (w & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
+    return words, flat.reshape(grad.shape), int(pos.sum() + negm.sum())
+
+
+def bitmap_decode(words: np.ndarray, n: int,
+                  threshold: float) -> np.ndarray:
+    out = np.zeros(n, np.float32)
+    lib = _load()
+    w = np.ascontiguousarray(words, np.int32)
+    if lib is not None:
+        lib.bitmap_decode(w, n, np.float32(threshold), out)
+        return out
+    idx = np.arange(n)
+    bits = (w.astype(np.int64)[idx >> 4] >> ((idx & 15) * 2)) & 3
+    out[bits == 1] = threshold
+    out[bits == 2] = -threshold
+    return out
+
+
+# ---------------------------------------------------------------------------
+# npy IO
+# ---------------------------------------------------------------------------
+_DTYPES = {np.dtype(np.float32): 0, np.dtype(np.float64): 1,
+           np.dtype(np.int32): 2, np.dtype(np.int64): 3,
+           np.dtype(np.uint8): 4, np.dtype(np.int8): 5,
+           np.dtype(np.bool_): 6}
+_DTYPES_INV = {v: k for k, v in _DTYPES.items()}
+
+
+def npy_save(path: str, arr: np.ndarray):
+    lib = _load()
+    arr = np.ascontiguousarray(arr)
+    if lib is None or arr.dtype not in _DTYPES:
+        np.save(path, arr)
+        return
+    shape = np.asarray(arr.shape, np.int64)
+    rc = lib.npy_save(path.encode(), arr.ctypes.data_as(ctypes.c_void_p),
+                      _DTYPES[arr.dtype], shape, arr.ndim)
+    if rc != 0:
+        np.save(path, arr)
+
+
+def npy_load(path: str) -> np.ndarray:
+    lib = _load()
+    if lib is None:
+        return np.load(path)
+    shape = np.zeros(8, np.int64)
+    ndim = ctypes.c_int32()
+    nbytes = ctypes.c_int64()
+    dtype = lib.npy_header(path.encode(), shape, ctypes.byref(ndim),
+                           ctypes.byref(nbytes))
+    if dtype < 0:
+        return np.load(path)
+    out = np.empty(nbytes.value, np.uint8)
+    rc = lib.npy_read(path.encode(), out.ctypes.data_as(ctypes.c_void_p),
+                      nbytes.value)
+    if rc != 0:
+        return np.load(path)
+    return out.view(_DTYPES_INV[dtype]).reshape(
+        tuple(int(s) for s in shape[:ndim.value]))
+
+
+# ---------------------------------------------------------------------------
+# CSV fast path
+# ---------------------------------------------------------------------------
+def csv_parse_floats(text: str, delimiter: str = ","
+                     ) -> Optional[np.ndarray]:
+    """Parse a numeric CSV blob to a [rows, cols] float32 array; None on
+    malformed input (caller falls back to the python reader)."""
+    lib = _load()
+    raw = text.encode()
+    if lib is not None:
+        cap = max(16, raw.count(delimiter.encode())
+                  + raw.count(b"\n") + 2)
+        out = np.empty(cap, np.float32)
+        rows = ctypes.c_int64()
+        cols = ctypes.c_int64()
+        n = lib.csv_parse_floats(raw, len(raw), delimiter.encode(),
+                                 out, cap, ctypes.byref(rows),
+                                 ctypes.byref(cols))
+        if n < 0:
+            return None
+        return out[:n].reshape(rows.value, cols.value).copy()
+    try:
+        rows = [r for r in text.splitlines() if r.strip()]
+        return np.asarray([[float(c) for c in r.split(delimiter)]
+                           for r in rows], np.float32)
+    except ValueError:
+        return None

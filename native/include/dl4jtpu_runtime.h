@@ -1,0 +1,88 @@
+/* dl4jtpu runtime — native host-side core.
+ *
+ * The TPU-native counterpart of the reference's libnd4j HOST
+ * responsibilities that do not collapse into XLA (SURVEY.md §2.1 mapping
+ * note: N2-N8 become StableHLO/XLA; what remains native is the runtime
+ * AROUND the compiled program):
+ *  - workspaces: ring-buffer arena allocator with cyclic learning +
+ *    spill accounting (ref: include/memory/Workspace.h, Java mirror
+ *    nd4j-api Nd4jWorkspace.java:59 alloc :321, policy enums in
+ *    nd4j-buffer memory/enums/)
+ *  - threshold codec: Strom-2015 gradient encode/decode with residual
+ *    carry (ref: NativeOpExecutioner.thresholdEncode/Decode
+ *    :1328-1420 — native kernels behind EncodingHandler.java:51)
+ *  - cnpy-role .npy IO (ref: libnd4j include/cnpy/)
+ *  - CSV numeric fast path (host ETL feeding the device pipeline,
+ *    the role of datavec's native loaders)
+ *
+ * Flat C ABI mirroring the role of blas/NativeOps.h: every entry point
+ * is extern "C", so the Python layer binds with ctypes (no pybind11).
+ */
+#ifndef DL4JTPU_RUNTIME_H
+#define DL4JTPU_RUNTIME_H
+
+#include <cstdint>
+#include <cstddef>
+
+extern "C" {
+
+/* ---- version/capability probe ---- */
+int32_t dl4j_abi_version();
+
+/* ---- workspaces (ring-buffer arena) ----
+ * Semantics follow Nd4jWorkspace: allocations are bump-pointer within a
+ * fixed arena; when the arena is exhausted the allocation "spills" to
+ * malloc and is tracked so the next cycle can grow (LearningPolicy
+ * OVER_TIME). reset() rewinds the bump pointer (end of scope);
+ * spilled blocks are freed on reset. */
+typedef struct dl4j_workspace dl4j_workspace;
+
+dl4j_workspace *ws_create(int64_t initial_bytes);
+void ws_destroy(dl4j_workspace *ws);
+/* returns pointer valid until the next reset; never NULL for n>0 */
+void *ws_alloc(dl4j_workspace *ws, int64_t nbytes, int32_t alignment);
+void ws_reset(dl4j_workspace *ws);
+/* end-of-cycle: grows the arena to cover observed spills (learning) */
+void ws_cycle(dl4j_workspace *ws);
+int64_t ws_capacity(const dl4j_workspace *ws);
+int64_t ws_used(const dl4j_workspace *ws);
+int64_t ws_spilled(const dl4j_workspace *ws);
+int64_t ws_cycles(const dl4j_workspace *ws);
+
+/* ---- threshold gradient codec (Strom 2015) ----
+ * encode: residual+update in `grad` (modified in place to the new
+ * residual); indices of |g|>=threshold written to out_encoded as
+ * (idx<<1)|signbit. Returns the count (<= cap; extra quanta stay in the
+ * residual for the next round, matching the reference's bounded-message
+ * behavior). */
+int64_t thr_encode(float *grad, int64_t n, float threshold,
+                   int64_t *out_encoded, int64_t cap);
+/* decode-accumulate into out (+= sign*threshold per entry) */
+void thr_decode(const int64_t *encoded, int64_t count, float threshold,
+                float *out, int64_t n);
+/* bitmap variant (ref: NativeOpExecutioner bitmapEncode): 2 bits per
+ * element, 16 elements per int32 word. Returns nonzero count. */
+int64_t bitmap_encode(float *grad, int64_t n, float threshold,
+                      int32_t *out_words);
+void bitmap_decode(const int32_t *words, int64_t n, float threshold,
+                   float *out);
+
+/* ---- .npy IO (cnpy role) ----
+ * dtype codes: 0=f32 1=f64 2=i32 3=i64 4=u8 5=i8 6=bool */
+int32_t npy_save(const char *path, const void *data, int32_t dtype,
+                 const int64_t *shape, int32_t ndim);
+/* reads header; returns dtype code or -1. shape_out must hold 8. */
+int32_t npy_header(const char *path, int64_t *shape_out, int32_t *ndim_out,
+                   int64_t *nbytes_out);
+int32_t npy_read(const char *path, void *out, int64_t nbytes);
+
+/* ---- CSV numeric fast path ----
+ * Parses ascii float rows. Returns number of values written, or -1 on
+ * malformed input. Cells parse as float; delimiter configurable. */
+int64_t csv_parse_floats(const char *buf, int64_t len, char delimiter,
+                         float *out, int64_t cap, int64_t *rows_out,
+                         int64_t *cols_out);
+
+} /* extern "C" */
+
+#endif

@@ -1,0 +1,330 @@
+/* Implementation of the dl4jtpu native runtime core.
+ * See dl4jtpu_runtime.h for the reference mapping. */
+#include "dl4jtpu_runtime.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+
+int32_t dl4j_abi_version() { return 1; }
+
+/* ================= workspaces ================= */
+
+struct dl4j_workspace {
+  char *arena = nullptr;
+  int64_t capacity = 0;
+  int64_t offset = 0;
+  int64_t spilled_this_cycle = 0;
+  int64_t cycles = 0;
+  std::vector<void *> spills;
+};
+
+dl4j_workspace *ws_create(int64_t initial_bytes) {
+  auto *ws = new dl4j_workspace();
+  ws->capacity = initial_bytes > 0 ? initial_bytes : 1024;
+  ws->arena = static_cast<char *>(std::malloc(ws->capacity));
+  return ws;
+}
+
+void ws_destroy(dl4j_workspace *ws) {
+  if (!ws) return;
+  for (void *p : ws->spills) std::free(p);
+  std::free(ws->arena);
+  delete ws;
+}
+
+void *ws_alloc(dl4j_workspace *ws, int64_t nbytes, int32_t alignment) {
+  if (nbytes <= 0) return nullptr;
+  int64_t align = alignment > 0 ? alignment : 8;
+  /* align the ABSOLUTE address (malloc'd arena base need not be
+   * align-aligned), not just the offset */
+  auto base = reinterpret_cast<uintptr_t>(ws->arena);
+  uintptr_t addr = (base + ws->offset + align - 1) & ~uintptr_t(align - 1);
+  int64_t off = static_cast<int64_t>(addr - base);
+  if (off + nbytes <= ws->capacity) {
+    ws->offset = off + nbytes;
+    return ws->arena + off;
+  }
+  /* spill: malloc-backed, tracked for learning + freed on reset
+   * (ref: SpillPolicy.EXTERNAL + ALLOCATION OVER_TIME learning) */
+  ws->spilled_this_cycle += nbytes;
+  void *p = std::malloc(nbytes);
+  ws->spills.push_back(p);
+  return p;
+}
+
+void ws_reset(dl4j_workspace *ws) {
+  ws->offset = 0;
+  for (void *p : ws->spills) std::free(p);
+  ws->spills.clear();
+}
+
+void ws_cycle(dl4j_workspace *ws) {
+  ws->cycles++;
+  if (ws->spilled_this_cycle > 0) {
+    int64_t want = ws->capacity + ws->spilled_this_cycle;
+    char *bigger = static_cast<char *>(std::realloc(ws->arena, want));
+    if (bigger) {
+      ws->arena = bigger;
+      ws->capacity = want;
+    }
+  }
+  ws->spilled_this_cycle = 0;
+  ws_reset(ws);
+}
+
+int64_t ws_capacity(const dl4j_workspace *ws) { return ws->capacity; }
+int64_t ws_used(const dl4j_workspace *ws) { return ws->offset; }
+int64_t ws_spilled(const dl4j_workspace *ws) {
+  return ws->spilled_this_cycle;
+}
+int64_t ws_cycles(const dl4j_workspace *ws) { return ws->cycles; }
+
+/* ================= threshold codec ================= */
+
+int64_t thr_encode(float *grad, int64_t n, float threshold,
+                   int64_t *out_encoded, int64_t cap) {
+  int64_t count = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    float g = grad[i];
+    if (g >= threshold) {
+      if (count < cap) {
+        out_encoded[count++] = (i << 1);
+        grad[i] = g - threshold;
+      }
+    } else if (g <= -threshold) {
+      if (count < cap) {
+        out_encoded[count++] = (i << 1) | 1;
+        grad[i] = g + threshold;
+      }
+    }
+  }
+  return count;
+}
+
+void thr_decode(const int64_t *encoded, int64_t count, float threshold,
+                float *out, int64_t n) {
+  for (int64_t k = 0; k < count; ++k) {
+    int64_t e = encoded[k];
+    int64_t i = e >> 1;
+    if (i >= 0 && i < n) out[i] += (e & 1) ? -threshold : threshold;
+  }
+}
+
+/* 2-bit bitmap: 00 = zero, 01 = +threshold, 10 = -threshold
+ * (ref: the bitmap encoding family in NativeOpExecutioner) */
+int64_t bitmap_encode(float *grad, int64_t n, float threshold,
+                      int32_t *out_words) {
+  int64_t nwords = (n + 15) / 16;
+  std::memset(out_words, 0, nwords * sizeof(int32_t));
+  int64_t count = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    float g = grad[i];
+    uint32_t bits = 0;
+    if (g >= threshold) {
+      bits = 1u;
+      grad[i] = g - threshold;
+      ++count;
+    } else if (g <= -threshold) {
+      bits = 2u;
+      grad[i] = g + threshold;
+      ++count;
+    }
+    if (bits) out_words[i >> 4] |= bits << ((i & 15) * 2);
+  }
+  return count;
+}
+
+void bitmap_decode(const int32_t *words, int64_t n, float threshold,
+                   float *out) {
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t bits = (static_cast<uint32_t>(words[i >> 4])
+                     >> ((i & 15) * 2)) & 3u;
+    if (bits == 1u) out[i] += threshold;
+    else if (bits == 2u) out[i] -= threshold;
+  }
+}
+
+/* ================= .npy IO ================= */
+
+static const char *npy_descr(int32_t dtype) {
+  switch (dtype) {
+    case 0: return "<f4";
+    case 1: return "<f8";
+    case 2: return "<i4";
+    case 3: return "<i8";
+    case 4: return "|u1";
+    case 5: return "|i1";
+    case 6: return "|b1";
+    default: return nullptr;
+  }
+}
+
+static int64_t dtype_size(int32_t dtype) {
+  switch (dtype) {
+    case 0: case 2: return 4;
+    case 1: case 3: return 8;
+    default: return 1;
+  }
+}
+
+int32_t npy_save(const char *path, const void *data, int32_t dtype,
+                 const int64_t *shape, int32_t ndim) {
+  const char *descr = npy_descr(dtype);
+  if (!descr || ndim < 0 || ndim > 8) return -1;
+  std::string header = "{'descr': '";
+  header += descr;
+  header += "', 'fortran_order': False, 'shape': (";
+  int64_t count = 1;
+  for (int32_t i = 0; i < ndim; ++i) {
+    header += std::to_string(shape[i]);
+    header += (ndim == 1 || i + 1 < ndim) ? "," : "";
+    if (i + 1 < ndim) header += " ";
+    count *= shape[i];
+  }
+  header += "), }";
+  /* pad so magic+len+header is a multiple of 64, newline-terminated */
+  size_t base = 10 + header.size() + 1;
+  size_t pad = (64 - base % 64) % 64;
+  header.append(pad, ' ');
+  header += '\n';
+  FILE *f = std::fopen(path, "wb");
+  if (!f) return -1;
+  uint16_t hlen = static_cast<uint16_t>(header.size());
+  std::fwrite("\x93NUMPY\x01\x00", 1, 8, f);
+  std::fwrite(&hlen, 2, 1, f);
+  std::fwrite(header.data(), 1, header.size(), f);
+  std::fwrite(data, 1, count * dtype_size(dtype), f);
+  std::fclose(f);
+  return 0;
+}
+
+static int32_t parse_npy_header(FILE *f, int64_t *shape_out,
+                                int32_t *ndim_out, int64_t *nbytes_out) {
+  char magic[8];
+  if (std::fread(magic, 1, 8, f) != 8) return -1;
+  if (std::memcmp(magic, "\x93NUMPY", 6) != 0) return -1;
+  uint32_t hlen = 0;
+  if (magic[6] == 1) {
+    uint16_t h16;
+    if (std::fread(&h16, 2, 1, f) != 1) return -1;
+    hlen = h16;
+  } else {
+    if (std::fread(&hlen, 4, 1, f) != 1) return -1;
+  }
+  std::string header(hlen, '\0');
+  if (std::fread(&header[0], 1, hlen, f) != hlen) return -1;
+  /* descr */
+  size_t dp = header.find("'descr'");
+  if (dp == std::string::npos) return -1;
+  size_t q1 = header.find('\'', dp + 7);
+  size_t q2 = header.find('\'', q1 + 1);
+  std::string descr = header.substr(q1 + 1, q2 - q1 - 1);
+  int32_t dtype = -1;
+  for (int32_t c = 0; c <= 6; ++c) {
+    if (descr == npy_descr(c)) { dtype = c; break; }
+  }
+  if (dtype < 0 && descr == "<b1") dtype = 6;
+  if (dtype < 0) return -1;
+  /* fortran order unsupported */
+  if (header.find("'fortran_order': True") != std::string::npos) return -1;
+  /* shape */
+  size_t sp = header.find("'shape'");
+  size_t p1 = header.find('(', sp);
+  size_t p2 = header.find(')', p1);
+  std::string dims = header.substr(p1 + 1, p2 - p1 - 1);
+  int32_t ndim = 0;
+  int64_t count = 1;
+  size_t pos = 0;
+  while (pos < dims.size() && ndim < 8) {
+    while (pos < dims.size() && (dims[pos] == ' ' || dims[pos] == ','))
+      ++pos;
+    if (pos >= dims.size()) break;
+    int64_t d = std::strtoll(dims.c_str() + pos, nullptr, 10);
+    shape_out[ndim++] = d;
+    count *= d;
+    while (pos < dims.size() && dims[pos] != ',') ++pos;
+  }
+  *ndim_out = ndim;
+  *nbytes_out = count * dtype_size(dtype);
+  return dtype;
+}
+
+int32_t npy_header(const char *path, int64_t *shape_out, int32_t *ndim_out,
+                   int64_t *nbytes_out) {
+  FILE *f = std::fopen(path, "rb");
+  if (!f) return -1;
+  int32_t dtype = parse_npy_header(f, shape_out, ndim_out, nbytes_out);
+  std::fclose(f);
+  return dtype;
+}
+
+int32_t npy_read(const char *path, void *out, int64_t nbytes) {
+  FILE *f = std::fopen(path, "rb");
+  if (!f) return -1;
+  int64_t shape[8];
+  int32_t ndim;
+  int64_t have;
+  int32_t dtype = parse_npy_header(f, shape, &ndim, &have);
+  if (dtype < 0 || have > nbytes) {
+    std::fclose(f);
+    return -1;
+  }
+  size_t got = std::fread(out, 1, have, f);
+  std::fclose(f);
+  return got == static_cast<size_t>(have) ? 0 : -1;
+}
+
+/* ================= CSV fast path ================= */
+
+int64_t csv_parse_floats(const char *buf, int64_t len, char delimiter,
+                         float *out, int64_t cap, int64_t *rows_out,
+                         int64_t *cols_out) {
+  int64_t written = 0, rows = 0, cols = -1, cur_cols = 0;
+  const char *p = buf;
+  const char *end = buf + len;
+  while (p < end) {
+    /* one row */
+    cur_cols = 0;
+    bool row_empty = true;
+    while (p < end && *p != '\n') {
+      char *next = nullptr;
+      float v = std::strtof(p, &next);
+      if (next == p) {
+        /* not a number: malformed cell */
+        if (*p == delimiter) { /* empty cell -> 0 */
+          v = 0.0f;
+          next = const_cast<char *>(p);
+        } else {
+          return -1;
+        }
+      }
+      if (written >= cap) return -1;
+      out[written++] = v;
+      ++cur_cols;
+      row_empty = false;
+      p = next;
+      while (p < end && *p != delimiter && *p != '\n') {
+        if (*p != ' ' && *p != '\r') return -1;
+        ++p;
+      }
+      if (p < end && *p == delimiter) ++p;
+    }
+    if (p < end) ++p; /* consume newline */
+    if (row_empty) continue;
+    if (cols < 0) cols = cur_cols;
+    else if (cols != cur_cols) return -1;
+    ++rows;
+  }
+  *rows_out = rows;
+  *cols_out = cols < 0 ? 0 : cols;
+  return written;
+}
+
+} /* extern "C" */

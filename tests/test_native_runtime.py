@@ -1,0 +1,129 @@
+"""Native runtime tests: workspace arena, threshold/bitmap codecs, npy
+IO, CSV fast path — both the C++ path and the numpy fallback (the same
+suite runs against whichever loaded, mirroring the reference's
+one-suite-many-backends strategy, SURVEY.md §4.1)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import runtime as rt
+
+
+def test_native_library_loads():
+    # the toolchain is part of the environment; the native path must be up
+    assert rt.available(), "native runtime failed to build/load"
+
+
+class TestWorkspace:
+    def test_alloc_reset_cycle(self):
+        ws = rt.Workspace(1024)
+        a = ws.alloc(256)
+        b = ws.alloc(256)
+        assert a != b
+        assert ws.used >= 512
+        ws.reset()
+        assert ws.used == 0
+        ws.close()
+
+    def test_alignment(self):
+        ws = rt.Workspace(4096)
+        ws.alloc(3)
+        p = ws.alloc(8, alignment=64)
+        if rt.available():
+            assert p % 64 == 0
+        ws.close()
+
+    def test_spill_and_learning(self):
+        # over-allocate -> spills tracked; cycle() grows capacity
+        ws = rt.Workspace(1024)
+        cap0 = ws.capacity
+        ws.alloc(900)
+        ws.alloc(900)  # spills
+        assert ws.spilled >= 900
+        ws.cycle()
+        assert ws.capacity > cap0  # learned the real footprint
+        assert ws.spilled == 0
+        # next cycle fits without spilling
+        ws.alloc(900)
+        ws.alloc(900)
+        assert ws.spilled == 0
+        ws.close()
+
+    def test_context_manager(self):
+        with rt.Workspace(512) as ws:
+            ws.alloc(100)
+            assert ws.used >= 100
+        assert ws.used == 0
+
+
+class TestThresholdCodec:
+    def test_round_trip_with_residual(self, np_rng):
+        g = np_rng.randn(500).astype(np.float32)
+        enc, residual = rt.threshold_encode(g, 0.5)
+        dec = rt.threshold_decode(enc, g.shape, 0.5)
+        np.testing.assert_allclose(dec + residual, g, atol=1e-6)
+        # only |g|>=0.5 entries encoded
+        assert enc.size == int((np.abs(g) >= 0.5).sum())
+
+    def test_cap_bounds_message(self, np_rng):
+        g = np_rng.randn(100).astype(np.float32) * 10
+        enc, residual = rt.threshold_encode(g, 0.1, cap=10)
+        assert enc.size == 10
+        # undelivered quanta stay in the residual
+        dec = rt.threshold_decode(enc, g.shape, 0.1)
+        np.testing.assert_allclose(dec + residual, g, atol=1e-5)
+
+    def test_matches_python_compression_module(self, np_rng):
+        # native codec and the parallel.compression host codec agree
+        from deeplearning4j_tpu.parallel import compression as comp
+        g = np_rng.randn(200).astype(np.float32)
+        enc_n, res_n = rt.threshold_encode(g, 0.3)
+        enc_p, res_p = comp.threshold_encode(g, 0.3)
+        np.testing.assert_array_equal(np.sort(enc_n), np.sort(enc_p))
+        np.testing.assert_allclose(res_n, res_p, atol=1e-6)
+
+    def test_bitmap_round_trip(self, np_rng):
+        g = np_rng.randn(77).astype(np.float32)
+        words, residual, cnt = rt.bitmap_encode(g, 0.4)
+        assert cnt == int((np.abs(g) >= 0.4).sum())
+        dec = rt.bitmap_decode(words, g.size, 0.4)
+        np.testing.assert_allclose(dec + residual, g, atol=1e-6)
+
+
+class TestNpyIO:
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64, np.int32,
+                                       np.int64, np.uint8, np.bool_])
+    def test_save_load_round_trip(self, np_rng, tmp_path, dtype):
+        arr = (np_rng.randn(3, 4, 5) * 10).astype(dtype)
+        p = str(tmp_path / "a.npy")
+        rt.npy_save(p, arr)
+        # interop both ways: numpy reads ours, we read numpy's
+        np.testing.assert_array_equal(np.load(p), arr)
+        loaded = rt.npy_load(p)
+        np.testing.assert_array_equal(loaded, arr)
+        assert loaded.dtype == arr.dtype
+
+    def test_read_numpy_written_file(self, np_rng, tmp_path):
+        arr = np_rng.randn(7, 2).astype(np.float32)
+        p = str(tmp_path / "np.npy")
+        np.save(p, arr)
+        np.testing.assert_array_equal(rt.npy_load(p), arr)
+
+    def test_scalar_and_1d(self, tmp_path):
+        for arr in (np.float32(3.5), np.arange(5, dtype=np.int64)):
+            p = str(tmp_path / "s.npy")
+            rt.npy_save(p, np.asarray(arr))
+            np.testing.assert_array_equal(rt.npy_load(p), arr)
+
+
+class TestCsvFastPath:
+    def test_parse(self):
+        out = rt.csv_parse_floats("1,2.5,3\n4,5,6.25\n")
+        np.testing.assert_allclose(out, [[1, 2.5, 3], [4, 5, 6.25]])
+
+    def test_malformed_returns_none(self):
+        assert rt.csv_parse_floats("1,abc,3\n") is None
+        assert rt.csv_parse_floats("1,2\n3,4,5\n") is None  # ragged
+
+    def test_negative_and_scientific(self):
+        out = rt.csv_parse_floats("-1.5,2e3\n0,-4e-2\n")
+        np.testing.assert_allclose(out, [[-1.5, 2000], [0, -0.04]])
