@@ -1,0 +1,26 @@
+"""Reinforcement learning — the rl4j layer (ref: D16, ~7.8k LoC).
+
+Ref: `rl4j/.../learning/sync/qlearning/discrete/QLearningDiscrete.java:115`
+(trainStep: eps-greedy act, replay buffer, target net, TD update),
+`learning/async/a3c/**` (async advantage actor-critic),
+`policy/{EpsGreedy,Policy}.java`, `mdp/MDP.java`, and the gym
+integration (`gym-java-client`).
+
+TPU-first redesign notes:
+- DQN's Q-network IS a framework MultiLayerNetwork (mse head); the TD
+  step batches replay samples into one jitted update.
+- The reference's ASYNC A3C exists to keep 2015-era CPUs busy with
+  lock-free stale gradients; the TPU-shaped equivalent is the
+  synchronous batched advantage actor-critic over vectorized
+  environments (one jitted update per rollout — no staleness, full MXU
+  batches). The class keeps the A3C name for capability parity and
+  documents the redesign.
+"""
+from .mdp import MDP, CartPole, GridWorld
+from .policy import BoltzmannPolicy, EpsGreedy, GreedyPolicy, play
+from .qlearning import QLearningConfiguration, QLearningDiscrete
+from .a3c import A3C, A3CConfiguration
+
+__all__ = ["MDP", "CartPole", "GridWorld", "QLearningDiscrete",
+           "QLearningConfiguration", "A3C", "A3CConfiguration",
+           "EpsGreedy", "GreedyPolicy", "BoltzmannPolicy", "play"]

@@ -1,0 +1,166 @@
+"""Advantage actor-critic (ref: `rl4j/.../learning/async/a3c/**` —
+A3CDiscrete, ActorCriticSeparate/Combined, n-step advantage updates).
+
+TPU-first redesign (see package docstring): the reference spreads async
+workers across CPU threads pushing stale gradients at a shared model
+(Mnih 2016's hardware workaround). Here N environments step in lockstep
+on the host and every rollout trains in ONE jitted update — synchronous
+batched A2C, which is the same estimator with batch parallelism moved
+from threads into the MXU batch dimension. Policy + value heads share a
+trunk; loss = policy gradient + c_v * value MSE - c_e * entropy, exactly
+the reference's ActorCriticCombined objective.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import learning
+from ..weightinit import init_weights
+from .mdp import MDP
+
+
+@dataclass
+class A3CConfiguration:
+    """Ref: A3CDiscrete.A3CConfiguration (gamma, nstep, updaterConfig,
+    entropy/value coefficients)."""
+    seed: int = 0
+    gamma: float = 0.99
+    n_step: int = 16
+    n_envs: int = 8
+    hidden: int = 64
+    learning_rate: float = 7e-3
+    value_coef: float = 0.5
+    entropy_coef: float = 0.01
+    max_grad_norm: float = 0.5
+
+
+class A3C:
+    """Batched advantage actor-critic over `n_envs` copies of the MDP."""
+
+    def __init__(self, mdp_factory: Callable[[int], MDP],
+                 config: A3CConfiguration):
+        self.conf = config
+        self.envs = [mdp_factory(i) for i in range(config.n_envs)]
+        self.obs_size = self.envs[0].obs_size
+        self.n_actions = self.envs[0].n_actions
+        key = jax.random.PRNGKey(config.seed)
+        k1, k2, k3, self._key = jax.random.split(key, 4)
+        H = config.hidden
+        self.params = {
+            "w1": init_weights(k1, (self.obs_size, H), self.obs_size, H,
+                               "xavier"),
+            "b1": jnp.zeros(H),
+            "wp": init_weights(k2, (H, self.n_actions), H, self.n_actions,
+                               "xavier") * 0.1,
+            "bp": jnp.zeros(self.n_actions),
+            "wv": init_weights(k3, (H, 1), H, 1, "xavier") * 0.1,
+            "bv": jnp.zeros(1),
+        }
+        self.updater = learning.Adam(config.learning_rate)
+        self.opt_state = self.updater.init_state(self.params)
+        self._step_no = 0
+        self._update = self._build_update()
+        self.episode_rewards: List[float] = []
+        self._running = np.zeros(config.n_envs)
+        self._obs = np.stack([e.reset() for e in self.envs])
+
+    # -- model ---------------------------------------------------------
+    @staticmethod
+    def _forward(params, obs):
+        h = jnp.tanh(obs @ params["w1"] + params["b1"])
+        logits = h @ params["wp"] + params["bp"]
+        value = (h @ params["wv"] + params["bv"])[..., 0]
+        return logits, value
+
+    def _build_update(self):
+        conf = self.conf
+        updater = self.updater
+
+        def loss_fn(params, obs, actions, returns):
+            logits, value = A3C._forward(params, obs)
+            logp = jax.nn.log_softmax(logits)
+            p = jax.nn.softmax(logits)
+            adv = returns - value
+            pg = -(jnp.take_along_axis(
+                logp, actions[:, None], 1)[:, 0]
+                * jax.lax.stop_gradient(adv)).mean()
+            v_loss = (adv ** 2).mean()
+            entropy = -(p * logp).sum(-1).mean()
+            return (pg + conf.value_coef * v_loss
+                    - conf.entropy_coef * entropy)
+
+        def update(params, opt_state, step_no, obs, actions, returns):
+            loss, grads = jax.value_and_grad(loss_fn)(params, obs,
+                                                      actions, returns)
+            gnorm = jnp.sqrt(sum(jnp.sum(g ** 2)
+                                 for g in jax.tree_util.tree_leaves(grads)))
+            scale = jnp.minimum(1.0, conf.max_grad_norm / (gnorm + 1e-8))
+            grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+            opt_state, updates = updater.apply(opt_state, grads, step_no)
+            params = jax.tree_util.tree_map(lambda p, u: p - u, params,
+                                            updates)
+            return params, opt_state, loss
+
+        return jax.jit(update, donate_argnums=(0, 1))
+
+    def _policy_probs(self, obs_batch: np.ndarray) -> np.ndarray:
+        logits, _ = A3C._forward(self.params, jnp.asarray(obs_batch))
+        return np.asarray(jax.nn.softmax(logits))
+
+    # -- training ------------------------------------------------------
+    def train(self, updates: int = 100) -> List[float]:
+        """Run `updates` rollout+update cycles (each = n_step * n_envs
+        environment transitions, one jitted gradient step)."""
+        conf = self.conf
+        rng = np.random.RandomState(conf.seed)
+        for _ in range(updates):
+            obs_buf, act_buf, rew_buf, done_buf = [], [], [], []
+            for t in range(conf.n_step):
+                probs = self._policy_probs(self._obs)
+                actions = np.asarray(
+                    [rng.choice(self.n_actions, p=probs[i])
+                     for i in range(conf.n_envs)])
+                obs_buf.append(self._obs.copy())
+                step_out = []
+                for i, env in enumerate(self.envs):
+                    o2, r, d = env.step(int(actions[i]))
+                    self._running[i] += r
+                    if d:
+                        self.episode_rewards.append(self._running[i])
+                        self._running[i] = 0.0
+                        o2 = env.reset()
+                    step_out.append((o2, r, d))
+                self._obs = np.stack([s[0] for s in step_out])
+                act_buf.append(actions)
+                rew_buf.append([s[1] for s in step_out])
+                done_buf.append([s[2] for s in step_out])
+            # n-step bootstrapped returns (ref: async nstep accumulation)
+            _, boot = A3C._forward(self.params, jnp.asarray(self._obs))
+            returns = np.zeros((conf.n_step, conf.n_envs), np.float32)
+            run = np.asarray(boot)
+            rew = np.asarray(rew_buf, np.float32)
+            done = np.asarray(done_buf, np.float32)
+            for t in reversed(range(conf.n_step)):
+                run = rew[t] + conf.gamma * run * (1.0 - done[t])
+                returns[t] = run
+            obs = np.concatenate(obs_buf).astype(np.float32)
+            acts = np.concatenate(act_buf).astype(np.int32)
+            rets = returns.reshape(-1)
+            self.params, self.opt_state, _ = self._update(
+                self.params, self.opt_state, self._step_no,
+                jnp.asarray(obs), jnp.asarray(acts), jnp.asarray(rets))
+            self._step_no += 1
+        return self.episode_rewards
+
+    def get_policy(self):
+        from .policy import GreedyPolicy
+
+        def q_like(obs):
+            logits, _ = A3C._forward(self.params, jnp.asarray(obs[None]))
+            return np.asarray(logits)[0]
+        return GreedyPolicy(q_like)

@@ -1,0 +1,136 @@
+"""MDP interface + built-in environments.
+
+Ref: `rl4j-api/.../mdp/MDP.java` (reset/step/isDone/getActionSpace) and
+the gym bindings; CartPole matches the classic control dynamics the
+reference exercises through gym-java-client, implemented natively so no
+gym dependency is needed.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+class MDP:
+    """Ref: MDP.java — the environment SPI."""
+
+    obs_size: int
+    n_actions: int
+
+    def reset(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, action: int) -> Tuple[np.ndarray, float, bool]:
+        """Returns (observation, reward, done)."""
+        raise NotImplementedError
+
+    def is_done(self) -> bool:
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class CartPole(MDP):
+    """Classic cart-pole balancing (Barto-Sutton-Anderson dynamics, the
+    same task the reference's gym examples target)."""
+
+    obs_size = 4
+    n_actions = 2
+
+    def __init__(self, max_steps: int = 200, seed: int = 0):
+        self.max_steps = max_steps
+        self._rng = np.random.RandomState(seed)
+        self._state: Optional[np.ndarray] = None
+        self._steps = 0
+        self._done = True
+        # physics constants (classic control)
+        self.gravity = 9.8
+        self.masscart, self.masspole = 1.0, 0.1
+        self.length = 0.5
+        self.force_mag = 10.0
+        self.tau = 0.02
+        self.theta_threshold = 12 * 2 * math.pi / 360
+        self.x_threshold = 2.4
+
+    def reset(self) -> np.ndarray:
+        self._state = self._rng.uniform(-0.05, 0.05, 4)
+        self._steps = 0
+        self._done = False
+        return self._state.astype(np.float32)
+
+    def step(self, action: int):
+        x, x_dot, theta, theta_dot = self._state
+        force = self.force_mag if action == 1 else -self.force_mag
+        costh, sinth = math.cos(theta), math.sin(theta)
+        total_mass = self.masscart + self.masspole
+        polemass_length = self.masspole * self.length
+        temp = (force + polemass_length * theta_dot ** 2 * sinth) \
+            / total_mass
+        thetaacc = (self.gravity * sinth - costh * temp) / (
+            self.length * (4.0 / 3.0
+                           - self.masspole * costh ** 2 / total_mass))
+        xacc = temp - polemass_length * thetaacc * costh / total_mass
+        x += self.tau * x_dot
+        x_dot += self.tau * xacc
+        theta += self.tau * theta_dot
+        theta_dot += self.tau * thetaacc
+        self._state = np.asarray([x, x_dot, theta, theta_dot])
+        self._steps += 1
+        self._done = bool(
+            abs(x) > self.x_threshold
+            or abs(theta) > self.theta_threshold
+            or self._steps >= self.max_steps)
+        return self._state.astype(np.float32), 1.0, self._done
+
+    def is_done(self) -> bool:
+        return self._done
+
+
+class GridWorld(MDP):
+    """Deterministic NxN grid: start top-left, +1 at bottom-right,
+    -0.01 per step (a fast-converging correctness env, the role of the
+    reference's toy MDPs in `rl4j-core` tests)."""
+
+    n_actions = 4  # up, down, left, right
+
+    def __init__(self, size: int = 4, max_steps: int = 50):
+        self.size = size
+        self.obs_size = size * size
+        self.max_steps = max_steps
+        self._pos = (0, 0)
+        self._steps = 0
+        self._done = True
+
+    def _obs(self) -> np.ndarray:
+        o = np.zeros(self.size * self.size, np.float32)
+        o[self._pos[0] * self.size + self._pos[1]] = 1.0
+        return o
+
+    def reset(self):
+        self._pos = (0, 0)
+        self._steps = 0
+        self._done = False
+        return self._obs()
+
+    def step(self, action: int):
+        r, c = self._pos
+        if action == 0:
+            r = max(0, r - 1)
+        elif action == 1:
+            r = min(self.size - 1, r + 1)
+        elif action == 2:
+            c = max(0, c - 1)
+        else:
+            c = min(self.size - 1, c + 1)
+        self._pos = (r, c)
+        self._steps += 1
+        at_goal = self._pos == (self.size - 1, self.size - 1)
+        self._done = at_goal or self._steps >= self.max_steps
+        reward = 1.0 if at_goal else -0.01
+        return self._obs(), reward, self._done
+
+    def is_done(self):
+        return self._done

@@ -1,0 +1,72 @@
+"""Action policies (ref: `rl4j-core/.../policy/{Policy,EpsGreedy,
+DQNPolicy,BoltzmannPolicy}.java`)."""
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+
+class GreedyPolicy:
+    """argmax-Q (ref: DQNPolicy)."""
+
+    def __init__(self, q_fn: Callable[[np.ndarray], np.ndarray]):
+        self.q_fn = q_fn
+
+    def next_action(self, obs: np.ndarray) -> int:
+        return int(np.argmax(self.q_fn(obs)))
+
+
+class EpsGreedy(GreedyPolicy):
+    """Annealed epsilon-greedy (ref: EpsGreedy.java — minEpsilon +
+    epsilonNbStep annealing)."""
+
+    def __init__(self, q_fn, eps_start: float = 1.0,
+                 eps_min: float = 0.05, anneal_steps: int = 1000,
+                 seed: int = 0):
+        super().__init__(q_fn)
+        self.eps_start = eps_start
+        self.eps_min = eps_min
+        self.anneal_steps = max(1, anneal_steps)
+        self.step_count = 0
+        self._rng = np.random.RandomState(seed)
+
+    @property
+    def epsilon(self) -> float:
+        frac = min(1.0, self.step_count / self.anneal_steps)
+        return self.eps_start + (self.eps_min - self.eps_start) * frac
+
+    def next_action(self, obs: np.ndarray) -> int:
+        q = self.q_fn(obs)
+        self.step_count += 1
+        if self._rng.rand() < self.epsilon:
+            return int(self._rng.randint(len(q)))
+        return int(np.argmax(q))
+
+
+class BoltzmannPolicy(GreedyPolicy):
+    """Softmax-over-Q sampling (ref: BoltzmannPolicy)."""
+
+    def __init__(self, q_fn, temperature: float = 1.0, seed: int = 0):
+        super().__init__(q_fn)
+        self.temperature = temperature
+        self._rng = np.random.RandomState(seed)
+
+    def next_action(self, obs: np.ndarray) -> int:
+        q = np.asarray(self.q_fn(obs), np.float64) / self.temperature
+        p = np.exp(q - q.max())
+        p /= p.sum()
+        return int(self._rng.choice(len(p), p=p))
+
+
+def play(mdp, policy, episodes: int = 1) -> float:
+    """Run greedy episodes, return mean total reward (ref:
+    Policy.play)."""
+    total = 0.0
+    for _ in range(episodes):
+        obs = mdp.reset()
+        done = False
+        while not done:
+            obs, r, done = mdp.step(policy.next_action(obs))
+            total += r
+    return total / episodes

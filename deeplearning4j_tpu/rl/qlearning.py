@@ -1,0 +1,125 @@
+"""Synchronous DQN (ref: `rl4j-core/.../learning/sync/qlearning/discrete/
+QLearningDiscrete.java:115` trainStep — eps-greedy act, ExpReplay buffer,
+target network with periodic hard sync, TD(0) targets, double-DQN
+option; configuration mirror of `QLearning.QLConfiguration`).
+
+The Q-network is a framework MultiLayerNetwork (mse head); each TD
+update is ONE batched fit step — the replay minibatch trains in a single
+jitted program.
+"""
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from ..datasets import ArrayDataSetIterator
+from .mdp import MDP
+from .policy import EpsGreedy, GreedyPolicy
+
+
+@dataclass
+class QLearningConfiguration:
+    """Ref: QLearning.QLConfiguration (seed, maxEpochStep, expRepMaxSize,
+    batchSize, targetDqnUpdateFreq, gamma, epsilon schedule...)."""
+    seed: int = 0
+    gamma: float = 0.99
+    batch_size: int = 32
+    exp_replay_size: int = 10000
+    target_update_freq: int = 100
+    eps_start: float = 1.0
+    eps_min: float = 0.05
+    eps_anneal_steps: int = 1000
+    warmup_steps: int = 64
+    double_dqn: bool = False
+    max_steps_per_episode: int = 10000
+
+
+class QLearningDiscrete:
+    """Ref: QLearningDiscrete.java. `net` is an (un)initialized
+    MultiLayerNetwork whose output layer is an mse regression over
+    n_actions."""
+
+    def __init__(self, mdp: MDP, net, config: QLearningConfiguration):
+        from ..nn.multilayer import MultiLayerNetwork
+        self.mdp = mdp
+        self.conf = config
+        self.net = net
+        if self.net._params is None:
+            self.net.init()
+        # target network: same conf, hard-synced copies of the params
+        self.target = MultiLayerNetwork(net.conf).init()
+        self._sync_target()
+        self.replay = deque(maxlen=config.exp_replay_size)
+        self.policy = EpsGreedy(self._q, config.eps_start, config.eps_min,
+                                config.eps_anneal_steps, config.seed)
+        self._rng = np.random.RandomState(config.seed)
+        self.total_steps = 0
+        self.episode_rewards: List[float] = []
+
+    def _q(self, obs: np.ndarray) -> np.ndarray:
+        return np.asarray(self.net.output(obs[None]))[0]
+
+    def _sync_target(self):
+        # deep copy: fit() donates the online params' buffers to XLA, so
+        # aliasing them here would leave the target net holding freed
+        # buffers
+        self.target._params = jax.tree_util.tree_map(
+            jax.numpy.copy, self.net._params)
+        self.target._net_state = jax.tree_util.tree_map(
+            jax.numpy.copy, self.net._net_state)
+
+    def _train_batch(self):
+        idx = self._rng.choice(len(self.replay), self.conf.batch_size,
+                               replace=False)
+        batch = [self.replay[i] for i in idx]
+        s = np.stack([b[0] for b in batch])
+        a = np.asarray([b[1] for b in batch])
+        r = np.asarray([b[2] for b in batch], np.float32)
+        s2 = np.stack([b[3] for b in batch])
+        done = np.asarray([b[4] for b in batch], np.float32)
+        q = np.asarray(self.net.output(s))
+        q_next_t = np.asarray(self.target.output(s2))
+        if self.conf.double_dqn:
+            # online net picks the action, target net evaluates it
+            a_star = np.argmax(np.asarray(self.net.output(s2)), axis=1)
+            boot = q_next_t[np.arange(len(a_star)), a_star]
+        else:
+            boot = q_next_t.max(axis=1)
+        targets = q.copy()
+        targets[np.arange(len(a)), a] = r + self.conf.gamma * boot \
+            * (1.0 - done)
+        self.net.fit(ArrayDataSetIterator(s, targets,
+                                          batch=self.conf.batch_size),
+                     epochs=1)
+
+    def train_step(self, obs: np.ndarray):
+        """One environment interaction + one TD update (ref: trainStep
+        :115)."""
+        action = self.policy.next_action(obs)
+        obs2, reward, done = self.mdp.step(action)
+        self.replay.append((obs, action, reward, obs2, float(done)))
+        self.total_steps += 1
+        if len(self.replay) >= max(self.conf.warmup_steps,
+                                   self.conf.batch_size):
+            self._train_batch()
+        if self.total_steps % self.conf.target_update_freq == 0:
+            self._sync_target()
+        return obs2, reward, done
+
+    def train(self, episodes: int = 50) -> List[float]:
+        for _ in range(episodes):
+            obs = self.mdp.reset()
+            total, done, steps = 0.0, False, 0
+            while not done and steps < self.conf.max_steps_per_episode:
+                obs, r, done = self.train_step(obs)
+                total += r
+                steps += 1
+            self.episode_rewards.append(total)
+        return self.episode_rewards
+
+    def get_policy(self) -> GreedyPolicy:
+        return GreedyPolicy(self._q)

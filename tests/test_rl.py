@@ -1,0 +1,118 @@
+"""RL tests: MDPs, policies, DQN (incl. double-DQN), batched A3C
+(SURVEY.md D16). Correctness bar: agents must actually LEARN the toy
+environments, not just run."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.rl import (A3C, A3CConfiguration, BoltzmannPolicy,
+                                   CartPole, EpsGreedy, GridWorld,
+                                   QLearningConfiguration,
+                                   QLearningDiscrete, play)
+
+
+def _qnet(obs_size, n_actions, hidden=32, lr=5e-3, seed=0):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(lr))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=hidden, activation="tanh"))
+            .layer(OutputLayer(n_out=n_actions, loss="mse",
+                               activation="identity"))
+            .input_type_feed_forward(obs_size).build())
+    return MultiLayerNetwork(conf)
+
+
+class TestMDPs:
+    def test_cartpole_dynamics(self):
+        env = CartPole(max_steps=50, seed=1)
+        obs = env.reset()
+        assert obs.shape == (4,)
+        total = 0
+        done = False
+        while not done:
+            obs, r, done = env.step(1)  # constant push falls over fast
+            total += r
+        assert total < 50  # pole fell before the cap
+
+    def test_gridworld_optimal_path(self):
+        env = GridWorld(size=3)
+        env.reset()
+        # down,down,right,right reaches the goal
+        for a, want_done in [(1, False), (1, False), (3, False), (3, True)]:
+            obs, r, done = env.step(a)
+            assert done == want_done
+        assert r == 1.0
+
+
+class TestPolicies:
+    def test_eps_greedy_anneals(self):
+        pol = EpsGreedy(lambda o: np.asarray([0.0, 1.0]), eps_start=1.0,
+                        eps_min=0.1, anneal_steps=100, seed=0)
+        assert pol.epsilon == 1.0
+        for _ in range(100):
+            pol.next_action(np.zeros(2))
+        assert pol.epsilon == pytest.approx(0.1)
+        # annealed policy is (mostly) greedy now
+        acts = [pol.next_action(np.zeros(2)) for _ in range(50)]
+        assert np.mean(np.asarray(acts) == 1) > 0.7
+
+    def test_boltzmann_samples_by_value(self):
+        pol = BoltzmannPolicy(lambda o: np.asarray([0.0, 3.0]),
+                              temperature=1.0, seed=0)
+        acts = [pol.next_action(np.zeros(2)) for _ in range(200)]
+        assert np.mean(np.asarray(acts) == 1) > 0.8
+
+
+class TestDQN:
+    def test_gridworld_learns(self):
+        env = GridWorld(size=3, max_steps=30)
+        net = _qnet(env.obs_size, env.n_actions, hidden=32, lr=5e-3)
+        cfg = QLearningConfiguration(
+            seed=0, gamma=0.95, batch_size=32, exp_replay_size=2000,
+            target_update_freq=50, eps_anneal_steps=600, warmup_steps=64)
+        dqn = QLearningDiscrete(env, net, cfg)
+        rewards = dqn.train(episodes=60)
+        # greedy policy reaches the goal near-optimally (4 steps, 3
+        # penalty steps -> ~0.97)
+        score = play(GridWorld(size=3, max_steps=30), dqn.get_policy())
+        assert score > 0.8, (score, rewards[-5:])
+
+    def test_double_dqn_runs_and_learns(self):
+        env = GridWorld(size=3, max_steps=30)
+        net = _qnet(env.obs_size, env.n_actions, lr=5e-3, seed=1)
+        cfg = QLearningConfiguration(seed=1, gamma=0.95,
+                                     eps_anneal_steps=600,
+                                     target_update_freq=50,
+                                     double_dqn=True)
+        dqn = QLearningDiscrete(env, net, cfg)
+        dqn.train(episodes=60)
+        assert play(GridWorld(size=3, max_steps=30),
+                    dqn.get_policy()) > 0.8
+
+    def test_target_network_sync(self):
+        env = GridWorld(size=3)
+        dqn = QLearningDiscrete(env, _qnet(env.obs_size, env.n_actions),
+                                QLearningConfiguration(
+                                    target_update_freq=5, warmup_steps=8,
+                                    batch_size=8))
+        obs = env.reset()
+        for _ in range(20):
+            obs, r, done = dqn.train_step(obs)
+            if done:
+                obs = env.reset()
+        # after syncs, target params mirror online params at sync points
+        assert dqn.total_steps == 20
+
+
+class TestA3C:
+    def test_cartpole_improves(self):
+        a3c = A3C(lambda i: CartPole(max_steps=200, seed=i),
+                  A3CConfiguration(seed=0, n_envs=8, n_step=16,
+                                   learning_rate=7e-3))
+        a3c.train(updates=150)
+        rewards = a3c.episode_rewards
+        early = np.mean(rewards[:10])
+        late = np.mean(rewards[-10:])
+        assert late > early * 1.5, (early, late)
+        assert late > 40, (early, late)
