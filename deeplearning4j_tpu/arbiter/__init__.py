@@ -1,0 +1,329 @@
+"""Hyperparameter optimization — the arbiter layer (ref: D17, ~24k LoC).
+
+Ref: `arbiter-core/.../parameter/**` (ParameterSpace DSL:
+ContinuousParameterSpace, IntegerParameterSpace, DiscreteParameterSpace,
+FixedValue), `generator/{GridSearchCandidateGenerator,
+RandomSearchGenerator}.java`, genetic operators under
+`generator/genetic/**` (selection, crossover, mutation),
+`scoring/ScoreFunction`, termination conditions
+(`MaxCandidatesCondition`, `MaxTimeCondition`), and the
+`LocalOptimizationRunner`.
+
+The runner here executes candidates in-process (the reference's
+LocalOptimizationRunner role); each candidate's training already
+saturates the chip, so candidate-level parallelism is deliberately NOT
+the TPU story — sequential candidates, fully-utilized device.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# parameter spaces (ref: arbiter-core parameter/**)
+# ---------------------------------------------------------------------------
+class ParameterSpace:
+    def sample(self, rng: np.random.RandomState):
+        raise NotImplementedError
+
+    def grid_values(self, discretization: int) -> List:
+        raise NotImplementedError
+
+
+class ContinuousParameterSpace(ParameterSpace):
+    """Uniform (or log-uniform) float range (ref:
+    ContinuousParameterSpace.java)."""
+
+    def __init__(self, min_value: float, max_value: float,
+                 log_scale: bool = False):
+        if log_scale and min_value <= 0:
+            raise ValueError("log_scale needs positive min")
+        self.min, self.max, self.log = min_value, max_value, log_scale
+
+    def sample(self, rng):
+        if self.log:
+            return float(np.exp(rng.uniform(np.log(self.min),
+                                            np.log(self.max))))
+        return float(rng.uniform(self.min, self.max))
+
+    def grid_values(self, discretization):
+        if self.log:
+            return [float(v) for v in np.exp(np.linspace(
+                np.log(self.min), np.log(self.max), discretization))]
+        return [float(v) for v in np.linspace(self.min, self.max,
+                                              discretization)]
+
+
+class IntegerParameterSpace(ParameterSpace):
+    def __init__(self, min_value: int, max_value: int):
+        self.min, self.max = int(min_value), int(max_value)
+
+    def sample(self, rng):
+        return int(rng.randint(self.min, self.max + 1))
+
+    def grid_values(self, discretization):
+        n = min(discretization, self.max - self.min + 1)
+        return [int(round(v)) for v in np.linspace(self.min, self.max, n)]
+
+
+class DiscreteParameterSpace(ParameterSpace):
+    def __init__(self, *values):
+        if len(values) == 1 and isinstance(values[0], (list, tuple)):
+            values = tuple(values[0])
+        self.values = list(values)
+
+    def sample(self, rng):
+        return self.values[rng.randint(len(self.values))]
+
+    def grid_values(self, discretization):
+        return list(self.values)
+
+
+class BooleanParameterSpace(DiscreteParameterSpace):
+    def __init__(self):
+        super().__init__(True, False)
+
+
+class FixedValue(ParameterSpace):
+    def __init__(self, value):
+        self.value = value
+
+    def sample(self, rng):
+        return self.value
+
+    def grid_values(self, discretization):
+        return [self.value]
+
+
+# ---------------------------------------------------------------------------
+# candidate generators (ref: generator/**)
+# ---------------------------------------------------------------------------
+@dataclass
+class Candidate:
+    index: int
+    values: Dict[str, Any]
+
+
+class CandidateGenerator:
+    def __init__(self, spaces: Dict[str, ParameterSpace], seed: int = 0):
+        self.spaces = {k: (v if isinstance(v, ParameterSpace)
+                           else FixedValue(v))
+                       for k, v in spaces.items()}
+        self.rng = np.random.RandomState(seed)
+        self._count = 0
+
+    def has_more(self) -> bool:
+        raise NotImplementedError
+
+    def next(self) -> Candidate:
+        raise NotImplementedError
+
+    def report_score(self, candidate: Candidate, score: float):
+        """Hook for adaptive generators (genetic)."""
+
+
+class RandomSearchGenerator(CandidateGenerator):
+    """Ref: RandomSearchGenerator.java."""
+
+    def __init__(self, spaces, num_candidates: int = 10, seed: int = 0):
+        super().__init__(spaces, seed)
+        self.num_candidates = num_candidates
+
+    def has_more(self):
+        return self._count < self.num_candidates
+
+    def next(self):
+        values = {k: s.sample(self.rng) for k, s in self.spaces.items()}
+        c = Candidate(self._count, values)
+        self._count += 1
+        return c
+
+
+class GridSearchCandidateGenerator(CandidateGenerator):
+    """Ref: GridSearchCandidateGenerator.java — full cartesian product,
+    Sequential or RandomOrder mode."""
+
+    def __init__(self, spaces, discretization_count: int = 5,
+                 mode: str = "sequential", seed: int = 0):
+        super().__init__(spaces, seed)
+        keys = list(self.spaces)
+        grids = [self.spaces[k].grid_values(discretization_count)
+                 for k in keys]
+        self._grid: List[Dict[str, Any]] = []
+        idx = [0] * len(keys)
+        while True:
+            self._grid.append({k: grids[i][idx[i]]
+                               for i, k in enumerate(keys)})
+            j = len(keys) - 1
+            while j >= 0:
+                idx[j] += 1
+                if idx[j] < len(grids[j]):
+                    break
+                idx[j] = 0
+                j -= 1
+            if j < 0:
+                break
+        if mode == "random":
+            order = self.rng.permutation(len(self._grid))
+            self._grid = [self._grid[i] for i in order]
+        elif mode != "sequential":
+            raise ValueError(f"unknown mode {mode!r}")
+
+    @property
+    def total(self) -> int:
+        return len(self._grid)
+
+    def has_more(self):
+        return self._count < len(self._grid)
+
+    def next(self):
+        c = Candidate(self._count, dict(self._grid[self._count]))
+        self._count += 1
+        return c
+
+
+class GeneticSearchCandidateGenerator(CandidateGenerator):
+    """Ref: generator/genetic/** — population, tournament selection,
+    uniform crossover, per-gene mutation. Numeric genes mutate by
+    gaussian perturbation; discrete genes resample."""
+
+    def __init__(self, spaces, population_size: int = 10,
+                 generations: int = 5, tournament: int = 3,
+                 mutation_prob: float = 0.2, seed: int = 0,
+                 minimize: bool = True):
+        super().__init__(spaces, seed)
+        self.population_size = population_size
+        self.generations = generations
+        self.tournament = tournament
+        self.mutation_prob = mutation_prob
+        self.minimize = minimize
+        self._pop: List[Candidate] = []
+        self._scores: Dict[int, float] = {}
+        self._emitted = 0
+        self._gen = 0
+
+    def has_more(self):
+        return self._emitted < self.population_size * self.generations
+
+    def _random_candidate(self):
+        values = {k: s.sample(self.rng) for k, s in self.spaces.items()}
+        return Candidate(self._emitted, values)
+
+    def _select(self) -> Candidate:
+        pool = [self._pop[self.rng.randint(len(self._pop))]
+                for _ in range(self.tournament)]
+        key = lambda c: self._scores.get(c.index, np.inf)
+        return min(pool, key=key) if self.minimize else \
+            max(pool, key=lambda c: self._scores.get(c.index, -np.inf))
+
+    def _breed(self) -> Candidate:
+        a, b = self._select(), self._select()
+        child: Dict[str, Any] = {}
+        for k, space in self.spaces.items():
+            v = a.values[k] if self.rng.rand() < 0.5 else b.values[k]
+            if self.rng.rand() < self.mutation_prob:
+                if isinstance(space, ContinuousParameterSpace):
+                    span = space.max - space.min
+                    v = float(np.clip(v + self.rng.randn() * 0.1 * span,
+                                      space.min, space.max))
+                elif isinstance(space, IntegerParameterSpace):
+                    v = int(np.clip(v + self.rng.randint(-1, 2),
+                                    space.min, space.max))
+                else:
+                    v = space.sample(self.rng)
+            child[k] = v
+        return Candidate(self._emitted, child)
+
+    def next(self):
+        in_gen = self._emitted % self.population_size
+        if self._emitted // self.population_size == 0:
+            c = self._random_candidate()          # seed generation
+        else:
+            c = self._breed()
+        self._emitted += 1
+        self._pop.append(c)
+        if len(self._pop) > 2 * self.population_size:
+            self._pop = self._pop[-2 * self.population_size:]
+        return c
+
+    def report_score(self, candidate, score):
+        self._scores[candidate.index] = score
+
+
+# ---------------------------------------------------------------------------
+# score functions + termination (ref: scoring/**, termination conditions)
+# ---------------------------------------------------------------------------
+class MaxCandidatesCondition:
+    def __init__(self, n: int):
+        self.n = n
+
+    def should_stop(self, runner) -> bool:
+        return len(runner.results) >= self.n
+
+
+class MaxTimeCondition:
+    def __init__(self, seconds: float):
+        self.seconds = seconds
+        self._start: Optional[float] = None
+
+    def should_stop(self, runner) -> bool:
+        if self._start is None:
+            self._start = time.time()
+        return time.time() - self._start > self.seconds
+
+
+@dataclass
+class OptimizationResult:
+    candidate: Candidate
+    score: float
+    model: Any = None
+
+
+class OptimizationConfiguration:
+    """Ref: OptimizationConfiguration.Builder — generator + score fn +
+    termination conditions."""
+
+    def __init__(self, candidate_generator: CandidateGenerator,
+                 score_function: Callable[[Dict[str, Any]], Any],
+                 termination_conditions: Sequence = (),
+                 minimize: bool = True):
+        self.generator = candidate_generator
+        self.score_function = score_function
+        self.termination_conditions = list(termination_conditions)
+        self.minimize = minimize
+
+
+class LocalOptimizationRunner:
+    """Ref: LocalOptimizationRunner — executes candidates, tracks the
+    best. `score_function(values)` returns a score or
+    (score, model)."""
+
+    def __init__(self, config: OptimizationConfiguration):
+        self.config = config
+        self.results: List[OptimizationResult] = []
+
+    def execute(self) -> OptimizationResult:
+        gen = self.config.generator
+        while gen.has_more():
+            if any(t.should_stop(self)
+                   for t in self.config.termination_conditions):
+                break
+            cand = gen.next()
+            out = self.config.score_function(cand.values)
+            score, model = out if isinstance(out, tuple) else (out, None)
+            score = float(score)
+            gen.report_score(cand, score)
+            self.results.append(OptimizationResult(cand, score, model))
+        if not self.results:
+            raise RuntimeError("no candidates evaluated")
+        key = lambda r: r.score
+        return min(self.results, key=key) if self.config.minimize \
+            else max(self.results, key=key)
+
+    def best_score(self) -> float:
+        best = min if self.config.minimize else max
+        return best(r.score for r in self.results)

@@ -1,0 +1,191 @@
+"""Arbiter (hyperparameter search) + clustering/KNN/t-SNE tests
+(SURVEY.md D17/D19)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.arbiter import (BooleanParameterSpace,
+                                        ContinuousParameterSpace,
+                                        DiscreteParameterSpace, FixedValue,
+                                        GeneticSearchCandidateGenerator,
+                                        GridSearchCandidateGenerator,
+                                        IntegerParameterSpace,
+                                        LocalOptimizationRunner,
+                                        MaxCandidatesCondition,
+                                        MaxTimeCondition,
+                                        OptimizationConfiguration,
+                                        RandomSearchGenerator)
+from deeplearning4j_tpu.clustering import (KDTree, KMeans, Tsne, VPTree)
+
+
+class TestParameterSpaces:
+    def test_continuous(self):
+        rng = np.random.RandomState(0)
+        s = ContinuousParameterSpace(0.1, 0.9)
+        vals = [s.sample(rng) for _ in range(100)]
+        assert all(0.1 <= v <= 0.9 for v in vals)
+        grid = s.grid_values(5)
+        assert grid[0] == pytest.approx(0.1) and grid[-1] == \
+            pytest.approx(0.9)
+
+    def test_log_scale(self):
+        rng = np.random.RandomState(0)
+        s = ContinuousParameterSpace(1e-5, 1e-1, log_scale=True)
+        vals = np.asarray([s.sample(rng) for _ in range(500)])
+        # log-uniform: ~half the mass below the geometric mean 1e-3
+        frac = np.mean(vals < 1e-3)
+        assert 0.3 < frac < 0.7
+
+    def test_integer_and_discrete(self):
+        rng = np.random.RandomState(0)
+        i = IntegerParameterSpace(2, 5)
+        assert set(i.grid_values(10)) == {2, 3, 4, 5}
+        d = DiscreteParameterSpace("relu", "tanh")
+        assert d.sample(rng) in ("relu", "tanh")
+        assert BooleanParameterSpace().grid_values(3) == [True, False]
+        assert FixedValue(7).sample(rng) == 7
+
+
+class TestGenerators:
+    def _spaces(self):
+        return {"lr": ContinuousParameterSpace(0.0, 1.0),
+                "units": IntegerParameterSpace(1, 3),
+                "act": DiscreteParameterSpace("a", "b")}
+
+    def test_grid_covers_product(self):
+        gen = GridSearchCandidateGenerator(self._spaces(),
+                                           discretization_count=3)
+        cands = []
+        while gen.has_more():
+            cands.append(gen.next().values)
+        assert len(cands) == 3 * 3 * 2 == gen.total
+        assert len({tuple(sorted(c.items())) for c in cands}) == 18
+
+    def test_random_within_bounds(self):
+        gen = RandomSearchGenerator(self._spaces(), num_candidates=20,
+                                    seed=1)
+        n = 0
+        while gen.has_more():
+            v = gen.next().values
+            assert 0 <= v["lr"] <= 1 and v["units"] in (1, 2, 3)
+            n += 1
+        assert n == 20
+
+    def test_genetic_improves_on_quadratic(self):
+        spaces = {"x": ContinuousParameterSpace(-5.0, 5.0),
+                  "y": ContinuousParameterSpace(-5.0, 5.0)}
+        gen = GeneticSearchCandidateGenerator(
+            spaces, population_size=12, generations=8, seed=0)
+        objective = lambda v: (v["x"] - 2) ** 2 + (v["y"] + 1) ** 2
+        runner = LocalOptimizationRunner(OptimizationConfiguration(
+            gen, objective, minimize=True))
+        best = runner.execute()
+        first_gen = [r.score for r in runner.results[:12]]
+        assert best.score < min(first_gen) + 1e-9
+        assert best.score < 0.5  # converged near the optimum
+        assert abs(best.candidate.values["x"] - 2) < 1.0
+
+
+class TestRunner:
+    def test_termination_conditions(self):
+        gen = RandomSearchGenerator(
+            {"x": ContinuousParameterSpace(0, 1)}, num_candidates=100)
+        runner = LocalOptimizationRunner(OptimizationConfiguration(
+            gen, lambda v: v["x"],
+            termination_conditions=[MaxCandidatesCondition(7)]))
+        runner.execute()
+        assert len(runner.results) == 7
+        gen2 = RandomSearchGenerator(
+            {"x": ContinuousParameterSpace(0, 1)}, num_candidates=5)
+        r2 = LocalOptimizationRunner(OptimizationConfiguration(
+            gen2, lambda v: v["x"],
+            termination_conditions=[MaxTimeCondition(60)]))
+        r2.execute()
+        assert len(r2.results) == 5
+
+    def test_optimizes_real_model(self, np_rng):
+        """End-to-end: search learning rate for a tiny MLP (the
+        reference's MultiLayerSpace->runner flow)."""
+        from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+        from deeplearning4j_tpu.learning import Adam
+        from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        X = np_rng.randn(96, 4).astype(np.float32)
+        Y = np.eye(2, dtype=np.float32)[(X[:, 0] > 0).astype(int)]
+
+        def score(values):
+            conf = (NeuralNetConfiguration.builder().seed(0)
+                    .updater(Adam(values["lr"])).list()
+                    .layer(DenseLayer(n_out=values["units"],
+                                      activation="relu"))
+                    .layer(OutputLayer(n_out=2, loss="mcxent",
+                                       activation="softmax"))
+                    .input_type_feed_forward(4).build())
+            net = MultiLayerNetwork(conf).init()
+            net.fit(ArrayDataSetIterator(X, Y, batch=32), epochs=6)
+            return float(net._last_loss), net
+
+        gen = GridSearchCandidateGenerator(
+            {"lr": DiscreteParameterSpace(1e-5, 3e-2),
+             "units": FixedValue(16)}, discretization_count=2)
+        runner = LocalOptimizationRunner(OptimizationConfiguration(
+            gen, score, minimize=True))
+        best = runner.execute()
+        assert best.candidate.values["lr"] == pytest.approx(3e-2)
+        assert best.model is not None
+
+
+class TestKMeans:
+    def test_separates_blobs(self, np_rng):
+        a = np_rng.randn(60, 2) + [0, 0]
+        b = np_rng.randn(60, 2) + [8, 8]
+        c = np_rng.randn(60, 2) + [-8, 8]
+        x = np.concatenate([a, b, c]).astype(np.float32)
+        km = KMeans(k=3, seed=0).fit(x)
+        labels = km.predict(x)
+        # each blob maps to one dominant cluster
+        for blob in (labels[:60], labels[60:120], labels[120:]):
+            counts = np.bincount(blob, minlength=3)
+            assert counts.max() / 60 > 0.95
+        assert km.inertia_ < 1000
+
+
+class TestTrees:
+    def test_vptree_exact_knn(self, np_rng):
+        pts = np_rng.randn(200, 5).astype(np.float32)
+        tree = VPTree(pts)
+        q = np_rng.randn(5).astype(np.float32)
+        idx, dists = tree.knn(q, 5)
+        brute = np.argsort(np.linalg.norm(pts - q, axis=1))[:5]
+        assert set(idx) == set(int(i) for i in brute)
+        assert dists == sorted(dists)
+
+    def test_vptree_cosine(self, np_rng):
+        pts = np_rng.randn(100, 8).astype(np.float32)
+        tree = VPTree(pts, distance="cosine")
+        q = pts[17]
+        idx, dists = tree.knn(q, 1)
+        assert idx[0] == 17 and dists[0] < 1e-5
+
+    def test_kdtree_nn(self, np_rng):
+        pts = np_rng.randn(300, 3).astype(np.float32)
+        tree = KDTree(pts)
+        q = np_rng.randn(3).astype(np.float32)
+        i, d = tree.nn(q)
+        brute = int(np.argmin(np.linalg.norm(pts - q, axis=1)))
+        assert i == brute
+
+
+class TestTsne:
+    def test_embeds_clusters_apart(self, np_rng):
+        a = np_rng.randn(40, 10) + 0
+        b = np_rng.randn(40, 10) + 6
+        x = np.concatenate([a, b]).astype(np.float32)
+        ts = Tsne(n_components=2, perplexity=15, n_iter=300, seed=0)
+        y = ts.fit_transform(x)
+        assert y.shape == (80, 2)
+        assert np.isfinite(ts.kl_)
+        ca, cb = y[:40].mean(0), y[40:].mean(0)
+        spread = 0.5 * (y[:40].std() + y[40:].std())
+        # cluster centroids separated well beyond intra-cluster spread
+        assert np.linalg.norm(ca - cb) > 2 * spread
