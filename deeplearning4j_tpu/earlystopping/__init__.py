@@ -1,0 +1,210 @@
+"""Early stopping (ref: D7 — `deeplearning4j-nn/.../earlystopping/**`:
+`EarlyStoppingConfiguration`, termination conditions
+(`MaxEpochsTerminationCondition`, `MaxTimeIterationTerminationCondition`,
+`ScoreImprovementEpochTerminationCondition`,
+`BestScoreEpochTerminationCondition`), score calculators
+(`DataSetLossCalculator`), savers (`LocalFileModelSaver`,
+`InMemoryModelSaver`), trainer
+`trainer/BaseEarlyStoppingTrainer.java:93` fit loop, and
+`EarlyStoppingResult`)."""
+from __future__ import annotations
+
+import copy
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# score calculators
+# ---------------------------------------------------------------------------
+class DataSetLossCalculator:
+    """Average loss over an iterator (ref: DataSetLossCalculator.java)."""
+
+    def __init__(self, iterator):
+        self.iterator = iterator
+
+    def calculate_score(self, model) -> float:
+        losses = []
+        for batch in self.iterator:
+            x, y = batch[0], batch[1]
+            m = batch[2] if len(batch) > 2 else None
+            losses.append(float(model.score(x, y, mask=m)))
+        if hasattr(self.iterator, "reset"):
+            self.iterator.reset()
+        return float(np.mean(losses))
+
+
+# ---------------------------------------------------------------------------
+# termination conditions
+# ---------------------------------------------------------------------------
+class MaxEpochsTerminationCondition:
+    def __init__(self, max_epochs: int):
+        self.max_epochs = max_epochs
+
+    def terminate(self, epoch: int, score: float, best_score: float,
+                  epochs_without_improvement: int) -> bool:
+        return epoch + 1 >= self.max_epochs
+
+
+class ScoreImprovementEpochTerminationCondition:
+    """Stop after `patience` epochs without improvement (ref:
+    ScoreImprovementEpochTerminationCondition.java)."""
+
+    def __init__(self, patience: int, min_improvement: float = 0.0):
+        self.patience = patience
+        self.min_improvement = min_improvement
+
+    def terminate(self, epoch, score, best_score,
+                  epochs_without_improvement) -> bool:
+        return epochs_without_improvement > self.patience
+
+
+class BestScoreEpochTerminationCondition:
+    """Stop once the score reaches a target (ref:
+    BestScoreEpochTerminationCondition.java)."""
+
+    def __init__(self, target: float):
+        self.target = target
+
+    def terminate(self, epoch, score, best_score,
+                  epochs_without_improvement) -> bool:
+        return score <= self.target
+
+
+class MaxTimeTerminationCondition:
+    def __init__(self, seconds: float):
+        self.seconds = seconds
+        self._start: Optional[float] = None
+
+    def terminate(self, epoch, score, best_score,
+                  epochs_without_improvement) -> bool:
+        if self._start is None:
+            self._start = time.time()
+            return False
+        return time.time() - self._start > self.seconds
+
+
+# ---------------------------------------------------------------------------
+# savers
+# ---------------------------------------------------------------------------
+class InMemoryModelSaver:
+    def __init__(self):
+        self._best = None
+
+    def save_best_model(self, model, score: float):
+        self._best = (jax.tree_util.tree_map(np.asarray, model._params),
+                      jax.tree_util.tree_map(np.asarray, model._net_state),
+                      score)
+
+    def get_best_model(self, model):
+        """Restores the saved params INTO `model` and returns it."""
+        if self._best is None:
+            return model
+        params, state, _ = self._best
+        model._params = jax.tree_util.tree_map(jax.numpy.asarray, params)
+        model._net_state = jax.tree_util.tree_map(jax.numpy.asarray, state)
+        return model
+
+
+class LocalFileModelSaver:
+    """Ref: LocalFileModelSaver.java — bestModel.bin in a directory."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.directory, "bestModel.zip")
+
+    def save_best_model(self, model, score: float):
+        from ..util.serializer import ModelSerializer
+        ModelSerializer.write_model(model, self.path)
+
+    def get_best_model(self, model):
+        from ..util.serializer import ModelSerializer
+        return ModelSerializer.restore_multi_layer_network(self.path)
+
+
+# ---------------------------------------------------------------------------
+# configuration + trainer + result
+# ---------------------------------------------------------------------------
+@dataclass
+class EarlyStoppingResult:
+    """Ref: EarlyStoppingResult.java."""
+    termination_reason: str
+    termination_details: str
+    score_vs_epoch: List[float]
+    best_model_epoch: int
+    best_model_score: float
+    total_epochs: int
+    best_model: Any
+
+
+class EarlyStoppingConfiguration:
+    """Ref: EarlyStoppingConfiguration.Builder."""
+
+    def __init__(self, score_calculator,
+                 epoch_termination_conditions: Sequence = (),
+                 model_saver=None, evaluate_every_n_epochs: int = 1,
+                 save_last_model: bool = False):
+        self.score_calculator = score_calculator
+        self.epoch_termination_conditions = list(
+            epoch_termination_conditions)
+        self.model_saver = model_saver or InMemoryModelSaver()
+        self.evaluate_every_n_epochs = evaluate_every_n_epochs
+
+
+class EarlyStoppingTrainer:
+    """Ref: BaseEarlyStoppingTrainer.fit :93 — train an epoch, score,
+    track best, check conditions."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, model,
+                 train_iterator):
+        self.config = config
+        self.model = model
+        self.iterator = train_iterator
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        best_score = np.inf
+        best_epoch = -1
+        scores: List[float] = []
+        epochs_no_improve = 0
+        epoch = 0
+        reason, details = "MaxEpochs", "conditions exhausted"
+        while True:
+            self.model.fit(self.iterator, epochs=1)
+            if epoch % cfg.evaluate_every_n_epochs == 0:
+                score = cfg.score_calculator.calculate_score(self.model)
+                scores.append(score)
+                if score < best_score:
+                    best_score = score
+                    best_epoch = epoch
+                    epochs_no_improve = 0
+                    cfg.model_saver.save_best_model(self.model, score)
+                else:
+                    epochs_no_improve += 1
+            stop = False
+            for cond in cfg.epoch_termination_conditions:
+                if cond.terminate(epoch, scores[-1], best_score,
+                                  epochs_no_improve):
+                    reason = type(cond).__name__
+                    details = (f"epoch={epoch} score={scores[-1]:.6f} "
+                               f"best={best_score:.6f}")
+                    stop = True
+                    break
+            epoch += 1
+            if stop:
+                break
+        best = cfg.model_saver.get_best_model(self.model)
+        return EarlyStoppingResult(
+            termination_reason=reason, termination_details=details,
+            score_vs_epoch=scores, best_model_epoch=best_epoch,
+            best_model_score=best_score, total_epochs=epoch,
+            best_model=best)

@@ -1,0 +1,147 @@
+"""Transfer learning (ref: D7 —
+`nn/transferlearning/TransferLearning.java:54-108`: Builder over a
+trained network with setFeatureExtractor (freeze up to a layer),
+removeOutputLayer / removeLayersFromOutput, addLayer,
+nOutReplace, fineTuneConfiguration; `FineTuneConfiguration.java`).
+
+The rebuilt network copies retained layers' trained params; frozen
+layers wrap in FrozenLayer (stop_gradient — see
+nn/layers/convolutional.FrozenLayer), so the compiled step simply never
+produces gradients for them.
+"""
+from __future__ import annotations
+
+import copy
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import learning
+from .conf import MultiLayerConfiguration
+from .layers import Layer
+from .layers.convolutional import FrozenLayer
+from .multilayer import MultiLayerNetwork
+
+
+class FineTuneConfiguration:
+    """Ref: FineTuneConfiguration.java — overrides applied to the whole
+    rebuilt network (updater/lr, seed)."""
+
+    def __init__(self, updater=None, seed: Optional[int] = None):
+        self.updater = learning.get(updater) if updater is not None \
+            else None
+        self.seed = seed
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def updater(self, u):
+            self._kw["updater"] = u
+            return self
+
+        def seed(self, s):
+            self._kw["seed"] = s
+            return self
+
+        def build(self):
+            return FineTuneConfiguration(**self._kw)
+
+    @staticmethod
+    def builder():
+        return FineTuneConfiguration.Builder()
+
+
+class TransferLearning:
+    """Ref: TransferLearning.Builder (:54)."""
+
+    class Builder:
+        def __init__(self, net: MultiLayerNetwork):
+            if net._params is None:
+                net.init()
+            self._net = net
+            self._layers: List[Layer] = [copy.deepcopy(l)
+                                         for l in net.layers]
+            # params copied per original layer index (None once removed)
+            self._params: List = [
+                jax.tree_util.tree_map(
+                    jnp.copy, net._params.get(net._layer_keys[i]))
+                if net._layer_keys[i] in net._params else None
+                for i in range(len(net.layers))]
+            self._state: List = [
+                jax.tree_util.tree_map(
+                    jnp.copy, net._net_state[net._layer_keys[i]])
+                if net._layer_keys[i] in net._net_state else None
+                for i in range(len(net.layers))]
+            self._freeze_until = -1
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+            self._appended: List[Layer] = []
+
+        def fine_tune_configuration(self, cfg: FineTuneConfiguration):
+            self._fine_tune = cfg
+            return self
+
+        def set_feature_extractor(self, layer_index: int):
+            """Freeze layers [0..layer_index] (ref: setFeatureExtractor)."""
+            self._freeze_until = layer_index
+            return self
+
+        def remove_output_layer(self):
+            return self.remove_layers_from_output(1)
+
+        def remove_layers_from_output(self, n: int):
+            for _ in range(n):
+                self._layers.pop()
+                self._params.pop()
+                self._state.pop()
+            return self
+
+        def add_layer(self, layer: Layer):
+            self._layers.append(layer)
+            self._params.append(None)
+            self._state.append(None)
+            return self
+
+        def build(self) -> MultiLayerNetwork:
+            old_conf = self._net.conf
+            layers: List[Layer] = []
+            for i, l in enumerate(self._layers):
+                if i <= self._freeze_until:
+                    layers.append(l if isinstance(l, FrozenLayer)
+                                  else FrozenLayer(l))
+                else:
+                    layers.append(l)
+            updater = old_conf.updater
+            seed = old_conf.seed
+            if self._fine_tune is not None:
+                if self._fine_tune.updater is not None:
+                    updater = self._fine_tune.updater
+                if self._fine_tune.seed is not None:
+                    seed = self._fine_tune.seed
+            conf = MultiLayerConfiguration(
+                layers=layers, seed=seed, updater=updater,
+                defaults=old_conf.defaults,
+                input_type=old_conf.input_type,
+                tbptt_fwd_length=old_conf.tbptt_fwd_length,
+                max_grad_norm=old_conf.max_grad_norm,
+                grad_clip_value=old_conf.grad_clip_value)
+            net = MultiLayerNetwork(conf).init()
+            # restore trained params/state for retained layers
+            for i, (p, s) in enumerate(zip(self._params, self._state)):
+                key = net._layer_keys[i]
+                if p is not None and key in net._params:
+                    net._params[key] = p
+                if s is not None and key in net._net_state:
+                    net._net_state[key] = s
+            # rebuild optimizer state against the restored params
+            net._opt_state = {
+                net._layer_keys[i]: net._updaters[i].init_state(
+                    net._params[net._layer_keys[i]])
+                for i in range(len(net.layers))
+                if net._layer_keys[i] in net._params}
+            return net
+
+    @staticmethod
+    def builder(net: MultiLayerNetwork) -> "TransferLearning.Builder":
+        return TransferLearning.Builder(net)

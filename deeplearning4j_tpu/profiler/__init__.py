@@ -1,0 +1,150 @@
+"""Profiling / numeric sanity (ref: J10 —
+`linalg/profiler/{OpProfiler,ProfilerConfig}.java`, ProfilingMode enum at
+`executioner/OpExecutioner.java:53-63` {DISABLED, NAN_PANIC, INF_PANIC,
+ANY_PANIC, OPERATIONS, METHODS, ALL, SCOPE_PANIC, BANDWIDTH}, native
+profiling structs `include/graph/profiling/`).
+
+TPU-native shape: per-op timing dissolves under XLA fusion (there are no
+per-op kernels to time), so the profiler times named SECTIONS (step,
+epoch, forward…) and wraps `jax.profiler` for the real device trace
+(xplane). The NaN/Inf panic modes survive intact as pytree checks —
+the jax.debug/checkify-era equivalent of the reference's per-op panics.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from enum import Enum
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+class ProfilingMode(Enum):
+    """Ref: OpExecutioner.ProfilingMode :53-63."""
+    DISABLED = "disabled"
+    NAN_PANIC = "nan_panic"
+    INF_PANIC = "inf_panic"
+    ANY_PANIC = "any_panic"
+    OPERATIONS = "operations"
+    ALL = "all"
+
+
+class ND4JOpProfilerException(RuntimeError):
+    """Ref: the exception OpProfiler's panic modes raise."""
+
+
+def check_for_nan(tree, label: str = "array"):
+    """Ref: OpProfiler NAN_PANIC hook."""
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        a = np.asarray(leaf)
+        if np.issubdtype(a.dtype, np.floating) and np.isnan(a).any():
+            raise ND4JOpProfilerException(
+                f"NaN detected in {label}{jax.tree_util.keystr(path)}")
+
+
+def check_for_inf(tree, label: str = "array"):
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        a = np.asarray(leaf)
+        if np.issubdtype(a.dtype, np.floating) and np.isinf(a).any():
+            raise ND4JOpProfilerException(
+                f"Inf detected in {label}{jax.tree_util.keystr(path)}")
+
+
+class OpProfiler:
+    """Section timing + panic checks (ref: OpProfiler singleton —
+    getInstance, timing aggregation per op name, reset, printOutDashboard
+    -> print_report)."""
+
+    _instance: Optional["OpProfiler"] = None
+
+    def __init__(self):
+        self.mode = ProfilingMode.DISABLED
+        self._totals: Dict[str, float] = defaultdict(float)
+        self._counts: Dict[str, int] = defaultdict(int)
+
+    @classmethod
+    def get_instance(cls) -> "OpProfiler":
+        if cls._instance is None:
+            cls._instance = OpProfiler()
+        return cls._instance
+
+    def set_mode(self, mode: ProfilingMode):
+        self.mode = mode
+
+    @contextlib.contextmanager
+    def record(self, name: str):
+        """Time a named section (ref: processOpCall timing path). Blocks
+        on device completion so the timing is honest."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if self.mode in (ProfilingMode.OPERATIONS, ProfilingMode.ALL):
+                self._totals[name] += time.perf_counter() - t0
+                self._counts[name] += 1
+
+    def check(self, tree, label: str = "array"):
+        """Apply the active panic mode to a pytree of arrays."""
+        if self.mode in (ProfilingMode.NAN_PANIC, ProfilingMode.ANY_PANIC,
+                         ProfilingMode.ALL):
+            check_for_nan(tree, label)
+        if self.mode in (ProfilingMode.INF_PANIC, ProfilingMode.ANY_PANIC,
+                         ProfilingMode.ALL):
+            check_for_inf(tree, label)
+
+    def timings(self) -> Dict[str, Dict[str, float]]:
+        return {name: {"total_s": self._totals[name],
+                       "count": self._counts[name],
+                       "mean_s": self._totals[name]
+                       / max(1, self._counts[name])}
+                for name in self._totals}
+
+    def reset(self):
+        self._totals.clear()
+        self._counts.clear()
+
+    def print_report(self):
+        for name, t in sorted(self.timings().items(),
+                              key=lambda kv: -kv[1]["total_s"]):
+            print(f"{name:<32} {t['count']:>8} calls "
+                  f"{t['total_s'] * 1e3:>10.2f} ms total "
+                  f"{t['mean_s'] * 1e6:>10.1f} us/call")
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str):
+    """XLA/TPU trace capture (xplane) — view in TensorBoard/XProf (ref
+    role: the native-side profiling structs + SameDiff UI log)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class ProfilerListener:
+    """TrainingListener applying panic checks to loss/params every
+    iteration (the fit-loop integration point of the panic modes)."""
+
+    def __init__(self, mode: ProfilingMode = ProfilingMode.NAN_PANIC,
+                 check_params: bool = False):
+        self.profiler = OpProfiler.get_instance()
+        self.mode = mode
+        self.check_params = check_params
+
+    def iteration_done(self, model, iteration: int, epoch: int):
+        prev = self.profiler.mode
+        self.profiler.set_mode(self.mode)
+        try:
+            self.profiler.check(
+                {"score": np.asarray(model.score_)}, "loss")
+            if self.check_params:
+                self.profiler.check(model._params, "params")
+        finally:
+            self.profiler.set_mode(prev)
+
+    def on_epoch_end(self, model):
+        pass

@@ -1,0 +1,215 @@
+"""Training UI / stats pipeline (ref: D15 — deeplearning4j-ui-parent):
+`StatsListener` (SBE-encoded stats) -> `StatsStorage` (mapdb/sqlite) ->
+`PlayUIServer.attach` (`ui/play/PlayUIServer.java:337`), remote stats
+routing for cluster training.
+
+TPU-native shape: the listener samples score/param/update statistics per
+iteration (host-side, off the device critical path), storage is
+in-memory or sqlite, and the server is a stdlib HTTP endpoint serving
+JSON + a dependency-free HTML chart — same pipeline, no Play framework.
+"""
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+from collections import defaultdict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..optimize import TrainingListener
+
+
+# ---------------------------------------------------------------------------
+# storage (ref: StatsStorage SPI + InMemoryStatsStorage / FileStatsStorage)
+# ---------------------------------------------------------------------------
+class InMemoryStatsStorage:
+    def __init__(self):
+        self._updates: Dict[str, List[dict]] = defaultdict(list)
+        self._lock = threading.Lock()
+
+    def put_update(self, session_id: str, update: dict):
+        with self._lock:
+            self._updates[session_id].append(update)
+
+    def list_session_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._updates)
+
+    def get_updates(self, session_id: str) -> List[dict]:
+        with self._lock:
+            return list(self._updates.get(session_id, []))
+
+
+class FileStatsStorage:
+    """sqlite-backed storage (ref: FileStatsStorage uses MapDB; sqlite is
+    the stdlib equivalent)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        with self._conn() as c:
+            c.execute("CREATE TABLE IF NOT EXISTS updates ("
+                      "session TEXT, ts REAL, payload TEXT)")
+
+    def _conn(self):
+        return sqlite3.connect(self.path)
+
+    def put_update(self, session_id: str, update: dict):
+        with self._lock, self._conn() as c:
+            c.execute("INSERT INTO updates VALUES (?, ?, ?)",
+                      (session_id, time.time(), json.dumps(update)))
+
+    def list_session_ids(self) -> List[str]:
+        with self._lock, self._conn() as c:
+            rows = c.execute(
+                "SELECT DISTINCT session FROM updates").fetchall()
+        return sorted(r[0] for r in rows)
+
+    def get_updates(self, session_id: str) -> List[dict]:
+        with self._lock, self._conn() as c:
+            rows = c.execute(
+                "SELECT payload FROM updates WHERE session=? ORDER BY ts",
+                (session_id,)).fetchall()
+        return [json.loads(r[0]) for r in rows]
+
+
+# ---------------------------------------------------------------------------
+# listener (ref: deeplearning4j-ui-model StatsListener.java)
+# ---------------------------------------------------------------------------
+class StatsListener(TrainingListener):
+    """Collects per-iteration score + parameter/update statistics into a
+    StatsStorage (ref: StatsListener collects score, param/update/
+    activation mean magnitudes + histograms; the mean-magnitude core is
+    reproduced here)."""
+
+    def __init__(self, storage, session_id: Optional[str] = None,
+                 report_every: int = 1, collect_params: bool = True):
+        self.storage = storage
+        self.session_id = session_id or f"session_{int(time.time())}"
+        self.report_every = report_every
+        self.collect_params = collect_params
+        self._last_time = None
+
+    def iteration_done(self, model, iteration: int, epoch: int):
+        if iteration % self.report_every:
+            return
+        now = time.time()
+        update = {"iteration": iteration, "epoch": epoch,
+                  "score": float(model.score_), "ts": now}
+        if self._last_time is not None:
+            update["iter_seconds"] = now - self._last_time
+        self._last_time = now
+        if self.collect_params and getattr(model, "_params", None):
+            mm = {}
+            for lkey, ptree in model._params.items():
+                for pname, arr in ptree.items():
+                    a = np.asarray(arr)
+                    mm[f"{lkey}.{pname}"] = float(np.mean(np.abs(a)))
+            update["param_mean_magnitudes"] = mm
+        self.storage.put_update(self.session_id, update)
+
+
+# ---------------------------------------------------------------------------
+# server (ref: PlayUIServer attach :337)
+# ---------------------------------------------------------------------------
+_PAGE = """<!doctype html><html><head><title>dl4j-tpu training UI</title>
+<style>body{font-family:sans-serif;margin:2em}#chart{border:1px solid #ccc}
+</style></head><body><h2>Training score</h2>
+<select id=sess></select> <canvas id=chart width=800 height=300></canvas>
+<script>
+async function sessions(){
+  const s = await (await fetch('/sessions')).json();
+  const sel = document.getElementById('sess');
+  sel.innerHTML = s.map(x=>`<option>${x}</option>`).join('');
+  if (s.length) draw(s[0]);
+  sel.onchange = () => draw(sel.value);
+}
+async function draw(id){
+  const u = await (await fetch('/train/'+id+'/overview')).json();
+  const c = document.getElementById('chart').getContext('2d');
+  c.clearRect(0,0,800,300);
+  const xs = u.map(p=>p.iteration), ys = u.map(p=>p.score);
+  if (!xs.length) return;
+  const xmax = Math.max(...xs), ymax = Math.max(...ys),
+        ymin = Math.min(...ys);
+  c.beginPath();
+  u.forEach((p,i)=>{const x = 10+780*p.iteration/Math.max(xmax,1);
+    const y = 290-280*(p.score-ymin)/Math.max(ymax-ymin,1e-9);
+    i?c.lineTo(x,y):c.moveTo(x,y);});
+  c.strokeStyle='#2060c0'; c.stroke();
+}
+sessions(); setInterval(sessions, 5000);
+</script></body></html>"""
+
+
+class UIServer:
+    """Ref: UIServer.getInstance().attach(statsStorage)."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, port: int = 0):
+        self.storages: List = []
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path in ("/", "/train"):
+                    body = _PAGE.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/sessions":
+                    ids = []
+                    for st in server.storages:
+                        ids.extend(st.list_session_ids())
+                    self._json(sorted(set(ids)))
+                elif self.path.startswith("/train/") and \
+                        self.path.endswith("/overview"):
+                    sid = self.path[len("/train/"):-len("/overview")]
+                    out = []
+                    for st in server.storages:
+                        out.extend(st.get_updates(sid))
+                    self._json(out)
+                else:
+                    self._json({"error": "not found"}, 404)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @classmethod
+    def get_instance(cls, port: int = 0) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = cls(port)
+        return cls._instance
+
+    def attach(self, storage):
+        self.storages.append(storage)
+
+    def detach(self, storage):
+        self.storages.remove(storage)
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if UIServer._instance is self:
+            UIServer._instance = None

@@ -1,0 +1,227 @@
+"""Training infrastructure tests: early stopping, transfer learning,
+stats/UI pipeline, profiler (SURVEY.md D7/D15/J10)."""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+from deeplearning4j_tpu.earlystopping import (
+    BestScoreEpochTerminationCondition, DataSetLossCalculator,
+    EarlyStoppingConfiguration, EarlyStoppingTrainer, InMemoryModelSaver,
+    LocalFileModelSaver, MaxEpochsTerminationCondition,
+    ScoreImprovementEpochTerminationCondition)
+from deeplearning4j_tpu.learning import Adam, Sgd
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.transferlearning import (FineTuneConfiguration,
+                                                    TransferLearning)
+from deeplearning4j_tpu.profiler import (ND4JOpProfilerException,
+                                         OpProfiler, ProfilerListener,
+                                         ProfilingMode, check_for_nan)
+from deeplearning4j_tpu.ui import (FileStatsStorage, InMemoryStatsStorage,
+                                   StatsListener, UIServer)
+
+
+def _net(lr=0.05, seed=0, n_in=4, hidden=16, n_out=2):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(lr))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=hidden, activation="relu",
+                              name="feat"))
+            .layer(OutputLayer(n_out=n_out, loss="mcxent",
+                               activation="softmax", name="head"))
+            .input_type_feed_forward(n_in).build())
+    return MultiLayerNetwork(conf)
+
+
+def _data(np_rng, n=96):
+    X = np_rng.randn(n, 4).astype(np.float32)
+    Y = np.eye(2, dtype=np.float32)[(X[:, 0] + X[:, 1] > 0).astype(int)]
+    return X, Y
+
+
+class TestEarlyStopping:
+    def test_stops_on_max_epochs_and_restores_best(self, np_rng):
+        X, Y = _data(np_rng)
+        it = ArrayDataSetIterator(X, Y, batch=32)
+        net = _net().init()
+        cfg = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(
+                ArrayDataSetIterator(X, Y, batch=32)),
+            epoch_termination_conditions=[
+                MaxEpochsTerminationCondition(8)],
+            model_saver=InMemoryModelSaver())
+        result = EarlyStoppingTrainer(cfg, net, it).fit()
+        assert result.total_epochs == 8
+        assert result.termination_reason == \
+            "MaxEpochsTerminationCondition"
+        assert len(result.score_vs_epoch) == 8
+        assert result.best_model_score == min(result.score_vs_epoch)
+        # best model actually scores best_model_score
+        rescore = DataSetLossCalculator(
+            ArrayDataSetIterator(X, Y, batch=32)).calculate_score(
+            result.best_model)
+        assert rescore == pytest.approx(result.best_model_score, rel=1e-4)
+
+    def test_patience_condition(self, np_rng):
+        X, Y = _data(np_rng, 48)
+        # lr=0 -> no improvement ever -> patience triggers quickly
+        net = _net(lr=0.0).init()
+        cfg = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(
+                ArrayDataSetIterator(X, Y, batch=24)),
+            epoch_termination_conditions=[
+                ScoreImprovementEpochTerminationCondition(patience=2),
+                MaxEpochsTerminationCondition(50)])
+        result = EarlyStoppingTrainer(
+            cfg, net, ArrayDataSetIterator(X, Y, batch=24)).fit()
+        assert result.total_epochs <= 5
+        assert "ScoreImprovement" in result.termination_reason
+
+    def test_best_score_target(self, np_rng):
+        X, Y = _data(np_rng)
+        net = _net(lr=0.05).init()
+        cfg = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(
+                ArrayDataSetIterator(X, Y, batch=32)),
+            epoch_termination_conditions=[
+                BestScoreEpochTerminationCondition(0.4),
+                MaxEpochsTerminationCondition(100)])
+        result = EarlyStoppingTrainer(
+            cfg, net, ArrayDataSetIterator(X, Y, batch=32)).fit()
+        assert result.score_vs_epoch[-1] <= 0.4
+        assert result.total_epochs < 100
+
+    def test_local_file_saver(self, np_rng, tmp_path):
+        X, Y = _data(np_rng, 48)
+        net = _net().init()
+        cfg = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(
+                ArrayDataSetIterator(X, Y, batch=24)),
+            epoch_termination_conditions=[
+                MaxEpochsTerminationCondition(3)],
+            model_saver=LocalFileModelSaver(str(tmp_path)))
+        result = EarlyStoppingTrainer(
+            cfg, net, ArrayDataSetIterator(X, Y, batch=24)).fit()
+        assert (tmp_path / "bestModel.zip").exists()
+        out = result.best_model.output(X[:4])
+        assert np.asarray(out).shape == (4, 2)
+
+
+class TestTransferLearning:
+    def test_freeze_and_replace_head(self, np_rng):
+        X, Y = _data(np_rng)
+        base = _net(seed=3).init()
+        base.fit(ArrayDataSetIterator(X, Y, batch=32), epochs=6)
+        feat_key = base._layer_keys[0]
+        w_before = np.asarray(base._params[feat_key]["W"]).copy()
+
+        new_net = (TransferLearning.builder(base)
+                   .fine_tune_configuration(
+                       FineTuneConfiguration.builder()
+                       .updater(Sgd(0.5)).build())
+                   .set_feature_extractor(0)
+                   .remove_output_layer()
+                   .add_layer(OutputLayer(n_out=2, loss="mcxent",
+                                          activation="softmax"))
+                   .build())
+        # trained features copied in
+        new_key = new_net._layer_keys[0]
+        np.testing.assert_allclose(
+            np.asarray(new_net._params[new_key]["W"]), w_before, rtol=1e-6)
+        # train the new head: frozen features must not move
+        new_net.fit(ArrayDataSetIterator(X, Y, batch=32), epochs=4)
+        np.testing.assert_allclose(
+            np.asarray(new_net._params[new_key]["W"]), w_before, rtol=1e-6)
+        ev = new_net.evaluate(ArrayDataSetIterator(X, Y, batch=32))
+        assert ev.accuracy() > 0.7
+
+    def test_remove_multiple_and_output_works(self, np_rng):
+        X, Y = _data(np_rng, 32)
+        base = _net().init()
+        net = (TransferLearning.builder(base)
+               .remove_layers_from_output(2)
+               .add_layer(DenseLayer(n_out=8, activation="tanh"))
+               .add_layer(OutputLayer(n_out=2, loss="mcxent",
+                                      activation="softmax"))
+               .build())
+        out = net.output(X[:5])
+        assert np.asarray(out).shape == (5, 2)
+
+
+class TestStatsUI:
+    def test_listener_and_memory_storage(self, np_rng):
+        X, Y = _data(np_rng, 64)
+        storage = InMemoryStatsStorage()
+        net = _net().init()
+        net.listeners.append(StatsListener(storage, session_id="s1"))
+        net.fit(ArrayDataSetIterator(X, Y, batch=32), epochs=2)
+        assert storage.list_session_ids() == ["s1"]
+        updates = storage.get_updates("s1")
+        assert len(updates) == 4  # 2 batches x 2 epochs
+        assert all(np.isfinite(u["score"]) for u in updates)
+        assert "param_mean_magnitudes" in updates[0]
+        key = [k for k in updates[0]["param_mean_magnitudes"]
+               if k.endswith(".W")][0]
+        assert updates[0]["param_mean_magnitudes"][key] > 0
+
+    def test_file_storage(self, tmp_path):
+        st = FileStatsStorage(str(tmp_path / "stats.db"))
+        st.put_update("a", {"iteration": 0, "score": 1.0})
+        st.put_update("a", {"iteration": 1, "score": 0.5})
+        st.put_update("b", {"iteration": 0, "score": 2.0})
+        assert st.list_session_ids() == ["a", "b"]
+        ups = st.get_updates("a")
+        assert [u["score"] for u in ups] == [1.0, 0.5]
+
+    def test_http_server_endpoints(self):
+        storage = InMemoryStatsStorage()
+        storage.put_update("sess", {"iteration": 0, "score": 0.9})
+        server = UIServer(port=0)
+        try:
+            server.attach(storage)
+            base = f"http://127.0.0.1:{server.port}"
+            sessions = json.loads(urllib.request.urlopen(
+                base + "/sessions", timeout=5).read())
+            assert sessions == ["sess"]
+            overview = json.loads(urllib.request.urlopen(
+                base + "/train/sess/overview", timeout=5).read())
+            assert overview[0]["score"] == 0.9
+            page = urllib.request.urlopen(base + "/", timeout=5).read()
+            assert b"Training score" in page
+        finally:
+            server.stop()
+
+
+class TestProfiler:
+    def test_nan_panic(self):
+        with pytest.raises(ND4JOpProfilerException, match="NaN"):
+            check_for_nan({"w": np.asarray([1.0, np.nan])})
+        check_for_nan({"w": np.asarray([1.0, 2.0])})  # clean passes
+
+    def test_section_timing(self):
+        prof = OpProfiler.get_instance()
+        prof.reset()
+        prof.set_mode(ProfilingMode.OPERATIONS)
+        with prof.record("step"):
+            sum(range(1000))
+        with prof.record("step"):
+            sum(range(1000))
+        t = prof.timings()
+        assert t["step"]["count"] == 2
+        assert t["step"]["total_s"] > 0
+        prof.set_mode(ProfilingMode.DISABLED)
+
+    def test_profiler_listener_panics_on_nan(self, np_rng):
+        import jax.numpy as jnp
+        X, Y = _data(np_rng, 32)
+        net = _net().init()
+        # poison a weight: forward produces NaN loss -> listener raises
+        key = net._layer_keys[0]
+        net._params[key]["W"] = net._params[key]["W"].at[0, 0].set(
+            jnp.nan)
+        net.listeners.append(ProfilerListener(ProfilingMode.NAN_PANIC,
+                                              check_params=True))
+        with pytest.raises(ND4JOpProfilerException):
+            net.fit(ArrayDataSetIterator(X, Y, batch=16), epochs=1)
