@@ -4,17 +4,19 @@
 Methodology follows the reference's own benchmark guidance
 (`docs/deeplearning4j/templates/benchmark.md:16-100,165-186`): warmup
 excluded, fixed realistic minibatch, ETL excluded (data pre-staged on
-host), wall-clock over many iterations.
+device), wall-clock over many iterations.
 
-Current headline: LeNet-CNN MNIST training throughput (samples/sec) on one
-chip — BASELINE config 1. (Will graduate to ResNet50 images/sec/chip as the
-zoo lands.) The reference publishes no absolute numbers (BASELINE.md), so
-vs_baseline compares against the previous round's recorded value when
-available (BENCH_r*.json), else 1.0.
+Headline: ResNet50 ImageNet-shaped training throughput (images/sec) on
+one chip — BASELINE config 2, the reference zoo's flagship benchmark
+model. Falls back to LeNet-MNIST (config 1) if the big model cannot run
+(e.g. CPU fallback), so the driver always gets a data point. The
+reference publishes no absolute numbers (BASELINE.md), so vs_baseline
+compares against the previous round's recorded value when available
+(BENCH_r*.json), else 1.0.
 
-Robustness: the axon TPU tunnel is single-client and can wedge; the actual
-bench runs in a subprocess with a timeout, retried once, then falls back to
-CPU so the driver always gets its JSON line.
+Robustness: the axon TPU tunnel is single-client and can wedge; each
+bench runs in a subprocess with a timeout, retried once, then falls back
+to CPU/LeNet so the driver always gets its JSON line.
 """
 from __future__ import annotations
 
@@ -24,11 +26,44 @@ import os
 import subprocess
 import sys
 
-BENCH_CODE = r"""
-import json, time, sys
+RESNET_CODE = r"""
+import json, time
 import numpy as np
 import jax, jax.numpy as jnp
+from deeplearning4j_tpu.zoo.resnet import ResNet50
 
+BATCH = 32
+model = ResNet50(num_classes=1000, seed=0).init()
+rs = np.random.RandomState(0)
+x = jnp.asarray(rs.rand(BATCH, 224, 224, 3).astype(np.float32))
+y = jnp.asarray(np.eye(1000, dtype=np.float32)[rs.randint(0, 1000, BATCH)])
+inputs = model._as_inputs(x)
+labels = model._as_labels(y)
+masks = model._as_masks(None) if hasattr(model, "_as_masks") else None
+step = model._make_step()
+rng = jax.random.PRNGKey(0)
+params, opt, st = model._params, model._opt_state, model._net_state
+for i in range(3):  # warmup: compile + stabilize
+    params, opt, st, loss = step(params, opt, st, jnp.asarray(i),
+                                 inputs, labels, masks, rng)
+jax.block_until_ready(loss)
+N = 30
+t0 = time.perf_counter()
+for i in range(N):
+    params, opt, st, loss = step(params, opt, st, jnp.asarray(i),
+                                 inputs, labels, masks, rng)
+jax.block_until_ready(loss)
+dt = time.perf_counter() - t0
+print(json.dumps({"samples_per_sec": N * BATCH / dt,
+                  "platform": jax.devices()[0].platform,
+                  "model": "ResNet50-224 train (batch 32)",
+                  "ms_per_iter": 1000 * dt / N}))
+"""
+
+LENET_CODE = r"""
+import json, time
+import numpy as np
+import jax, jax.numpy as jnp
 from deeplearning4j_tpu.datasets import MnistDataSetIterator
 from deeplearning4j_tpu.learning import Adam
 from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
@@ -46,39 +81,39 @@ conf = (NeuralNetConfiguration.builder().seed(123).updater(Adam(1e-3))
         .layer(OutputLayer(n_out=10, loss="mcxent", activation="softmax"))
         .input_type_convolutional(28, 28, 1).build())
 model = MultiLayerNetwork(conf).init()
-
 it = MnistDataSetIterator(batch=BATCH, train=True, flatten=False,
                           num_examples=4096, shuffle=False)
-batches = [(jnp.asarray(b[0]), jnp.asarray(b[1])) for b in it]  # pre-staged: ETL excluded
+batches = [(jnp.asarray(b[0]), jnp.asarray(b[1])) for b in it]
 step = model._make_step()
 rng = jax.random.PRNGKey(0)
-
-# warmup (compile + 3 steps)
 params, opt, st = model._params, model._opt_state, model._net_state
 for i in range(3):
     x, y = batches[i % len(batches)]
-    params, opt, st, loss = step(params, opt, st, jnp.asarray(i), x, y, None, rng)
+    params, opt, st, loss = step(params, opt, st, jnp.asarray(i), x, y,
+                                 None, rng)
 jax.block_until_ready(loss)
-
 N = 60
 t0 = time.perf_counter()
 for i in range(N):
     x, y = batches[i % len(batches)]
-    params, opt, st, loss = step(params, opt, st, jnp.asarray(i), x, y, None, rng)
+    params, opt, st, loss = step(params, opt, st, jnp.asarray(i), x, y,
+                                 None, rng)
 jax.block_until_ready(loss)
 dt = time.perf_counter() - t0
-platform = jax.devices()[0].platform
-print(json.dumps({"samples_per_sec": N * BATCH / dt, "platform": platform,
+print(json.dumps({"samples_per_sec": N * BATCH / dt,
+                  "platform": jax.devices()[0].platform,
+                  "model": "LeNet-MNIST train (batch 128)",
                   "ms_per_iter": 1000 * dt / N}))
 """
 
 
-def _run(env_extra, timeout):
+def _run(code, env_extra, timeout):
     env = dict(os.environ)
     env.update(env_extra)
     try:
-        out = subprocess.run([sys.executable, "-c", BENCH_CODE], env=env,
-                             capture_output=True, text=True, timeout=timeout)
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True,
+                             timeout=timeout)
         for line in reversed(out.stdout.strip().splitlines()):
             try:
                 return json.loads(line)
@@ -94,7 +129,8 @@ def _prev_round_value():
     for f in sorted(glob.glob("BENCH_r*.json")):
         try:
             d = json.load(open(f))
-            if isinstance(d, dict) and isinstance(d.get("value"), (int, float)):
+            if isinstance(d, dict) and isinstance(d.get("value"),
+                                                  (int, float)):
                 vals.append(d["value"])
         except Exception:
             continue
@@ -102,19 +138,26 @@ def _prev_round_value():
 
 
 def main():
-    # try the real TPU first (two attempts — the tunnel occasionally needs one)
-    res = _run({}, timeout=600)
+    # headline: ResNet50 on the real chip (two attempts — the tunnel
+    # occasionally needs one)
+    res = _run(RESNET_CODE, {}, timeout=900)
     if res is None:
-        res = _run({}, timeout=300)
+        res = _run(RESNET_CODE, {}, timeout=600)
     if res is None:
-        # tunnel wedged — fall back to hermetic CPU so the driver gets data
-        res = _run({"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"},
-                   timeout=600) or {"samples_per_sec": 0.0, "platform": "none"}
+        # LeNet on the chip, then hermetic-CPU LeNet as last resort
+        res = _run(LENET_CODE, {}, timeout=600)
+    if res is None:
+        res = _run(LENET_CODE,
+                   {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"},
+                   timeout=600) or {"samples_per_sec": 0.0,
+                                    "platform": "none",
+                                    "model": "none"}
     value = round(res["samples_per_sec"], 1)
     prev = _prev_round_value()
     vs = round(value / prev, 3) if prev else 1.0
     print(json.dumps({
-        "metric": f"LeNet-MNIST train throughput ({res.get('platform', '?')}, batch 128)",
+        "metric": f"{res.get('model', '?')} throughput "
+                  f"({res.get('platform', '?')})",
         "value": value,
         "unit": "samples/sec",
         "vs_baseline": vs,

@@ -269,3 +269,82 @@ class RegressionEvaluation:
 
     def average_mean_squared_error(self) -> float:
         return float(np.mean(self._sum_sq / self._n))
+
+
+class ROCBinary:
+    """Per-output binary ROC for multi-label sigmoid outputs (ref:
+    ROCBinary.java)."""
+
+    def __init__(self):
+        self._rocs: Dict[int, ROC] = {}
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 1:
+            labels = labels[:, None]
+            predictions = predictions[:, None]
+        for c in range(labels.shape[-1]):
+            self._rocs.setdefault(c, ROC()).eval(labels[..., c],
+                                                 predictions[..., c])
+
+    def auc(self, output: int = 0) -> float:
+        return self._rocs[output].auc()
+
+    def auprc(self, output: int = 0) -> float:
+        return self._rocs[output].auprc()
+
+    def num_outputs(self) -> int:
+        return len(self._rocs)
+
+
+class EvaluationCalibration:
+    """Reliability diagram + probability histograms (ref:
+    EvaluationCalibration.java — reliability bins, residual plot,
+    probability histogram; expected calibration error added as the
+    summary scalar)."""
+
+    def __init__(self, num_bins: int = 10):
+        self.num_bins = num_bins
+        self._counts = np.zeros(num_bins)
+        self._pos = np.zeros(num_bins)
+        self._prob_sum = np.zeros(num_bins)
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        pred = np.asarray(predictions)
+        if labels.ndim > 1:
+            # multiclass: calibration over the predicted-class probability
+            cls = pred.argmax(-1)
+            p = np.take_along_axis(pred, cls[..., None], -1)[..., 0]
+            hit = (labels.argmax(-1) == cls).astype(np.float64)
+        else:
+            p = pred
+            hit = (labels > 0.5).astype(np.float64)
+        bins = np.clip((p * self.num_bins).astype(int), 0,
+                       self.num_bins - 1)
+        for b, h, pr in zip(bins.reshape(-1), hit.reshape(-1),
+                            np.asarray(p).reshape(-1)):
+            self._counts[b] += 1
+            self._pos[b] += h
+            self._prob_sum[b] += pr
+
+    def reliability_curve(self):
+        """Returns (mean predicted prob per bin, empirical accuracy per
+        bin, counts)."""
+        with np.errstate(invalid="ignore"):
+            mean_p = np.where(self._counts > 0,
+                              self._prob_sum / np.maximum(self._counts, 1),
+                              np.nan)
+            acc = np.where(self._counts > 0,
+                           self._pos / np.maximum(self._counts, 1), np.nan)
+        return mean_p, acc, self._counts.copy()
+
+    def expected_calibration_error(self) -> float:
+        mean_p, acc, counts = self.reliability_curve()
+        total = counts.sum()
+        if total == 0:
+            return 0.0
+        valid = counts > 0
+        return float(np.sum(counts[valid] * np.abs(mean_p[valid]
+                                                   - acc[valid])) / total)

@@ -1,0 +1,123 @@
+"""Model serving + compiled-program export.
+
+Ref: the reference's serving surface — `libnd4j/server/GraphServer.cpp`
+(gRPC + FlatBuffers inference server), the KNN REST server
+(`deeplearning4j-nearestneighbor-server`), and datavec's
+spark-inference REST endpoints (L7 inventory).
+
+TPU-native shape:
+- :class:`InferenceServer`: one stdlib HTTP endpoint serving any model
+  with an `output(x)` method (MultiLayerNetwork, ComputationGraph) or a
+  SameDiff (named-placeholder feed). JSON in/out; the compiled forward
+  is cached across requests exactly like the C++ server caches its
+  FlatBuffers graph.
+- :func:`export_stablehlo`: serialize a SameDiff (or any jittable
+  fn+args) to StableHLO text — the portable compiled-graph artifact
+  replacing the reference's FlatBuffers graph format (SURVEY.md §2.1:
+  "N5 -> StableHLO module serialization").
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+def export_stablehlo(fn_or_samediff, example_args=None,
+                     outputs: Optional[Sequence[str]] = None,
+                     placeholders: Optional[Dict[str, Any]] = None) -> str:
+    """StableHLO text for a jittable fn or a SameDiff graph.
+
+    SameDiff: pass `outputs` (names) and `placeholders` (example arrays
+    fixing shapes). Function: pass `example_args`.
+    """
+    from ..autodiff.samediff import SameDiff
+    if isinstance(fn_or_samediff, SameDiff):
+        sd = fn_or_samediff
+        outs = tuple(outputs or sd._loss_variables)
+        if not outs:
+            raise ValueError("pass outputs= for SameDiff export")
+        gfn = sd._build(outs)
+        vals = sd._filter_values(sd._exec_values(placeholders or {}), gfn)
+        rng = jax.random.PRNGKey(sd.seed)
+        lowered = jax.jit(lambda v, r: gfn(v, r)).lower(vals, rng)
+    else:
+        lowered = jax.jit(fn_or_samediff).lower(*(example_args or ()))
+    return lowered.as_text()
+
+
+class InferenceServer:
+    """HTTP JSON inference endpoint (ref role: GraphServer.cpp).
+
+    POST /predict           {"inputs": [[...]]} -> {"outputs": [[...]]}
+    POST /predict (SameDiff) {"inputs": {"x": [[...]]},
+                              "outputs": ["pred"]}
+    GET  /health            {"status": "ok", "model": "..."}
+    """
+
+    def __init__(self, model, port: int = 0,
+                 default_outputs: Optional[Sequence[str]] = None):
+        self.model = model
+        self.default_outputs = list(default_outputs or [])
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self._json({"status": "ok",
+                                "model": type(server.model).__name__})
+                else:
+                    self._json({"error": "not found"}, 404)
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._json({"error": "not found"}, 404)
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n))
+                    out = server._predict(req)
+                    self._json(out)
+                except Exception as e:  # noqa: BLE001 — surface to client
+                    self._json({"error": str(e)}, 400)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def _predict(self, req: dict) -> dict:
+        inputs = req["inputs"]
+        from ..autodiff.samediff import SameDiff
+        if isinstance(self.model, SameDiff):
+            feed = {k: np.asarray(v, np.float32)
+                    for k, v in inputs.items()}
+            outs = req.get("outputs") or self.default_outputs
+            if not outs:
+                raise ValueError("SameDiff serving needs 'outputs'")
+            res = self.model.output(feed, outs)
+            return {"outputs": {k: np.asarray(v).tolist()
+                                for k, v in res.items()}}
+        x = np.asarray(inputs, np.float32)
+        y = np.asarray(self.model.output(x))
+        return {"outputs": y.tolist()}
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()

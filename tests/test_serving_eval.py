@@ -1,0 +1,139 @@
+"""Serving/export + eval-breadth tests (SURVEY.md L7 server, J9)."""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.eval import (EvaluationCalibration, ROCBinary)
+from deeplearning4j_tpu.serving import InferenceServer, export_stablehlo
+
+
+def _mlp(np_rng):
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=3, loss="mcxent",
+                               activation="softmax"))
+            .input_type_feed_forward(4).build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestEvalBreadth:
+    def test_roc_binary_multi_output(self, np_rng):
+        roc = ROCBinary()
+        labels = np_rng.randint(0, 2, (200, 3)).astype(np.float32)
+        # output 0 is informative, output 2 is noise
+        preds = np.stack([
+            np.clip(labels[:, 0] * 0.6 + np_rng.rand(200) * 0.4, 0, 1),
+            np.clip(labels[:, 1] * 0.3 + np_rng.rand(200) * 0.7, 0, 1),
+            np_rng.rand(200)], axis=1)
+        roc.eval(labels, preds)
+        assert roc.num_outputs() == 3
+        assert roc.auc(0) > 0.8
+        assert roc.auc(0) > roc.auc(2)
+        assert 0.3 < roc.auc(2) < 0.7
+        assert 0.0 <= roc.auprc(0) <= 1.0
+
+    def test_calibration_perfect_vs_off(self, np_rng):
+        # perfectly calibrated: P(label=1 | p) == p
+        cal = EvaluationCalibration(num_bins=10)
+        p = np_rng.rand(5000)
+        labels = (np_rng.rand(5000) < p).astype(np.float32)
+        cal.eval(labels, p)
+        assert cal.expected_calibration_error() < 0.05
+        # badly calibrated: always predicts 0.9 with 50% accuracy
+        cal2 = EvaluationCalibration(num_bins=10)
+        cal2.eval((np_rng.rand(1000) < 0.5).astype(np.float32),
+                  np.full(1000, 0.9))
+        assert cal2.expected_calibration_error() > 0.3
+        mean_p, acc, counts = cal2.reliability_curve()
+        assert counts.sum() == 1000
+
+    def test_calibration_multiclass(self, np_rng):
+        cal = EvaluationCalibration()
+        labels = np.eye(3)[np_rng.randint(0, 3, 100)]
+        preds = np_rng.dirichlet([1, 1, 1], 100)
+        cal.eval(labels, preds)
+        assert np.isfinite(cal.expected_calibration_error())
+
+
+class TestInferenceServer:
+    def test_network_predict_endpoint(self, np_rng):
+        net = _mlp(np_rng)
+        server = InferenceServer(net, port=0)
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            health = json.loads(urllib.request.urlopen(
+                base + "/health", timeout=5).read())
+            assert health["status"] == "ok"
+            x = np_rng.randn(3, 4).astype(np.float32)
+            req = urllib.request.Request(
+                base + "/predict",
+                data=json.dumps({"inputs": x.tolist()}).encode(),
+                headers={"Content-Type": "application/json"})
+            out = json.loads(urllib.request.urlopen(req,
+                                                    timeout=10).read())
+            got = np.asarray(out["outputs"])
+            want = np.asarray(net.output(x))
+            np.testing.assert_allclose(got, want, rtol=1e-5)
+        finally:
+            server.stop()
+
+    def test_samediff_predict_endpoint(self, np_rng):
+        from deeplearning4j_tpu.autodiff import SameDiff
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (None, 2))
+        w = sd.var("w", value=np.eye(2, dtype=np.float32))
+        (x @ w).rename("out")
+        server = InferenceServer(sd, port=0)
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/predict",
+                data=json.dumps({"inputs": {"x": [[1.0, 2.0]]},
+                                 "outputs": ["out"]}).encode(),
+                headers={"Content-Type": "application/json"})
+            out = json.loads(urllib.request.urlopen(req,
+                                                    timeout=10).read())
+            np.testing.assert_allclose(out["outputs"]["out"],
+                                       [[1.0, 2.0]])
+        finally:
+            server.stop()
+
+    def test_bad_request_is_400(self, np_rng):
+        server = InferenceServer(_mlp(np_rng), port=0)
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/predict",
+                data=b"{}", headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=5)
+            assert e.value.code == 400
+        finally:
+            server.stop()
+
+
+class TestStableHLOExport:
+    def test_export_function(self):
+        import jax.numpy as jnp
+        text = export_stablehlo(lambda x: jnp.tanh(x) @ x,
+                                example_args=(np.ones((3, 3),
+                                                      np.float32),))
+        assert "stablehlo" in text or "mhlo" in text or "func.func" in text
+        assert "tanh" in text
+
+    def test_export_samediff(self, np_rng):
+        from deeplearning4j_tpu.autodiff import SameDiff
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (2, 3))
+        w = sd.var("w", value=np_rng.randn(3, 2).astype(np.float32))
+        (x @ w).softmax(axis=-1).rename("pred")
+        text = export_stablehlo(sd, outputs=["pred"],
+                                placeholders={
+                                    "x": np.zeros((2, 3), np.float32)})
+        assert "func.func" in text
+        assert "dot_general" in text or "dot " in text
