@@ -1,0 +1,11 @@
+"""Pallas TPU kernels — the hand-written-kernel tier.
+
+Ref role: libnd4j's hand-tuned CPU/CUDA kernels (N2/N4). On TPU, XLA
+fusion covers almost everything (SURVEY.md §2.1 mapping note); Pallas is
+reserved for ops where explicit VMEM scheduling beats the fusion
+autoscheduler — attention being the canonical case (per
+/opt/skills/guides/pallas_guide.md).
+"""
+from .flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

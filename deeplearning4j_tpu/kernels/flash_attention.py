@@ -1,0 +1,169 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The hot-op case for a hand-written kernel: plain attention materializes
+the [Tq, Tk] score matrix in HBM; this kernel streams K/V blocks through
+VMEM with online-softmax (LSE) accumulation, so scores never leave
+on-chip memory — O(T) HBM traffic instead of O(T^2) (Dao 2022; the
+construction PAPERS.md's ring-attention work builds on).
+
+Grid: one program per (batch*heads, q-block). Each program holds its
+q-block plus running (m, l, acc) in VMEM scratch and loops over k-blocks
+with `pl.ds` slices. Matmuls hit the MXU via jnp.dot with
+preferred_element_type=f32 (guide: pitfalls #5); masks use
+broadcasted_iota (#4); tiles are 128-aligned (#2).
+
+Backward: recompute-based custom_vjp — the residuals are just (q, k, v,
+out-LSE); gradients are computed with the standard closed-form
+block recomputation in plain jnp (XLA fuses it well); the forward is
+where the memory win lives.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool, blk_q: int,
+            blk_k: int, t_real: int, scale: float):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)                      # [blk_q, D]
+    T_pad = k_ref.shape[1]
+    num_kb = T_pad // blk_k
+
+    q_pos = qi * blk_q + jax.lax.broadcasted_iota(
+        jnp.int32, (blk_q, blk_k), 0)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T,
+                    preferred_element_type=jnp.float32) * scale
+        k_pos = kb * blk_k + jax.lax.broadcasted_iota(
+            jnp.int32, (blk_q, blk_k), 1)
+        mask = k_pos < t_real
+        if causal:
+            mask = mask & (k_pos <= q_pos)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=1)
+        acc_new = acc * corr[:, None] + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((blk_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((blk_q,), jnp.float32)
+    acc0 = jnp.zeros((blk_q, q.shape[-1]), jnp.float32)
+    upper = num_kb if not causal else jnp.minimum(
+        num_kb, (qi + 1) * blk_q // blk_k + 1)
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd_impl(q, k, v, causal: bool, blk_q: int, blk_k: int,
+                    interpret: bool):
+    """q/k/v: [B, H, T, D] -> out [B, H, T, D]."""
+    B, H, T, D = q.shape
+    t_pad = _cdiv(T, max(blk_q, blk_k)) * max(blk_q, blk_k)
+    # flatten heads; pad T
+    qf = q.reshape(B * H, T, D)
+    kf = k.reshape(B * H, T, D)
+    vf = v.reshape(B * H, T, D)
+    if t_pad != T:
+        padw = ((0, 0), (0, t_pad - T), (0, 0))
+        qf = jnp.pad(qf, padw)
+        kf = jnp.pad(kf, padw)
+        vf = jnp.pad(vf, padw)
+    grid = (B * H, t_pad // blk_q)
+    kernel = functools.partial(
+        _kernel, causal=causal, blk_q=blk_q, blk_k=blk_k, t_real=T,
+        scale=1.0 / (D ** 0.5))
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, blk_q, D), lambda bh, qi: (bh, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, t_pad, D), lambda bh, qi: (bh, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, t_pad, D), lambda bh, qi: (bh, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, blk_q, D), lambda bh, qi: (bh, qi, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B * H, t_pad, D), q.dtype),
+        scratch_shapes=[],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out[:, :T, :].reshape(B, H, T, D)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, blk_q, blk_k, interpret):
+    return _flash_fwd_impl(q, k, v, causal, blk_q, blk_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, blk_q, blk_k, interpret):
+    out = _flash_fwd_impl(q, k, v, causal, blk_q, blk_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, blk_q, blk_k, interpret, res, g):
+    """Recompute-based backward in plain jnp (fused fine by XLA)."""
+    q, k, v = res
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        T = q.shape[2]
+        cm = jnp.arange(T)[None, :] <= jnp.arange(T)[:, None]
+        s = jnp.where(cm[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    g32 = g.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, g32)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", g32, v.astype(jnp.float32))
+    ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds,
+                    k.astype(jnp.float32)) * scale
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds,
+                    q.astype(jnp.float32)) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Fused attention. q/k/v: [B, T, H, D] (framework layout).
+
+    On TPU this runs the Pallas kernel; elsewhere (or with
+    interpret=True) the same kernel runs in the Pallas interpreter, so
+    one code path is tested everywhere (the reference's
+    one-suite-many-backends strategy).
+    """
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    # [B, T, H, D] -> [B, H, T, D]
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    T = qh.shape[2]
+    blk_q = min(block_q, max(8, T))
+    blk_k = min(block_k, max(8, T))
+    out = _flash(qh, kh, vh, causal, blk_q, blk_k, interpret)
+    return jnp.swapaxes(out, 1, 2)
