@@ -682,3 +682,5 @@ for _cls in (LSTM, GravesLSTM, SimpleRnn, Bidirectional,
     register(_cls)
 
 from . import convolutional  # noqa: E402,F401  (registers conv-family layers)
+from .attention import (SelfAttentionLayer,  # noqa: E402,F401
+                        TransformerEncoderLayer)

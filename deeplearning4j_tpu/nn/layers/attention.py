@@ -1,0 +1,213 @@
+"""Attention layers for the layer-DSL API.
+
+The reference snapshot has NO attention op or layer (SURVEY.md §5.7 —
+sequence capability = RNN family + TBPTT + masks; BERT only runs as an
+imported TF graph of primitives). Long context is first-class here, so
+the layer DSL exposes attention directly:
+
+- :class:`SelfAttentionLayer`: multi-head self-attention over [B, T, C]
+  sequence activations, masking-aware, with selectable compute path —
+  plain fused XLA attention, the Pallas flash kernel
+  (`kernels.flash_attention`), or chunked `blockwise_attention` for
+  long sequences on one chip.
+- :class:`TransformerEncoderLayer`: pre-LN block (attention + MLP with
+  residuals) — the building block the reference reaches only via Keras/
+  TF import.
+
+Sequence parallelism (ring attention over a mesh axis) lives in
+`parallel.longseq` / `parallel.transformer`; these layers are the
+single-chip / data-parallel form of the same capability.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...weightinit import init_weights
+from . import Layer, register
+
+
+@register
+class SelfAttentionLayer(Layer):
+    """Multi-head self-attention over recurrent-format [B, T, C] input."""
+
+    kind = "selfattention"
+    is_rnn = True
+
+    def __init__(self, n_heads: int = 4, n_out: Optional[int] = None,
+                 causal: bool = False, implementation: str = "auto",
+                 **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        self.n_heads = int(n_heads)
+        self.n_out = n_out
+        self.causal = bool(causal)
+        if implementation not in ("auto", "plain", "flash", "blockwise"):
+            raise ValueError(f"unknown implementation {implementation!r}")
+        self.implementation = implementation
+        self.n_in: Optional[int] = None
+
+    def build(self, input_shape, defaults=None):
+        super().build(input_shape, defaults)
+        self.n_in = int(input_shape[-1])
+        if self.n_out is None:
+            self.n_out = self.n_in
+        if self.n_out % self.n_heads:
+            raise ValueError(f"n_out={self.n_out} must divide "
+                             f"n_heads={self.n_heads}")
+
+    def param_shapes(self):
+        d, o = self.n_in, self.n_out
+        return {"Wq": (d, o), "Wk": (d, o), "Wv": (d, o), "Wo": (o, o),
+                "b": (o,)}
+
+    def init_params(self, rng, dtype=jnp.float32):
+        ks = jax.random.split(rng, 4)
+        d, o = self.n_in, self.n_out
+        p = {n: init_weights(k, (din, o), din, o, self.weight_init, dtype)
+             for (n, din), k in zip(
+                 [("Wq", d), ("Wk", d), ("Wv", d), ("Wo", o)], ks)}
+        p["b"] = jnp.zeros((o,), dtype)
+        return p
+
+    def _attend(self, q, k, v, mask):
+        from ...parallel.longseq import (blockwise_attention,
+                                         dot_product_attention)
+        impl = self.implementation
+        if impl == "auto":
+            impl = "blockwise" if q.shape[1] > 2048 else "plain"
+        if impl == "flash":
+            from ...kernels import flash_attention
+            if mask is not None:
+                # mask out padded keys by zeroing their value rows is
+                # wrong for softmax; fall back to plain masked attention
+                return dot_product_attention(
+                    q, k, v, mask=mask[:, None, None, :] > 0,
+                    causal=self.causal)
+            return flash_attention(q, k, v, causal=self.causal)
+        if impl == "blockwise" and mask is None:
+            return blockwise_attention(q, k, v, causal=self.causal)
+        return dot_product_attention(
+            q, k, v,
+            mask=None if mask is None else mask[:, None, None, :] > 0,
+            causal=self.causal)
+
+    def apply_seq(self, params, x, state, train, rng, carry, mask):
+        B, T, _ = x.shape
+        H = self.n_heads
+        Dh = self.n_out // H
+        x = self._maybe_dropout(x, train, rng)
+        q = (x @ params["Wq"]).reshape(B, T, H, Dh)
+        k = (x @ params["Wk"]).reshape(B, T, H, Dh)
+        v = (x @ params["Wv"]).reshape(B, T, H, Dh)
+        att = self._attend(q, k, v, mask)
+        out = att.reshape(B, T, self.n_out) @ params["Wo"] + params["b"]
+        if mask is not None:
+            out = out * mask[..., None]
+        return self.activation(out), state, carry
+
+    def apply(self, params, x, state, train, rng):
+        out, st, _ = self.apply_seq(params, x, state, train, rng, None,
+                                    None)
+        return out, st
+
+    def init_carry(self, batch, dtype=jnp.float32):
+        return ()
+
+    def output_shape(self, input_shape):
+        return (input_shape[0], self.n_out)
+
+    def _extra_json(self):
+        return {"n_heads": self.n_heads, "n_out": self.n_out,
+                "causal": self.causal,
+                "implementation": self.implementation}
+
+
+@register
+class TransformerEncoderLayer(Layer):
+    """Pre-LN transformer block: x + MHA(LN(x)); x + MLP(LN(x))."""
+
+    kind = "transformerencoder"
+    is_rnn = True
+
+    def __init__(self, n_heads: int = 4, d_ff: Optional[int] = None,
+                 causal: bool = False, implementation: str = "auto",
+                 **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        self.n_heads = int(n_heads)
+        self.d_ff = d_ff
+        self.causal = causal
+        self.implementation = implementation
+        self.attn: Optional[SelfAttentionLayer] = None
+        self.d_model: Optional[int] = None
+
+    def build(self, input_shape, defaults=None):
+        super().build(input_shape, defaults)
+        self.d_model = int(input_shape[-1])
+        if self.d_ff is None:
+            self.d_ff = 4 * self.d_model
+        self.attn = SelfAttentionLayer(
+            n_heads=self.n_heads, causal=self.causal,
+            implementation=self.implementation)
+        self.attn.build(input_shape, defaults)
+
+    def param_shapes(self):
+        d, f = self.d_model, self.d_ff
+        sh = {f"attn_{k}": v for k, v in self.attn.param_shapes().items()}
+        sh.update({"ln1_g": (d,), "ln1_b": (d,), "ln2_g": (d,),
+                   "ln2_b": (d,), "W1": (d, f), "b1": (f,),
+                   "W2": (f, d), "b2": (d,)})
+        return sh
+
+    def init_params(self, rng, dtype=jnp.float32):
+        k1, k2, k3 = jax.random.split(rng, 3)
+        d, f = self.d_model, self.d_ff
+        p = {f"attn_{k}": v
+             for k, v in self.attn.init_params(k1, dtype).items()}
+        p.update({
+            "ln1_g": jnp.ones((d,), dtype), "ln1_b": jnp.zeros((d,), dtype),
+            "ln2_g": jnp.ones((d,), dtype), "ln2_b": jnp.zeros((d,), dtype),
+            "W1": init_weights(k2, (d, f), d, f, self.weight_init, dtype),
+            "b1": jnp.zeros((f,), dtype),
+            "W2": init_weights(k3, (f, d), f, d, self.weight_init, dtype),
+            "b2": jnp.zeros((d,), dtype)})
+        return p
+
+    @staticmethod
+    def _ln(x, g, b, eps=1e-5):
+        m = x.mean(-1, keepdims=True)
+        v = ((x - m) ** 2).mean(-1, keepdims=True)
+        return (x - m) / jnp.sqrt(v + eps) * g + b
+
+    def apply_seq(self, params, x, state, train, rng, carry, mask):
+        ap = {k[len("attn_"):]: v for k, v in params.items()
+              if k.startswith("attn_")}
+        h = self._ln(x, params["ln1_g"], params["ln1_b"])
+        att, _, _ = self.attn.apply_seq(ap, h, None, train, rng, (), mask)
+        x = x + att
+        h = self._ln(x, params["ln2_g"], params["ln2_b"])
+        h = jax.nn.gelu(h @ params["W1"] + params["b1"])
+        x = x + (h @ params["W2"] + params["b2"])
+        if mask is not None:
+            x = x * mask[..., None]
+        return x, state, carry
+
+    def apply(self, params, x, state, train, rng):
+        out, st, _ = self.apply_seq(params, x, state, train, rng, None,
+                                    None)
+        return out, st
+
+    def init_carry(self, batch, dtype=jnp.float32):
+        return ()
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape)
+
+    def _extra_json(self):
+        return {"n_heads": self.n_heads, "d_ff": self.d_ff,
+                "causal": self.causal,
+                "implementation": self.implementation}
