@@ -1,0 +1,12 @@
+"""Shared stateless NN math used by both the layer DSL and the
+distributed transformer — one definition so numerics cannot diverge."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def layer_norm(x, gain, bias, eps: float = 1e-5):
+    """LayerNorm over the last axis: (x - mean)/sqrt(var + eps)*g + b."""
+    m = x.mean(-1, keepdims=True)
+    v = ((x - m) ** 2).mean(-1, keepdims=True)
+    return (x - m) / jnp.sqrt(v + eps) * gain + bias

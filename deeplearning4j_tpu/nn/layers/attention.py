@@ -56,8 +56,8 @@ class SelfAttentionLayer(Layer):
         if self.n_out is None:
             self.n_out = self.n_in
         if self.n_out % self.n_heads:
-            raise ValueError(f"n_out={self.n_out} must divide "
-                             f"n_heads={self.n_heads}")
+            raise ValueError(f"n_heads={self.n_heads} must divide "
+                             f"n_out={self.n_out}")
 
     def param_shapes(self):
         d, o = self.n_in, self.n_out
@@ -81,15 +81,14 @@ class SelfAttentionLayer(Layer):
             impl = "blockwise" if q.shape[1] > 2048 else "plain"
         if impl == "flash":
             from ...kernels import flash_attention
-            if mask is not None:
-                # mask out padded keys by zeroing their value rows is
-                # wrong for softmax; fall back to plain masked attention
-                return dot_product_attention(
-                    q, k, v, mask=mask[:, None, None, :] > 0,
-                    causal=self.causal)
-            return flash_attention(q, k, v, causal=self.causal)
-        if impl == "blockwise" and mask is None:
-            return blockwise_attention(q, k, v, causal=self.causal)
+            if mask is None:
+                return flash_attention(q, k, v, causal=self.causal)
+            # flash kernel has no key-padding input; blockwise keeps the
+            # O(T) memory property for masked long sequences
+            impl = "blockwise"
+        if impl == "blockwise":
+            return blockwise_attention(q, k, v, causal=self.causal,
+                                       key_mask=mask)
         return dot_product_attention(
             q, k, v,
             mask=None if mask is None else mask[:, None, None, :] > 0,
@@ -150,9 +149,12 @@ class TransformerEncoderLayer(Layer):
         self.d_model = int(input_shape[-1])
         if self.d_ff is None:
             self.d_ff = 4 * self.d_model
+        # forward this layer's regularization/init settings to the inner
+        # attention so the block behaves as one unit
         self.attn = SelfAttentionLayer(
             n_heads=self.n_heads, causal=self.causal,
-            implementation=self.implementation)
+            implementation=self.implementation, dropout=self.dropout,
+            weight_init=self.weight_init)
         self.attn.build(input_shape, defaults)
 
     def param_shapes(self):
@@ -177,20 +179,16 @@ class TransformerEncoderLayer(Layer):
             "b2": jnp.zeros((d,), dtype)})
         return p
 
-    @staticmethod
-    def _ln(x, g, b, eps=1e-5):
-        m = x.mean(-1, keepdims=True)
-        v = ((x - m) ** 2).mean(-1, keepdims=True)
-        return (x - m) / jnp.sqrt(v + eps) * g + b
-
     def apply_seq(self, params, x, state, train, rng, carry, mask):
+        from ..functional import layer_norm as _ln
         ap = {k[len("attn_"):]: v for k, v in params.items()
               if k.startswith("attn_")}
-        h = self._ln(x, params["ln1_g"], params["ln1_b"])
+        h = _ln(x, params["ln1_g"], params["ln1_b"])
         att, _, _ = self.attn.apply_seq(ap, h, None, train, rng, (), mask)
         x = x + att
-        h = self._ln(x, params["ln2_g"], params["ln2_b"])
+        h = _ln(x, params["ln2_g"], params["ln2_b"])
         h = jax.nn.gelu(h @ params["W1"] + params["b1"])
+        h = self._maybe_dropout(h, train, rng)
         x = x + (h @ params["W2"] + params["b2"])
         if mask is not None:
             x = x * mask[..., None]

@@ -59,14 +59,14 @@ class FaultTolerantTrainer:
 
     # -- training ------------------------------------------------------
     def fit(self, iterator, epochs: int):
-        """Train `epochs` ADDITIONAL epochs from the model's current
-        epoch counter, checkpointing every `save_every` epochs. After a
-        preemption, `resume()` + `fit()` with the same total continues
-        where the last checkpoint left off."""
+        """Train up to a TOTAL of `epochs` epochs (counting the model's
+        current epoch counter), checkpointing every `save_every` epochs.
+        After a preemption, `resume()` + `fit()` with the same total
+        continues where the last checkpoint left off; if the target was
+        already reached, this is a no-op."""
         start = self.model._epoch
         for e in range(start, epochs):
-            self.model.fit(iterator, epochs=1)
-            self.model._epoch = e + 1
+            self.model.fit(iterator, epochs=1)  # fit() advances _epoch
             if (e + 1) % self.save_every == 0 or e + 1 == epochs:
                 self._save(e + 1)
         return self.model
@@ -79,7 +79,8 @@ class FaultTolerantTrainer:
         if not ckpts:
             raise FileNotFoundError(
                 f"no checkpoints in {checkpoint_dir}")
-        return ModelSerializer.restore_multi_layer_network(ckpts[-1])
+        # dispatches on the saved model_type (MLN vs ComputationGraph)
+        return ModelSerializer.restore(ckpts[-1])
 
 
 def initialize_cluster(coordinator_address: Optional[str] = None,
@@ -89,11 +90,18 @@ def initialize_cluster(coordinator_address: Optional[str] = None,
     Spark plays for the reference; on TPU pods this is the PJRT
     distributed runtime + coordination service). Thin wrapper over
     `jax.distributed.initialize` so framework users have one entry
-    point; on single-host it is a no-op."""
+    point.
+
+    With no arguments, auto-detection is attempted (the TPU-pod
+    environment provides coordinates); `num_processes=1` is an explicit
+    single-process no-op. Returns True if the distributed runtime was
+    initialized."""
     import jax
-    if num_processes is None or num_processes <= 1:
+    if num_processes == 1:
         return False
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    kwargs = {k: v for k, v in
+              [("coordinator_address", coordinator_address),
+               ("num_processes", num_processes),
+               ("process_id", process_id)] if v is not None}
+    jax.distributed.initialize(**kwargs)
     return True

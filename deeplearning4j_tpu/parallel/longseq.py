@@ -54,15 +54,16 @@ def match_vma(x, *refs):
 def _attn_block(q, k, v, bias, m_prev, l_prev, o_prev):
     """One (q-block, kv-block) update of stable softmax accumulation.
 
-    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; bias: [Tq, Tk] additive or None.
-    Carries m (running max) [B, H, Tq], l (running denom) [B, H, Tq],
-    o (running numerator) [B, Tq, H, D]. Everything f32.
+    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; bias: additive, broadcastable
+    to [B, H, Tq, Tk], or None. Carries m (running max) [B, H, Tq],
+    l (running denom) [B, H, Tq], o (running numerator) [B, Tq, H, D].
+    Everything f32.
     """
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     if bias is not None:
-        s = s + bias[None, None, :, :]
+        s = s + bias
     m_new = jnp.maximum(m_prev, s.max(axis=-1))
     p = jnp.exp(s - m_new[..., None])
     corr = jnp.exp(m_prev - m_new)
@@ -73,7 +74,9 @@ def _attn_block(q, k, v, bias, m_prev, l_prev, o_prev):
 
 
 def _finalize(m, l, o):
-    return o / jnp.transpose(l, (0, 2, 1))[..., None]
+    # guard: fully-masked query rows have l == 0 (their output is zeroed
+    # by the caller's mask; dividing by 0 would poison it with NaN first)
+    return o / jnp.maximum(jnp.transpose(l, (0, 2, 1)), 1e-30)[..., None]
 
 
 def _init_carry(q):
@@ -84,12 +87,14 @@ def _init_carry(q):
 
 
 def blockwise_attention(q, k, v, block_size: int = 512,
-                        causal: bool = False):
+                        causal: bool = False, key_mask=None):
     """Memory-efficient chunked attention on one device.
 
     q/k/v: [B, T, H, D]. K/V are processed in `block_size` chunks under a
     `lax.scan`, so peak memory is O(T * block) instead of O(T^2). Exact
-    (not an approximation) thanks to LSE rescaling."""
+    (not an approximation) thanks to LSE rescaling. `key_mask` [B, T]
+    (1 = real, 0 = padded key) folds into the per-block bias, keeping the
+    O(T)-memory property for padded batches."""
     B, T, H, D = q.shape
     nb = -(-T // block_size)
     pad = nb * block_size - T
@@ -100,21 +105,36 @@ def blockwise_attention(q, k, v, block_size: int = 512,
         kp, vp = k, v
     kb = kp.reshape(B, nb, block_size, H, D).transpose(1, 0, 2, 3, 4)
     vb = vp.reshape(B, nb, block_size, H, D).transpose(1, 0, 2, 3, 4)
+    if key_mask is not None:
+        kmp = jnp.pad(key_mask.astype(jnp.float32), ((0, 0), (0, pad)))
+        kmb = kmp.reshape(B, nb, block_size).transpose(1, 0, 2)
     q_pos = jnp.arange(T)
 
     def step(carry, inp):
-        j, kj, vj = inp
+        if key_mask is not None:
+            j, kj, vj, kmj = inp
+        else:
+            j, kj, vj = inp
         k_pos = j * block_size + jnp.arange(block_size)
         bias = jnp.where(k_pos[None, :] >= T, _NEG_INF, 0.0)
         if causal:
             bias = bias + jnp.where(k_pos[None, :] > q_pos[:, None],
                                     _NEG_INF, 0.0)
+        bias = bias[None, None, :, :]       # [1, 1, Tq, blk]
+        if key_mask is not None:
+            bias = bias + jnp.where(kmj > 0, 0.0,
+                                    _NEG_INF)[:, None, None, :]
         m, l, o = _attn_block(q, kj, vj, bias, *carry)
         return (m, l, o), None
 
-    carry, _ = lax.scan(step, _init_carry(q),
-                        (jnp.arange(nb), kb, vb))
-    return _finalize(*carry).astype(q.dtype)
+    xs = (jnp.arange(nb), kb, vb) if key_mask is None else \
+        (jnp.arange(nb), kb, vb, kmb)
+    carry, _ = lax.scan(step, _init_carry(q), xs)
+    out = _finalize(*carry).astype(q.dtype)
+    if key_mask is not None:
+        # fully-masked queries (padded rows) produce 0/0 -> zero them
+        out = out * key_mask.astype(out.dtype)[:, :, None, None]
+    return out
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False):
@@ -139,7 +159,8 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
         src = (my - j) % S
         if causal:
             k_pos = src * Tl + jnp.arange(Tl)
-            bias = jnp.where(k_pos[None, :] > q_pos[:, None], _NEG_INF, 0.0)
+            bias = jnp.where(k_pos[None, :] > q_pos[:, None], _NEG_INF,
+                             0.0)[None, None, :, :]
         else:
             bias = None
         m, l, o = _attn_block(q, kj, vj, bias, m, l, o)

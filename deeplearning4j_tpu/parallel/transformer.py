@@ -48,10 +48,7 @@ def make_4d_mesh(n_devices: Optional[int] = None, dp: int = 1, sp: int = 1,
     return Mesh(arr, AXES)
 
 
-def _ln(x, g, b, eps=1e-5):
-    m = x.mean(-1, keepdims=True)
-    v = ((x - m) ** 2).mean(-1, keepdims=True)
-    return (x - m) / jnp.sqrt(v + eps) * g + b
+from ..nn.functional import layer_norm as _ln
 
 
 class DistributedTransformer:

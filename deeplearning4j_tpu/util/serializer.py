@@ -71,6 +71,43 @@ class ModelSerializer:
             z.writestr("meta.json", json.dumps(meta))
 
     @staticmethod
+    def restore(path: str, load_updater: bool = True):
+        """Dispatch on the model_type recorded at save time (ref:
+        ModelSerializer.restoreMultiLayerNetwork vs
+        restoreComputationGraph overloads)."""
+        with zipfile.ZipFile(path) as z:
+            meta = json.loads(z.read("meta.json").decode())
+        if meta.get("model_type") == "ComputationGraph":
+            return ModelSerializer.restore_computation_graph(
+                path, load_updater)
+        return ModelSerializer.restore_multi_layer_network(
+            path, load_updater)
+
+    @staticmethod
+    def restore_computation_graph(path: str, load_updater: bool = True):
+        from ..nn.graph import (ComputationGraph,
+                                ComputationGraphConfiguration)
+        with zipfile.ZipFile(path) as z:
+            conf = ComputationGraphConfiguration.from_json(
+                z.read("configuration.json").decode())
+            model = ComputationGraph(conf).init()
+            params_flat = dict(np.load(io.BytesIO(z.read("params.npz"))))
+            model._params = _unflatten_like(model._params, params_flat)
+            names = z.namelist()
+            if "state.npz" in names and model._net_state:
+                model._net_state = _unflatten_like(
+                    model._net_state,
+                    dict(np.load(io.BytesIO(z.read("state.npz")))))
+            if load_updater and "updater.npz" in names:
+                model._opt_state = _unflatten_like(
+                    model._opt_state,
+                    dict(np.load(io.BytesIO(z.read("updater.npz")))))
+            meta = json.loads(z.read("meta.json").decode())
+            model._step = meta.get("step", 0)
+            model._epoch = meta.get("epoch", 0)
+        return model
+
+    @staticmethod
     def restore_multi_layer_network(path: str, load_updater: bool = True):
         from ..nn.conf import MultiLayerConfiguration
         from ..nn.multilayer import MultiLayerNetwork

@@ -137,3 +137,64 @@ class TestFaultTolerance:
     def test_resume_empty_dir_raises(self, tmp_path):
         with pytest.raises(FileNotFoundError):
             FaultTolerantTrainer.resume(str(tmp_path))
+
+    def test_computation_graph_checkpoint_resume(self, np_rng, tmp_path):
+        # resume() must dispatch on the saved model type
+        from deeplearning4j_tpu.nn import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf import InputType
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        g = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+             .graph_builder().add_inputs("in"))
+        g.add_layer("d", DenseLayer(n_out=8, activation="relu"), "in")
+        g.add_layer("out", OutputLayer(n_out=2, loss="mcxent",
+                                       activation="softmax"), "d")
+        g.set_outputs("out")
+        g.set_input_types(InputType.feed_forward(4))
+        net = ComputationGraph(g.build()).init()
+        X = np_rng.randn(32, 4).astype(np.float32)
+        Y = np.eye(2, dtype=np.float32)[np_rng.randint(0, 2, 32)]
+        ckdir = str(tmp_path / "g")
+        FaultTolerantTrainer(net, ckdir).fit(
+            ArrayDataSetIterator(X, Y, batch=16), epochs=2)
+        resumed = FaultTolerantTrainer.resume(ckdir)
+        assert isinstance(resumed, ComputationGraph)
+        np.testing.assert_allclose(np.asarray(resumed.output(X[:4])),
+                                   np.asarray(net.output(X[:4])),
+                                   rtol=1e-5)
+
+    def test_fit_total_epoch_semantics_noop_when_reached(self, np_rng,
+                                                         tmp_path):
+        X, Y = _seq_task(np_rng, n=32)
+        net = _transformer_net(seed=5).init()
+        tr = FaultTolerantTrainer(net, str(tmp_path / "n"))
+        tr.fit(ArrayDataSetIterator(X, Y, batch=16), epochs=2)
+        step_after = net._step
+        tr.fit(ArrayDataSetIterator(X, Y, batch=16), epochs=2)  # no-op
+        assert net._step == step_after
+
+
+class TestMaskedBlockwise:
+    def test_blockwise_key_mask_matches_plain_masked(self, np_rng):
+        from deeplearning4j_tpu.parallel.longseq import (
+            blockwise_attention, dot_product_attention)
+        import jax.numpy as jnp
+        B, T, H, D = 2, 40, 2, 16
+        q, k, v = (jnp.asarray(np_rng.randn(B, T, H, D)
+                               .astype(np.float32) * 0.5)
+                   for _ in range(3))
+        km = np.ones((B, T), np.float32)
+        km[0, 30:] = 0
+        km[1, 25:] = 0
+        want = dot_product_attention(
+            q, k, v, mask=jnp.asarray(km)[:, None, None, :] > 0)
+        got = blockwise_attention(q, k, v, block_size=16,
+                                  key_mask=jnp.asarray(km))
+        # compare on unpadded query rows (padded rows are zeroed)
+        np.testing.assert_allclose(np.asarray(got)[0, :30],
+                                   np.asarray(want)[0, :30],
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(got)[1, :25],
+                                   np.asarray(want)[1, :25],
+                                   rtol=1e-4, atol=1e-5)
+        assert np.isfinite(np.asarray(got)).all()
