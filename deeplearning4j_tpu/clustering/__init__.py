@@ -292,3 +292,56 @@ class Tsne:
 
 
 BarnesHutTsne = Tsne  # capability alias (see module docstring)
+
+
+# ---------------------------------------------------------------------------
+# LSH (ref: nearestneighbor-core clustering/lsh/RandomProjectionLSH.java)
+# ---------------------------------------------------------------------------
+class RandomProjectionLSH:
+    """Random-hyperplane (signed random projection) LSH for approximate
+    cosine kNN: points hash to sign-pattern buckets; queries probe their
+    bucket (plus near buckets by Hamming distance) and rank candidates
+    exactly."""
+
+    def __init__(self, points: np.ndarray, hash_length: int = 12,
+                 num_tables: int = 4, seed: int = 0):
+        self.points = np.asarray(points, np.float32)
+        norms = np.linalg.norm(self.points, axis=1, keepdims=True)
+        self._unit = self.points / np.maximum(norms, 1e-12)
+        rng = np.random.RandomState(seed)
+        d = self.points.shape[1]
+        self.hash_length = hash_length
+        self.planes = [rng.randn(d, hash_length).astype(np.float32)
+                       for _ in range(num_tables)]
+        self.tables: List[Dict[int, List[int]]] = []
+        for P in self.planes:
+            table: Dict[int, List[int]] = {}
+            codes = self._codes(self.points, P)
+            for i, c in enumerate(codes):
+                table.setdefault(int(c), []).append(i)
+            self.tables.append(table)
+
+    @staticmethod
+    def _codes(x: np.ndarray, planes: np.ndarray) -> np.ndarray:
+        bits = (np.atleast_2d(x) @ planes) > 0
+        return (bits.astype(np.int64)
+                @ (1 << np.arange(planes.shape[1], dtype=np.int64)))
+
+    def knn(self, query: np.ndarray, k: int,
+            probe_hamming: int = 1) -> Tuple[List[int], List[float]]:
+        query = np.asarray(query, np.float32)
+        cand = set()
+        for P, table in zip(self.planes, self.tables):
+            code = int(self._codes(query, P)[0])
+            cand.update(table.get(code, ()))
+            if probe_hamming >= 1:
+                for b in range(self.hash_length):
+                    cand.update(table.get(code ^ (1 << b), ()))
+        if not cand:
+            cand = set(range(len(self.points)))  # degenerate: exact scan
+        idx = np.fromiter(cand, dtype=np.int64)
+        qn = query / max(np.linalg.norm(query), 1e-12)
+        sims = self._unit[idx] @ qn
+        order = np.argsort(-sims)[:k]
+        return [int(i) for i in idx[order]], \
+            [float(1.0 - s) for s in sims[order]]

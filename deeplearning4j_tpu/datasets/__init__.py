@@ -278,3 +278,121 @@ class MnistDataSetIterator(ArrayDataSetIterator):
         feats = feats.reshape(len(feats), -1) if flatten else feats[..., None]
         onehot = np.eye(10, dtype=np.float32)[labels]
         super().__init__(feats, onehot, batch=batch, shuffle=shuffle, seed=seed)
+
+
+# -- Iris (ref: deeplearning4j-datasets IrisDataSetIterator) ---------------
+# Fisher's iris measurements (public domain), embedded so the canonical
+# starter dataset works with zero egress. Values are (sl, sw, pl, pw, cls).
+_IRIS = np.array([
+    [5.1,3.5,1.4,0.2,0],[4.9,3.0,1.4,0.2,0],[4.7,3.2,1.3,0.2,0],
+    [4.6,3.1,1.5,0.2,0],[5.0,3.6,1.4,0.2,0],[5.4,3.9,1.7,0.4,0],
+    [4.6,3.4,1.4,0.3,0],[5.0,3.4,1.5,0.2,0],[4.4,2.9,1.4,0.2,0],
+    [4.9,3.1,1.5,0.1,0],[5.4,3.7,1.5,0.2,0],[4.8,3.4,1.6,0.2,0],
+    [4.8,3.0,1.4,0.1,0],[4.3,3.0,1.1,0.1,0],[5.8,4.0,1.2,0.2,0],
+    [5.7,4.4,1.5,0.4,0],[5.4,3.9,1.3,0.4,0],[5.1,3.5,1.4,0.3,0],
+    [5.7,3.8,1.7,0.3,0],[5.1,3.8,1.5,0.3,0],[5.4,3.4,1.7,0.2,0],
+    [5.1,3.7,1.5,0.4,0],[4.6,3.6,1.0,0.2,0],[5.1,3.3,1.7,0.5,0],
+    [4.8,3.4,1.9,0.2,0],[5.0,3.0,1.6,0.2,0],[5.0,3.4,1.6,0.4,0],
+    [5.2,3.5,1.5,0.2,0],[5.2,3.4,1.4,0.2,0],[4.7,3.2,1.6,0.2,0],
+    [4.8,3.1,1.6,0.2,0],[5.4,3.4,1.5,0.4,0],[5.2,4.1,1.5,0.1,0],
+    [5.5,4.2,1.4,0.2,0],[4.9,3.1,1.5,0.2,0],[5.0,3.2,1.2,0.2,0],
+    [5.5,3.5,1.3,0.2,0],[4.9,3.6,1.4,0.1,0],[4.4,3.0,1.3,0.2,0],
+    [5.1,3.4,1.5,0.2,0],[5.0,3.5,1.3,0.3,0],[4.5,2.3,1.3,0.3,0],
+    [4.4,3.2,1.3,0.2,0],[5.0,3.5,1.6,0.6,0],[5.1,3.8,1.9,0.4,0],
+    [4.8,3.0,1.4,0.3,0],[5.1,3.8,1.6,0.2,0],[4.6,3.2,1.4,0.2,0],
+    [5.3,3.7,1.5,0.2,0],[5.0,3.3,1.4,0.2,0],[7.0,3.2,4.7,1.4,1],
+    [6.4,3.2,4.5,1.5,1],[6.9,3.1,4.9,1.5,1],[5.5,2.3,4.0,1.3,1],
+    [6.5,2.8,4.6,1.5,1],[5.7,2.8,4.5,1.3,1],[6.3,3.3,4.7,1.6,1],
+    [4.9,2.4,3.3,1.0,1],[6.6,2.9,4.6,1.3,1],[5.2,2.7,3.9,1.4,1],
+    [5.0,2.0,3.5,1.0,1],[5.9,3.0,4.2,1.5,1],[6.0,2.2,4.0,1.0,1],
+    [6.1,2.9,4.7,1.4,1],[5.6,2.9,3.6,1.3,1],[6.7,3.1,4.4,1.4,1],
+    [5.6,3.0,4.5,1.5,1],[5.8,2.7,4.1,1.0,1],[6.2,2.2,4.5,1.5,1],
+    [5.6,2.5,3.9,1.1,1],[5.9,3.2,4.8,1.8,1],[6.1,2.8,4.0,1.3,1],
+    [6.3,2.5,4.9,1.5,1],[6.1,2.8,4.7,1.2,1],[6.4,2.9,4.3,1.3,1],
+    [6.6,3.0,4.4,1.4,1],[6.8,2.8,4.8,1.4,1],[6.7,3.0,5.0,1.7,1],
+    [6.0,2.9,4.5,1.5,1],[5.7,2.6,3.5,1.0,1],[5.5,2.4,3.8,1.1,1],
+    [5.5,2.4,3.7,1.0,1],[5.8,2.7,3.9,1.2,1],[6.0,2.7,5.1,1.6,1],
+    [5.4,3.0,4.5,1.5,1],[6.0,3.4,4.5,1.6,1],[6.7,3.1,4.7,1.5,1],
+    [6.3,2.3,4.4,1.3,1],[5.6,3.0,4.1,1.3,1],[5.5,2.5,4.0,1.3,1],
+    [5.5,2.6,4.4,1.2,1],[6.1,3.0,4.6,1.4,1],[5.8,2.6,4.0,1.2,1],
+    [5.0,2.3,3.3,1.0,1],[5.6,2.7,4.2,1.3,1],[5.7,3.0,4.2,1.2,1],
+    [5.7,2.9,4.2,1.3,1],[6.2,2.9,4.3,1.3,1],[5.1,2.5,3.0,1.1,1],
+    [5.7,2.8,4.1,1.3,1],[6.3,3.3,6.0,2.5,2],[5.8,2.7,5.1,1.9,2],
+    [7.1,3.0,5.9,2.1,2],[6.3,2.9,5.6,1.8,2],[6.5,3.0,5.8,2.2,2],
+    [7.6,3.0,6.6,2.1,2],[4.9,2.5,4.5,1.7,2],[7.3,2.9,6.3,1.8,2],
+    [6.7,2.5,5.8,1.8,2],[7.2,3.6,6.1,2.5,2],[6.5,3.2,5.1,2.0,2],
+    [6.4,2.7,5.3,1.9,2],[6.8,3.0,5.5,2.1,2],[5.7,2.5,5.0,2.0,2],
+    [5.8,2.8,5.1,2.4,2],[6.4,3.2,5.3,2.3,2],[6.5,3.0,5.5,1.8,2],
+    [7.7,3.8,6.7,2.2,2],[7.7,2.6,6.9,2.3,2],[6.0,2.2,5.0,1.5,2],
+    [6.9,3.2,5.7,2.3,2],[5.6,2.8,4.9,2.0,2],[7.7,2.8,6.7,2.0,2],
+    [6.3,2.7,4.9,1.8,2],[6.7,3.3,5.7,2.1,2],[7.2,3.2,6.0,1.8,2],
+    [6.2,2.8,4.8,1.8,2],[6.1,3.0,4.9,1.8,2],[6.4,2.8,5.6,2.1,2],
+    [7.2,3.0,5.8,1.6,2],[7.4,2.8,6.1,1.9,2],[7.9,3.8,6.4,2.0,2],
+    [6.4,2.8,5.6,2.2,2],[6.3,2.8,5.1,1.5,2],[6.1,2.6,5.6,1.4,2],
+    [7.7,3.0,6.1,2.3,2],[6.3,3.4,5.6,2.4,2],[6.4,3.1,5.5,1.8,2],
+    [6.0,3.0,4.8,1.8,2],[6.9,3.1,5.4,2.1,2],[6.7,3.1,5.6,2.4,2],
+    [6.9,3.1,5.1,2.3,2],[5.8,2.7,5.1,1.9,2],[6.8,3.2,5.9,2.3,2],
+    [6.7,3.3,5.7,2.5,2],[6.7,3.0,5.2,2.3,2],[6.3,2.5,5.0,1.9,2],
+    [6.5,3.0,5.2,2.0,2],[6.2,3.4,5.4,2.3,2],[5.9,3.0,5.1,1.8,2],
+], dtype=np.float32)
+
+
+class IrisDataSetIterator(ArrayDataSetIterator):
+    """Ref: `IrisDataSetIterator.java` — the canonical starter dataset,
+    embedded (150 samples, 4 features, 3 classes)."""
+
+    def __init__(self, batch: int = 150, shuffle: bool = False,
+                 seed: int = 6):
+        feats = _IRIS[:, :4]
+        onehot = np.eye(3, dtype=np.float32)[_IRIS[:, 4].astype(int)]
+        super().__init__(feats, onehot, batch=batch, shuffle=shuffle,
+                         seed=seed)
+
+
+def _find_cifar10() -> Optional[str]:
+    for d in (os.environ.get("CIFAR10_DATA_DIR", ""),
+              os.path.expanduser("~/.deeplearning4j_tpu/cifar10"),
+              "/data/cifar10", "/root/data/cifar10"):
+        if d and os.path.exists(os.path.join(d, "data_batch_1.bin")):
+            return d
+    return None
+
+
+class Cifar10DataSetIterator(ArrayDataSetIterator):
+    """Ref: `Cifar10DataSetIterator.java`. Reads the standard CIFAR-10
+    BINARY format (data_batch_*.bin / test_batch.bin: per record 1 label
+    byte + 3072 CHW pixel bytes) from a local directory; falls back to a
+    deterministic synthetic set when absent (no egress — the reference
+    downloads)."""
+
+    def __init__(self, batch: int, train: bool = True, shuffle: bool = True,
+                 seed: int = 6, num_examples: Optional[int] = None,
+                 data_dir: Optional[str] = None):
+        d = data_dir or _find_cifar10()
+        self.synthetic = d is None
+        if d is not None:
+            files = ([os.path.join(d, f"data_batch_{i}.bin")
+                      for i in range(1, 6)] if train
+                     else [os.path.join(d, "test_batch.bin")])
+            imgs, labels = [], []
+            for f in files:
+                raw = np.fromfile(f, np.uint8).reshape(-1, 3073)
+                labels.append(raw[:, 0])
+                # CHW bytes -> NHWC float
+                imgs.append(raw[:, 1:].reshape(-1, 3, 32, 32)
+                            .transpose(0, 2, 3, 1))
+            imgs = np.concatenate(imgs)
+            labels = np.concatenate(labels)
+        else:
+            n = num_examples or (4096 if train else 1024)
+            rng = np.random.RandomState(11 if train else 22)
+            labels = rng.randint(0, 10, n).astype(np.uint8)
+            base = rng.rand(10, 32, 32, 3).astype(np.float32)
+            imgs = ((base[labels] * 0.7 + rng.rand(n, 32, 32, 3) * 0.3)
+                    * 255).astype(np.uint8)
+        if num_examples:
+            imgs, labels = imgs[:num_examples], labels[:num_examples]
+        feats = imgs.astype(np.float32) / 255.0
+        onehot = np.eye(10, dtype=np.float32)[labels]
+        super().__init__(feats, onehot, batch=batch, shuffle=shuffle,
+                         seed=seed)

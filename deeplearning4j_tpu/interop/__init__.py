@@ -1,0 +1,53 @@
+"""Framework interop (ref: `nd4j/nd4j-tensorflow` — `GraphRunner.java`
+runs real TF graphs in-process via libtensorflow).
+
+`GraphRunner` executes a frozen TF GraphDef with the installed
+TensorFlow runtime — the escape hatch for graphs whose ops exceed the
+native importer's coverage (`modelimport.TFGraphMapper`), and the
+cross-check oracle the importer is tested against.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class GraphRunner:
+    """Ref: `tensorflow/conversion/graphrunner/GraphRunner.java` — load a
+    GraphDef once, run it many times. TF import is deferred so the
+    framework has no hard TF dependency."""
+
+    def __init__(self, source, input_names: Sequence[str],
+                 output_names: Sequence[str]):
+        import tensorflow as tf  # deferred heavy import
+        self._tf = tf
+        if isinstance(source, (bytes, bytearray)):
+            data = bytes(source)
+        else:
+            with open(source, "rb") as f:
+                data = f.read()
+        graph_def = tf.compat.v1.GraphDef()
+        graph_def.ParseFromString(data)
+        self.input_names = list(input_names)
+        self.output_names = list(output_names)
+        self._graph = tf.Graph()
+        with self._graph.as_default():
+            tf.import_graph_def(graph_def, name="")
+        self._sess = tf.compat.v1.Session(graph=self._graph)
+
+    def run(self, inputs: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        feed = {f"{k}:0": np.asarray(v) for k, v in inputs.items()}
+        fetches = [f"{n}:0" for n in self.output_names]
+        outs = self._sess.run(fetches, feed_dict=feed)
+        return dict(zip(self.output_names, outs))
+
+    def close(self):
+        self._sess.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,112 @@
+"""Interop (TF GraphRunner), LSH, and dataset-iterator breadth tests
+(SURVEY.md J14/D19/D8)."""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import RandomProjectionLSH
+from deeplearning4j_tpu.datasets import (Cifar10DataSetIterator,
+                                         IrisDataSetIterator)
+
+FIX = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+class TestGraphRunner:
+    def test_runs_frozen_graph_and_agrees_with_importer(self):
+        # heavy TF import: keep to one test; also cross-validates the
+        # native importer against the real TF runtime (the reference's
+        # TFGraphTestAllHelper SAMEDIFF-vs-LIBND4J comparison pattern)
+        from deeplearning4j_tpu.interop import GraphRunner
+        from deeplearning4j_tpu.modelimport import TFGraphMapper
+        exp = np.load(os.path.join(FIX, "tf_expected.npz"))
+        with GraphRunner(os.path.join(FIX, "tf_mlp.pb"), ["x"],
+                         ["probs"]) as runner:
+            tf_out = runner.run({"x": exp["x"]})["probs"]
+        np.testing.assert_allclose(tf_out, exp["y"], rtol=1e-5)
+        sd = TFGraphMapper.import_graph(os.path.join(FIX, "tf_mlp.pb"))
+        out_name = [v.name for v in sd.variables()][-1]
+        ours = sd.output({"x": exp["x"]}, [out_name])[out_name]
+        np.testing.assert_allclose(np.asarray(ours), tf_out, rtol=1e-4,
+                                   atol=1e-6)
+
+
+class TestLSH:
+    def test_approximate_knn_recall(self, np_rng):
+        pts = np_rng.randn(500, 16).astype(np.float32)
+        lsh = RandomProjectionLSH(pts, hash_length=10, num_tables=6,
+                                  seed=0)
+        # exact cosine neighbors for recall measurement
+        unit = pts / np.linalg.norm(pts, axis=1, keepdims=True)
+        hits = 0
+        trials = 20
+        for t in range(trials):
+            q = pts[t] + np_rng.randn(16).astype(np.float32) * 0.05
+            idx, dists = lsh.knn(q, 5)
+            qn = q / np.linalg.norm(q)
+            exact = set(np.argsort(-(unit @ qn))[:5])
+            hits += len(set(idx) & exact)
+            assert dists == sorted(dists)
+        assert hits / (trials * 5) > 0.6  # recall well above chance
+
+    def test_self_query(self, np_rng):
+        pts = np_rng.randn(100, 8).astype(np.float32)
+        lsh = RandomProjectionLSH(pts, seed=1)
+        idx, dists = lsh.knn(pts[42], 1)
+        assert idx[0] == 42 and dists[0] < 1e-5
+
+
+class TestDatasetIterators:
+    def test_iris(self):
+        it = IrisDataSetIterator(batch=150)
+        x, y = next(iter(it))
+        assert x.shape == (150, 4) and y.shape == (150, 3)
+        assert y.sum(0).tolist() == [50.0, 50.0, 50.0]
+        # classic sanity: setosa (class 0) has the smallest petals
+        petal_len = x[:, 2]
+        assert petal_len[y[:, 0] > 0].mean() < petal_len[y[:, 2] > 0].mean()
+
+    def test_iris_trains_to_high_accuracy(self):
+        from deeplearning4j_tpu.learning import Adam
+        from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        conf = (NeuralNetConfiguration.builder().seed(0)
+                .updater(Adam(0.05)).weight_init("xavier").list()
+                .layer(DenseLayer(n_out=16, activation="tanh"))
+                .layer(OutputLayer(n_out=3, loss="mcxent",
+                                   activation="softmax"))
+                .input_type_feed_forward(4).build())
+        net = MultiLayerNetwork(conf).init()
+        it = IrisDataSetIterator(batch=50, shuffle=True)
+        net.fit(it, epochs=40)
+        assert net.evaluate(IrisDataSetIterator(batch=150)).accuracy() \
+            > 0.93
+
+    def test_cifar10_binary_format(self, tmp_path, np_rng):
+        # write a real CIFAR-10-format binary file and read it back
+        n = 20
+        labels = np_rng.randint(0, 10, n).astype(np.uint8)
+        chw = np_rng.randint(0, 256, (n, 3, 32, 32)).astype(np.uint8)
+        rec = np.concatenate([labels[:, None],
+                              chw.reshape(n, -1)], axis=1)
+        for name in ("data_batch_1.bin", "data_batch_2.bin",
+                     "data_batch_3.bin", "data_batch_4.bin",
+                     "data_batch_5.bin"):
+            rec.astype(np.uint8).tofile(str(tmp_path / name))
+        it = Cifar10DataSetIterator(batch=10, train=True, shuffle=False,
+                                    data_dir=str(tmp_path))
+        assert not it.synthetic
+        x, y = next(iter(it))
+        assert x.shape == (10, 32, 32, 3)
+        # HWC layout: pixel (0,0) of channel 0 equals the CHW source
+        np.testing.assert_allclose(x[0, 0, 0, 0],
+                                   chw[0, 0, 0, 0] / 255.0, rtol=1e-6)
+        assert int(np.argmax(y[0])) == int(labels[0])
+
+    def test_cifar10_synthetic_fallback(self):
+        it = Cifar10DataSetIterator(batch=32, num_examples=64,
+                                    data_dir=None)
+        if it.synthetic:  # no local CIFAR data in this environment
+            x, y = next(iter(it))
+            assert x.shape == (32, 32, 32, 3) and y.shape == (32, 10)
