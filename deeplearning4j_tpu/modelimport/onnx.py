@@ -1,0 +1,328 @@
+"""ONNX import -> SameDiff.
+
+Ref: `nd4j-api/.../imports/graphmapper/onnx/OnnxGraphMapper.java` —
+protobuf ModelProto -> SameDiff with per-op mappings.
+
+Like the TF path (`modelimport.tf`), the protobuf wire format is parsed
+directly (ModelProto/GraphProto/NodeProto/TensorProto) — no onnx
+package needed. Covered op set targets the standard
+torch/keras-exported MLP/CNN surface; unsupported ops raise with the op
+name so coverage can grow incrementally.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..autodiff import SameDiff
+from .tf import _fields, _read_varint  # shared wire-format reader
+
+# ONNX TensorProto.DataType
+_ONNX_DTYPES = {1: np.float32, 2: np.uint8, 3: np.int8, 6: np.int32,
+                7: np.int64, 9: np.bool_, 11: np.float64}
+
+
+def _parse_tensor(buf: bytes) -> np.ndarray:
+    dims: List[int] = []
+    dtype = np.float32
+    raw = b""
+    floats: List[float] = []
+    ints: List[int] = []
+    for f, wt, v in _fields(buf):
+        if f == 1:  # dims (repeated int64)
+            dims.append(v if v < (1 << 62) else v - (1 << 64))
+        elif f == 2:  # data_type
+            dtype = _ONNX_DTYPES.get(v, np.float32)
+        elif f == 4 and wt == 2:  # float_data packed
+            floats.extend(struct.unpack(f"<{len(v)//4}f", v))
+        elif f == 4:
+            floats.append(struct.unpack("<f", v)[0])
+        elif f == 7:  # int64_data
+            if wt == 2:
+                pos = 0
+                while pos < len(v):
+                    iv, pos = _read_varint(v, pos)
+                    ints.append(iv if iv < (1 << 62) else iv - (1 << 64))
+            else:
+                ints.append(v)
+        elif f == 9:  # raw_data
+            raw = v
+    if raw:
+        arr = np.frombuffer(raw, dtype=dtype)
+    elif floats:
+        arr = np.asarray(floats, dtype)
+    elif ints:
+        arr = np.asarray(ints, dtype)
+    else:
+        arr = np.zeros(int(np.prod(dims)) if dims else 0, dtype)
+    return arr.reshape(dims) if dims else arr
+
+
+def _parse_attr(buf: bytes) -> Tuple[str, Any]:
+    name = ""
+    val: Any = None
+    ints: List[int] = []
+    floats: List[float] = []
+    for f, wt, v in _fields(buf):
+        if f == 1:
+            name = v.decode()
+        elif f == 2:  # f
+            val = struct.unpack("<f", v)[0]
+        elif f == 3:  # i
+            val = v if v < (1 << 62) else v - (1 << 64)
+        elif f == 4:  # s
+            val = v.decode("utf-8", "replace")
+        elif f == 5:  # t (tensor)
+            val = _parse_tensor(v)
+        elif f == 7:  # ints (repeated)
+            if wt == 2:
+                pos = 0
+                while pos < len(v):
+                    iv, pos = _read_varint(v, pos)
+                    ints.append(iv)
+            else:
+                ints.append(v)
+        elif f == 6:  # floats
+            if wt == 2:
+                floats.extend(struct.unpack(f"<{len(v)//4}f", v))
+            else:
+                floats.append(struct.unpack("<f", v)[0])
+    if ints:
+        val = ints
+    elif floats and val is None:
+        val = floats
+    return name, val
+
+
+class _OnnxNode:
+    def __init__(self):
+        self.op = ""
+        self.name = ""
+        self.inputs: List[str] = []
+        self.outputs: List[str] = []
+        self.attrs: Dict[str, Any] = {}
+
+
+def _parse_value_info(buf: bytes) -> Tuple[str, Optional[List[int]]]:
+    """ValueInfoProto -> (name, shape dims or None); 0/unknown dims map
+    to None entries."""
+    name = ""
+    shape = None
+    for f, _, v in _fields(buf):
+        if f == 1:
+            name = v.decode()
+        elif f == 2:  # TypeProto
+            for f2, _, v2 in _fields(v):
+                if f2 == 1:  # tensor_type
+                    for f3, _, v3 in _fields(v2):
+                        if f3 == 2:  # TensorShapeProto
+                            shape = []
+                            for f4, _, v4 in _fields(v3):
+                                if f4 == 1:  # Dimension
+                                    dim = None
+                                    for f5, wt5, v5 in _fields(v4):
+                                        if f5 == 1:  # dim_value
+                                            dim = v5
+                                    shape.append(dim)
+    return name, shape
+
+
+def parse_model(data: bytes):
+    """ModelProto -> (nodes, initializers, inputs, outputs)."""
+    graph_buf = None
+    for f, _, v in _fields(data):
+        if f == 7:  # graph
+            graph_buf = v
+    if graph_buf is None:
+        raise ValueError("no GraphProto in ONNX model")
+    nodes: List[_OnnxNode] = []
+    initializers: Dict[str, np.ndarray] = {}
+    inputs: List[Tuple[str, Optional[List[int]]]] = []
+    outputs: List[str] = []
+    for f, _, v in _fields(graph_buf):
+        if f == 1:  # node
+            n = _OnnxNode()
+            for f2, _, v2 in _fields(v):
+                if f2 == 1:
+                    n.inputs.append(v2.decode())
+                elif f2 == 2:
+                    n.outputs.append(v2.decode())
+                elif f2 == 3:
+                    n.name = v2.decode()
+                elif f2 == 4:
+                    n.op = v2.decode()
+                elif f2 == 5:
+                    k, val = _parse_attr(v2)
+                    n.attrs[k] = val
+            nodes.append(n)
+        elif f == 5:  # initializer (TensorProto with name field 8)
+            tname = ""
+            for f2, _, v2 in _fields(v):
+                if f2 == 8:
+                    tname = v2.decode()
+            initializers[tname] = _parse_tensor(v)
+        elif f == 11:  # input
+            inputs.append(_parse_value_info(v))
+        elif f == 12:  # output
+            name, _ = _parse_value_info(v)
+            outputs.append(name)
+    return nodes, initializers, inputs, outputs
+
+
+class OnnxGraphMapper:
+    """Ref: OnnxGraphMapper.java — importGraph(ModelProto) -> SameDiff."""
+
+    @staticmethod
+    def import_graph(source) -> SameDiff:
+        if isinstance(source, (bytes, bytearray)):
+            data = bytes(source)
+        else:
+            with open(source, "rb") as f:
+                data = f.read()
+        nodes, inits, inputs, outputs = parse_model(data)
+        sd = SameDiff.create()
+        env: Dict[str, Any] = {}
+        for name, arr in inits.items():
+            env[name] = sd.constant(arr, name=name.replace("/", "_")
+                                    .replace(".", "_"))
+        for name, shape in inputs:
+            if name in env:
+                continue  # initializer doubling as graph input
+            shape = None if shape is None else [
+                None if (d is None or d == 0) else int(d) for d in shape]
+            env[name] = sd.placeholder(name.replace("/", "_"), shape)
+        for n in nodes:
+            OnnxGraphMapper._map_node(sd, n, env)
+        sd._onnx_outputs = [env[o].name for o in outputs]
+        return sd
+
+    @staticmethod
+    def _map_node(sd: SameDiff, n: _OnnxNode, env: Dict[str, Any]):
+        op = n.op
+        a = n.attrs
+        ins = n.inputs
+        safe = (n.name or n.outputs[0]).replace("/", "_").replace(".", "_")
+
+        def rec(cat_op, *args, **kw):
+            v = sd._record(cat_op, args, kw, name=safe)
+            first = v[0] if isinstance(v, tuple) else v
+            env[n.outputs[0]] = first
+            if isinstance(v, tuple):
+                for i in range(1, min(len(v), len(n.outputs))):
+                    env[n.outputs[i]] = v[i]
+            return first
+
+        def const_of(name):
+            return np.asarray(sd.get_variable(env[name].name).get_arr())
+
+        if op == "Gemm":
+            alpha = a.get("alpha", 1.0)
+            beta = a.get("beta", 1.0)
+            x, w = env[ins[0]], env[ins[1]]
+            y = sd._record("matmul", (x, w), {
+                "transpose_a": bool(a.get("transA", 0)),
+                "transpose_b": bool(a.get("transB", 0))})
+            if alpha != 1.0:
+                y = y * float(alpha)
+            if len(ins) > 2:
+                b = env[ins[2]]
+                y = y + (b * float(beta) if beta != 1.0 else b)
+            y.rename(safe)
+            env[n.outputs[0]] = y
+        elif op == "MatMul":
+            rec("matmul", env[ins[0]], env[ins[1]])
+        elif op == "Add":
+            rec("add", env[ins[0]], env[ins[1]])
+        elif op == "Sub":
+            rec("subtract", env[ins[0]], env[ins[1]])
+        elif op == "Mul":
+            rec("multiply", env[ins[0]], env[ins[1]])
+        elif op == "Div":
+            rec("divide", env[ins[0]], env[ins[1]])
+        elif op in ("Relu", "Sigmoid", "Tanh", "Softplus", "Selu", "Elu",
+                    "Softsign"):
+            rec(op.lower(), env[ins[0]])
+        elif op == "LeakyRelu":
+            rec("lrelu", env[ins[0]], alpha=a.get("alpha", 0.01))
+        elif op == "Softmax":
+            rec("softmax", env[ins[0]], axis=a.get("axis", -1))
+        elif op in ("Exp", "Log", "Sqrt", "Neg", "Abs", "Floor", "Ceil",
+                    "Sin", "Cos", "Erf", "Sign", "Round"):
+            legacy = {"Abs": "abs", "Ceil": "ceil", "Round": "rint"}
+            rec("legacy." + legacy.get(op, op.lower()), env[ins[0]])
+        elif op == "Identity":
+            env[n.outputs[0]] = env[ins[0]]
+        elif op == "Flatten":
+            axis = a.get("axis", 1)
+            if axis != 1:
+                raise ValueError("Flatten axis != 1 unsupported")
+            x = env[ins[0]]
+            rec("reshape", x, shape=(-1, int(np.prod(x.shape[1:]))
+                                     if x.shape else -1))
+        elif op == "Reshape":
+            shape = tuple(int(s) for s in const_of(ins[1]))
+            rec("reshape", env[ins[0]], shape=shape)
+        elif op == "Transpose":
+            rec("permute", env[ins[0]], axes=tuple(a.get("perm", [])))
+        elif op == "Concat":
+            rec("concat", *[env[i] for i in ins], axis=a.get("axis", 0))
+        elif op == "Conv":
+            # ONNX NCHW -> framework NHWC
+            strides = tuple(a.get("strides", [1, 1]))
+            pads = a.get("pads", [0, 0, 0, 0])
+            dil = tuple(a.get("dilations", [1, 1]))
+            x = env[ins[0]]
+            x_nhwc = sd._record("permute", (x,), {"axes": (0, 2, 3, 1)})
+            w = const_of(ins[1])  # [O, I, kH, kW] -> [kH, kW, I, O]
+            w_hwio = sd.constant(np.transpose(w, (2, 3, 1, 0)))
+            padding = "valid" if not any(pads) else \
+                ((pads[0], pads[2]), (pads[1], pads[3]))
+            y = sd._record("conv2d", (x_nhwc, w_hwio), {
+                "stride": strides, "padding": padding, "dilation": dil})
+            if len(ins) > 2:
+                y = y + env[ins[2]]
+            y = sd._record("permute", (y,), {"axes": (0, 3, 1, 2)})
+            y.rename(safe)
+            env[n.outputs[0]] = y
+        elif op in ("MaxPool", "AveragePool"):
+            kernel = tuple(a.get("kernel_shape", [2, 2]))
+            strides = tuple(a.get("strides", kernel))
+            x_nhwc = sd._record("permute", (env[ins[0]],),
+                                {"axes": (0, 2, 3, 1)})
+            cat = "maxpool2d" if op == "MaxPool" else "avgpool2d"
+            y = sd._record(cat, (x_nhwc,), {"kernel": kernel,
+                                            "stride": strides,
+                                            "padding": "valid"})
+            y = sd._record("permute", (y,), {"axes": (0, 3, 1, 2)})
+            y.rename(safe)
+            env[n.outputs[0]] = y
+        elif op == "GlobalAveragePool":
+            rec("reduce_mean", env[ins[0]], axes=(2, 3), keep_dims=True)
+        elif op == "BatchNormalization":
+            # inference form over NCHW channel axis 1
+            x = env[ins[0]]
+            g, b = const_of(ins[1]), const_of(ins[2])
+            mean, var = const_of(ins[3]), const_of(ins[4])
+            eps = a.get("epsilon", 1e-5)
+            shape = (1, -1) + (1,) * (len(x.shape) - 2 if x.shape else 0)
+            scale = sd.constant((g / np.sqrt(var + eps)).reshape(shape))
+            shift = sd.constant((b - mean * g
+                                 / np.sqrt(var + eps)).reshape(shape))
+            y = x * scale + shift
+            y.rename(safe)
+            env[n.outputs[0]] = y
+        elif op == "ReduceMean":
+            rec("reduce_mean", env[ins[0]],
+                axes=tuple(a.get("axes", [])) or None,
+                keep_dims=bool(a.get("keepdims", 1)))
+        elif op == "Clip":
+            lo = float(const_of(ins[1])) if len(ins) > 2 else \
+                a.get("min", -np.inf)
+            hi = float(const_of(ins[2])) if len(ins) > 2 else \
+                a.get("max", np.inf)
+            rec("clipbyvalue", env[ins[0]], clip_min=lo, clip_max=hi)
+        else:
+            raise ValueError(f"unsupported ONNX op {op!r} (node "
+                             f"{n.name!r}); extend OnnxGraphMapper")
