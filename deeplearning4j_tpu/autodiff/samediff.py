@@ -1036,6 +1036,11 @@ class SameDiff:
         """Ref: SameDiff.evaluate — run forward over the iterator feeding
         features, accumulate into the evaluation object."""
         cfg = self._training_config
+        if cfg is None:
+            raise ValueError(
+                "evaluate requires a TrainingConfig with feature/label "
+                "mappings (set_training_config); this graph was loaded "
+                "without one")
         out = output_var.name if isinstance(output_var, SDVariable) \
             else output_var
         for batch in iterator:

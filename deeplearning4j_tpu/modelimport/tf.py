@@ -61,10 +61,17 @@ def _fields(buf: bytes):
         yield field, wt, val
 
 
-# TF DataType enum values we support
+# TF DataType enum values we support (types.proto: DT_FLOAT=1, DT_DOUBLE=2,
+# DT_INT32=3, DT_UINT8=4, DT_INT8=6, DT_STRING=7, DT_INT64=9, DT_BOOL=10,
+# DT_BFLOAT16=14, DT_HALF=19)
 _TF_DTYPES = {1: np.float32, 2: np.float64, 3: np.int32, 4: np.uint8,
               6: np.int8, 7: str, 9: np.int64, 10: np.bool_,
-              14: np.float16}
+              19: np.float16}
+try:  # bfloat16 consts (rare in frozen graphs; jax ships ml_dtypes)
+    import ml_dtypes as _mld
+    _TF_DTYPES[14] = _mld.bfloat16
+except ImportError:  # pragma: no cover
+    pass
 
 
 def _parse_shape(buf: bytes) -> List[int]:
@@ -83,11 +90,15 @@ def _parse_shape(buf: bytes) -> List[int]:
 
 
 def _parse_tensor(buf: bytes) -> np.ndarray:
+    # TensorProto fields (tensor.proto): 1=dtype 2=tensor_shape
+    # 4=tensor_content 5=float_val 6=double_val 7=int_val 8=string_val
+    # 10=int64_val 11=bool_val 13=half_val (bits of f16/bf16)
     dtype = np.float32
     shape: List[int] = []
     content = b""
     float_vals: List[float] = []
     int_vals: List[int] = []
+    half_bits: List[int] = []
     for f, wt, v in _fields(buf):
         if f == 1:
             dtype = _TF_DTYPES.get(v, np.float32)
@@ -95,24 +106,42 @@ def _parse_tensor(buf: bytes) -> np.ndarray:
             shape = _parse_shape(v)
         elif f == 4:
             content = v
-        elif f == 5:  # float_val
+        elif f == 5:  # float_val (wire: 32-bit, or packed)
             if wt == 2:  # packed
                 float_vals.extend(struct.unpack(f"<{len(v)//4}f", v))
             else:
                 float_vals.append(struct.unpack("<f", v)[0])
-        elif f in (6, 7, 9):  # int_val / int64_val
+        elif f == 6:  # double_val (wire: 64-bit, or packed)
+            if wt == 2:
+                float_vals.extend(struct.unpack(f"<{len(v)//8}d", v))
+            else:
+                float_vals.append(struct.unpack("<d", v)[0])
+        elif f in (7, 10, 11):  # int_val / int64_val / bool_val
+            # sign-correct: varints encode negative ints as huge unsigned
             if wt == 2:
                 pos = 0
                 while pos < len(v):
                     iv, pos = _read_varint(v, pos)
-                    int_vals.append(iv)
+                    int_vals.append(iv if iv < (1 << 62) else iv - (1 << 64))
             else:
-                int_vals.append(v)
+                int_vals.append(v if v < (1 << 62) else v - (1 << 64))
+        elif f == 13:  # half_val: raw f16/bf16 bit patterns as varints
+            if wt == 2:
+                pos = 0
+                while pos < len(v):
+                    iv, pos = _read_varint(v, pos)
+                    half_bits.append(iv)
+            else:
+                half_bits.append(v)
         elif f == 8 and wt == 2:  # string_val — unsupported payload
             raise ValueError("string tensors not supported")
     size = int(np.prod(shape)) if shape else 1
     if content:
         arr = np.frombuffer(content, dtype=dtype)
+    elif half_bits:
+        arr = np.asarray(half_bits, "<u2").view(dtype)
+        if arr.size == 1 and size > 1:
+            arr = np.full(size, arr[0], dtype)
     elif float_vals:
         arr = np.asarray(float_vals, dtype)
         if arr.size == 1 and size > 1:

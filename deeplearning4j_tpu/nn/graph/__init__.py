@@ -498,7 +498,8 @@ class ComputationGraph:
                                         else conf.updater)
                 self._layers_meta[name] = {
                     "l1": layer.l1, "l2": layer.l2,
-                    "l1_bias": layer.l1_bias, "l2_bias": layer.l2_bias}
+                    "l1_bias": layer.l1_bias, "l2_bias": layer.l2_bias,
+                    "bias_params": frozenset(layer.bias_param_names())}
             else:
                 shapes[name] = tuple(node.vertex.output_shape(in_shapes))
         self._shapes = shapes

@@ -120,6 +120,21 @@ class Layer:
     def param_shapes(self) -> Dict[str, Shape]:
         return {}
 
+    def bias_param_names(self) -> set:
+        """Params regularized with the *_bias coefficients (ref:
+        BaseMultiLayerUpdater.preApply — only weight params use l1/l2;
+        biases and norm offsets/gains use the bias coefficients, which
+        default to 0 i.e. unregularized). Convention over this package's
+        layer params: 'b'/'beta'/'b1'/'b2'/'gamma', any '*_b' offset and
+        any '*_g' norm gain, including composite 'attn_'-prefixed ones."""
+        names = set()
+        for n in self.param_shapes():
+            base = n[5:] if n.startswith("attn_") else n
+            if (base in ("b", "beta", "b1", "b2", "gamma")
+                    or base.endswith("_b") or base.endswith("_g")):
+                names.add(n)
+        return names
+
     def n_params(self) -> int:
         return sum(int(math.prod(s)) for s in self.param_shapes().values())
 

@@ -188,7 +188,10 @@ class TransformerEncoderLayer(Layer):
         x = x + att
         h = _ln(x, params["ln2_g"], params["ln2_b"])
         h = jax.nn.gelu(h @ params["W1"] + params["b1"])
-        h = self._maybe_dropout(h, train, rng)
+        # fold the rng so the MLP dropout mask is independent of the
+        # attention dropout mask above (same key would correlate them)
+        mlp_rng = None if rng is None else jax.random.fold_in(rng, 1)
+        h = self._maybe_dropout(h, train, mlp_rng)
         x = x + (h @ params["W2"] + params["b2"])
         if mask is not None:
             x = x * mask[..., None]

@@ -50,8 +50,9 @@ def _regularization_penalty(params, layers_meta):
     for key, meta in layers_meta.items():
         if key not in params:
             continue
+        bias_names = meta.get("bias_params", ("b", "beta"))
         for pname, w in params[key].items():
-            is_bias = pname in ("b", "beta")
+            is_bias = pname in bias_names
             l1 = meta["l1_bias"] if is_bias else meta["l1"]
             l2 = meta["l2_bias"] if is_bias else meta["l2"]
             if l2:
@@ -120,7 +121,8 @@ class MultiLayerNetwork:
         }
         self._layers_meta = {
             self._layer_keys[i]: {"l1": l.l1, "l2": l.l2,
-                                  "l1_bias": l.l1_bias, "l2_bias": l.l2_bias}
+                                  "l1_bias": l.l1_bias, "l2_bias": l.l2_bias,
+                                  "bias_params": frozenset(l.bias_param_names())}
             for i, l in enumerate(self.layers)
         }
         self._step = 0

@@ -286,38 +286,39 @@ int32_t npy_read(const char *path, void *out, int64_t nbytes) {
 int64_t csv_parse_floats(const char *buf, int64_t len, char delimiter,
                          float *out, int64_t cap, int64_t *rows_out,
                          int64_t *cols_out) {
-  int64_t written = 0, rows = 0, cols = -1, cur_cols = 0;
+  /* Whitespace handling: every whitespace char EXCEPT '\n' is padding
+     (strtof's own leading-whitespace skip would otherwise silently pull
+     the next row's first number across a row boundary, e.g. "1, \n2,3"
+     or "1,\t\n2,3"). Empty cells — including a trailing "1,2,\n" — are
+     malformed (-1), matching the python fallback which raises on
+     float(""). */
+  const auto pad = [](char c) {
+    return c == ' ' || c == '\r' || c == '\t' || c == '\v' || c == '\f';
+  };
+  int64_t written = 0, rows = 0, cols = -1;
   const char *p = buf;
   const char *end = buf + len;
   while (p < end) {
-    /* one row */
-    cur_cols = 0;
-    bool row_empty = true;
-    while (p < end && *p != '\n') {
+    while (p < end && pad(*p)) ++p;
+    if (p < end && *p == '\n') { ++p; continue; } /* blank row */
+    if (p >= end) break;
+    int64_t cur_cols = 0;
+    for (;;) {
+      while (p < end && pad(*p)) ++p;
+      if (p >= end || *p == '\n') return -1; /* empty cell */
       char *next = nullptr;
       float v = std::strtof(p, &next);
-      if (next == p) {
-        /* not a number: malformed cell */
-        if (*p == delimiter) { /* empty cell -> 0 */
-          v = 0.0f;
-          next = const_cast<char *>(p);
-        } else {
-          return -1;
-        }
-      }
+      if (next == p || next > end) return -1; /* malformed cell */
       if (written >= cap) return -1;
       out[written++] = v;
       ++cur_cols;
-      row_empty = false;
       p = next;
-      while (p < end && *p != delimiter && *p != '\n') {
-        if (*p != ' ' && *p != '\r') return -1;
-        ++p;
-      }
-      if (p < end && *p == delimiter) ++p;
+      while (p < end && pad(*p)) ++p;
+      if (p >= end || *p == '\n') break; /* row done */
+      if (*p != delimiter) return -1;    /* junk after value */
+      ++p;
     }
     if (p < end) ++p; /* consume newline */
-    if (row_empty) continue;
     if (cols < 0) cols = cur_cols;
     else if (cols != cur_cols) return -1;
     ++rows;

@@ -118,3 +118,115 @@ def test_conv_output_shape_numeric_padding():
         assert out.shape[1:] == tuple(layer.output_shape((6, 6, 3))), \
             f"{type(layer).__name__}: {out.shape[1:]} vs declared " \
             f"{layer.output_shape((6, 6, 3))}"
+
+
+# ---------------------------------------------------------------------------
+# round-2 ADVICE regressions
+# ---------------------------------------------------------------------------
+
+def _varint(n: int) -> bytes:
+    """Encode n (as unsigned 64-bit two's complement) as a protobuf varint."""
+    n &= (1 << 64) - 1
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def test_tf_parse_tensor_negative_ints():
+    """ADVICE r1 (medium): TF Consts holding negative ints (axis=-1 etc.)
+    must sign-correct in both the packed and unpacked int_val branches."""
+    # Cross-check against REAL TF serialization so the field numbers in
+    # the hand parser can never drift from the wire format again.
+    import tensorflow as tf
+    from deeplearning4j_tpu.modelimport.tf import _parse_tensor
+
+    def rt(val, dtype):
+        proto = tf.make_tensor_proto(val, dtype=dtype)
+        return _parse_tensor(proto.SerializeToString())
+
+    arr = rt(-1, tf.int32)       # unpacked int_val (field 7)
+    assert arr.dtype == np.int32 and arr.ravel().tolist() == [-1]
+    arr = rt([-1, 7, -3], tf.int32)
+    assert arr.ravel().tolist() == [-1, 7, -3]
+    arr = rt([-2, 5], tf.int64)  # int64_val (field 10)
+    assert arr.dtype == np.int64 and arr.ravel().tolist() == [-2, 5]
+    arr = rt([1.5, -2.25], tf.float64)  # double_val (field 6)
+    assert arr.dtype == np.float64 and arr.ravel().tolist() == [1.5, -2.25]
+    arr = rt([True, False], tf.bool)    # bool_val (field 11)
+    assert arr.ravel().tolist() == [1, 0]
+    arr = rt([1.5, -0.5], tf.float16)   # half_val bit patterns (field 13)
+    assert arr.dtype == np.float16 and arr.ravel().tolist() == [1.5, -0.5]
+
+
+def test_transformer_block_dropout_masks_independent(monkeypatch):
+    """ADVICE r1 (low): attention-input and MLP dropout within one
+    TransformerEncoderLayer must use decorrelated rng keys (the MLP
+    dropout folds the layer rng, it must not reuse it verbatim)."""
+    from deeplearning4j_tpu.nn.layers import Layer
+    from deeplearning4j_tpu.nn.layers.attention import TransformerEncoderLayer
+    layer = TransformerEncoderLayer(n_heads=2, dropout=0.5)
+    layer.build((4, 8, 16), {})
+    params = layer.init_params(jax.random.PRNGKey(0))
+    rng = jax.random.PRNGKey(42)
+    x = jnp.ones((4, 8, 16), jnp.float32)
+    seen = []
+    orig = Layer._maybe_dropout
+
+    def spy(self, h, train, key):
+        seen.append((type(self).__name__, np.asarray(key)))
+        return orig(self, h, train, key)
+
+    monkeypatch.setattr(Layer, "_maybe_dropout", spy)
+    layer.apply_seq(params, x, None, True, rng, (), None)
+    keys = {name: k for name, k in seen}
+    assert "TransformerEncoderLayer" in keys  # MLP dropout site
+    assert "SelfAttentionLayer" in keys       # attention dropout site
+    assert not np.array_equal(keys["TransformerEncoderLayer"],
+                              keys["SelfAttentionLayer"])
+
+
+def test_bias_params_not_weight_regularized():
+    """ADVICE r1 (low): LayerNorm offsets/gains and MLP biases in the
+    transformer block must be classified as bias params (unregularized
+    by default l1/l2)."""
+    from deeplearning4j_tpu.nn.layers.attention import TransformerEncoderLayer
+    layer = TransformerEncoderLayer(n_heads=2)
+    layer.build((2, 4, 16), {})
+    bias = layer.bias_param_names()
+    for name in ("b1", "b2", "ln1_b", "ln2_b", "ln1_g", "ln2_g", "attn_b"):
+        assert name in bias, name
+    for name in ("W1", "W2", "attn_Wq", "attn_Wo"):
+        assert name not in bias, name
+
+
+def test_samediff_evaluate_without_training_config_errors():
+    """ADVICE r1 (low): evaluate on an inference-only graph must raise a
+    clear ValueError, not AttributeError on NoneType."""
+    import pytest
+    from deeplearning4j_tpu.autodiff import SameDiff
+    from deeplearning4j_tpu.eval import Evaluation
+    sd = SameDiff.create()
+    x = sd.placeholder("x", (2, 3))
+    sd.nn.softmax(x, name="out")
+    with pytest.raises(ValueError, match="TrainingConfig"):
+        sd.evaluate([(np.zeros((2, 3)), np.zeros((2, 3)))], "out",
+                    Evaluation())
+
+
+def test_csv_parse_native_fallback_agree_on_edge_inputs():
+    """ADVICE r1 (low): native and python CSV parsers must agree: rows
+    ending in 'delimiter + spaces' and trailing empty cells are malformed
+    for both (no silent row-merging)."""
+    from deeplearning4j_tpu import runtime as rt
+    ok = rt.csv_parse_floats("1,2.5\n3, 4 \n")
+    assert ok is not None and ok.shape == (2, 2) and ok[1, 1] == 4.0
+    assert rt.csv_parse_floats("1, \n2,3\n") is None  # not row-merged
+    assert rt.csv_parse_floats("1,\t\n2,3\n") is None  # tab variant
+    assert rt.csv_parse_floats("1,2,\n") is None      # trailing empty cell
+    assert rt.csv_parse_floats("1,,2\n") is None      # interior empty cell
+    ok = rt.csv_parse_floats("1,\t2\n3,4\n")          # tab padding is fine
+    assert ok is not None and ok[0, 1] == 2.0
