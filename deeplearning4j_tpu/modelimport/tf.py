@@ -272,6 +272,10 @@ class TFGraphMapper:
 
         for nd in nodes:
             TFGraphMapper._map_node(sd, nd, env, ref)
+        # TF node name -> SameDiff variable name (pass-through nodes like
+        # Identity don't create vars; outputs are routed through this map)
+        sd.tf_name_map = {k: v.name for k, v in env.items()
+                          if hasattr(v, "name")}
         return sd
 
     @staticmethod
@@ -308,6 +312,15 @@ class TFGraphMapper:
             rec("matmul", ref(ins[0]), ref(ins[1]),
                 transpose_a=bool(a.get("transpose_a", False)),
                 transpose_b=bool(a.get("transpose_b", False)))
+        elif op in ("BatchMatMul", "BatchMatMulV2", "BatchMatMulV3"):
+            rec("matmul", ref(ins[0]), ref(ins[1]),
+                transpose_a=bool(a.get("adj_x", False)),
+                transpose_b=bool(a.get("adj_y", False)))
+        elif op == "Einsum":
+            rec("einsum", *[ref(i) for i in ins],
+                equation=a.get("equation", ""))
+        elif op == "AddN":
+            rec("mergeadd", *[ref(i) for i in ins])
         elif op == "BiasAdd":
             rec("biasadd", ref(ins[0]), ref(ins[1]))
         elif op in ("Add", "AddV2"):
@@ -334,9 +347,101 @@ class TFGraphMapper:
         elif op == "Softmax":
             rec("softmax", ref(ins[0]))
         elif op in ("Exp", "Log", "Sqrt", "Rsqrt", "Square", "Neg", "Abs",
-                    "Floor", "Ceil", "Sin", "Cos", "Erf", "Sign", "Round"):
-            legacy = {"Abs": "abs", "Ceil": "ceil", "Round": "rint"}
+                    "Floor", "Ceil", "Sin", "Cos", "Erf", "Erfc", "Sign",
+                    "Round", "Expm1", "Log1p", "Tan", "Atan", "Sinh", "Cosh",
+                    "Asin", "Acos", "Reciprocal", "Inv"):
+            legacy = {"Abs": "abs", "Ceil": "ceil", "Round": "rint",
+                      "Inv": "reciprocal"}
             rec("legacy." + legacy.get(op, op.lower()), ref(ins[0]))
+        elif op in ("ZerosLike", "OnesLike"):
+            rec("zeros_as" if op == "ZerosLike" else "ones_as", ref(ins[0]))
+        elif op in ("Greater", "GreaterEqual", "Less", "LessEqual",
+                    "Equal", "NotEqual"):
+            cmp = {"Greater": "greater", "GreaterEqual": "greater_equal",
+                   "Less": "less", "LessEqual": "less_equal",
+                   "Equal": "equals", "NotEqual": "not_equals"}[op]
+            rec(cmp, ref(ins[0]), ref(ins[1]))
+        elif op in ("LogicalAnd", "LogicalOr", "LogicalNot"):
+            b = {"LogicalAnd": "boolean_and", "LogicalOr": "boolean_or",
+                 "LogicalNot": "boolean_not"}[op]
+            rec(b, *[ref(i) for i in ins])
+        elif op in ("Select", "SelectV2"):
+            rec("select", ref(ins[0]), ref(ins[1]), ref(ins[2]))
+        elif op in ("FloorDiv", "FloorMod", "Mod"):
+            b = {"FloorDiv": "floordiv", "FloorMod": "floormod",
+                 "Mod": "floormod"}[op]
+            rec(b, ref(ins[0]), ref(ins[1]))
+        elif op == "LogSoftmax":
+            rec("log_softmax", ref(ins[0]))
+        elif op == "ClipByValue":
+            lo = float(np.asarray(ref(ins[1]).get_arr()))
+            hi = float(np.asarray(ref(ins[2]).get_arr()))
+            rec("clipbyvalue", ref(ins[0]), lo, hi)
+        elif op == "OneHot":
+            depth = int(np.asarray(ref(ins[1]).get_arr()))
+            on = float(np.asarray(ref(ins[2]).get_arr()))
+            off = float(np.asarray(ref(ins[3]).get_arr()))
+            rec("onehot", ref(ins[0]), depth, on=on, off=off,
+                axis=int(a.get("axis", -1)))
+        elif op == "Fill":
+            dims = tuple(int(x) for x in np.asarray(ref(ins[0]).get_arr()))
+            value = np.asarray(ref(ins[1]).get_arr())
+            env[name] = sd.constant(np.full(dims, value), name=safe)
+        elif op == "Range":
+            start, limit, delta = (np.asarray(ref(i).get_arr()) for i in ins)
+            env[name] = sd.constant(np.arange(start, limit, delta), name=safe)
+        elif op == "Shape":
+            shp = ref(ins[0]).shape
+            if shp is None or any(s is None for s in shp):
+                raise ValueError(
+                    f"Shape op {name!r} requires static input shapes "
+                    "(freeze the graph with concrete dims)")
+            env[name] = sd.constant(np.asarray(shp, np.int32), name=safe)
+        elif op == "StridedSlice":
+            begin = np.asarray(ref(ins[1]).get_arr()).tolist()
+            end = np.asarray(ref(ins[2]).get_arr()).tolist()
+            strides = np.asarray(ref(ins[3]).get_arr()).tolist()
+            bm = int(a.get("begin_mask", 0))
+            em = int(a.get("end_mask", 0))
+            elm = int(a.get("ellipsis_mask", 0))
+            nam = int(a.get("new_axis_mask", 0))
+            sam = int(a.get("shrink_axis_mask", 0))
+            spec = []
+            for i in range(len(begin)):
+                if elm & (1 << i):
+                    spec.append(("e",))
+                elif nam & (1 << i):
+                    spec.append(("n",))
+                elif sam & (1 << i):
+                    spec.append(("i", int(begin[i])))
+                else:
+                    spec.append((
+                        "s",
+                        None if bm & (1 << i) else int(begin[i]),
+                        None if em & (1 << i) else int(end[i]),
+                        int(strides[i])))
+            rec("numpy_slice", ref(ins[0]), spec=tuple(spec))
+        elif op == "Slice":
+            begin = tuple(int(x) for x in np.asarray(ref(ins[1]).get_arr()))
+            size = np.asarray(ref(ins[2]).get_arr()).tolist()
+            x = ref(ins[0])
+            if any(s < 0 for s in size):  # -1 = "to the end"
+                shp = x.shape
+                size = [int(shp[i] - begin[i]) if s < 0 else int(s)
+                        for i, s in enumerate(size)]
+            rec("slice", x, begin, tuple(int(s) for s in size))
+        elif op in ("Split", "SplitV"):
+            if op == "Split":  # inputs: axis, value
+                axis = int(np.asarray(ref(ins[0]).get_arr()))
+                rec("split", ref(ins[1]), int(a.get("num_split", 1)),
+                    axis=axis)
+            else:  # inputs: value, size_splits, axis
+                sizes = tuple(int(x)
+                              for x in np.asarray(ref(ins[1]).get_arr()))
+                axis = int(np.asarray(ref(ins[2]).get_arr()))
+                rec("split_v", ref(ins[0]), sizes, axis=axis)
+        elif op == "Unpack":
+            rec("unstack", ref(ins[0]), axis=int(a.get("axis", 0)))
         elif op in ("Mean", "Sum", "Max", "Min", "Prod"):
             axes_v = ref(ins[1]).get_arr()
             axes = tuple(int(x) for x in np.atleast_1d(np.asarray(axes_v)))

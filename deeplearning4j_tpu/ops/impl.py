@@ -230,6 +230,28 @@ def _strided_slice(x, begin, end, strides=None):
     return x[sl]
 
 
+@op("numpy_slice", "transforms")
+def _numpy_slice(x, spec):
+    """Full numpy basic-indexing slice from a static spec — the lowering
+    target for TF StridedSlice with begin/end/ellipsis/new_axis/
+    shrink_axis masks (ref: nd4j StridedSlice import,
+    imports/graphmapper/tf/TFGraphMapper.java). spec items:
+    ('s', begin|None, end|None, stride), ('i', index), ('n',) new axis,
+    ('e',) ellipsis."""
+    idx = []
+    for item in spec:
+        kind = item[0]
+        if kind == "s":
+            idx.append(slice(item[1], item[2], item[3]))
+        elif kind == "i":
+            idx.append(int(item[1]))
+        elif kind == "n":
+            idx.append(None)
+        else:  # 'e'
+            idx.append(Ellipsis)
+    return x[tuple(idx)]
+
+
 op("gather", "transforms")(lambda x, indices, axis=0: jnp.take(
     x, jnp.asarray(indices), axis=int(axis)))
 op("gather_nd", "transforms")(lambda x, indices: x[tuple(
@@ -600,6 +622,8 @@ op("matmul", "blas")(lambda a, b, transpose_a=False, transpose_b=False:
 op("tensormmul", "blas")(lambda a, b, axes_a, axes_b: jnp.tensordot(
     a, b, axes=(tuple(int(x) for x in axes_a), tuple(int(x) for x in axes_b))))
 op("batched_gemm", "blas")(lambda a, b: jnp.einsum("bij,bjk->bik", a, b))
+op("einsum", "blas")(lambda *xs, equation: jnp.einsum(equation, *xs))
+op("mergeadd", "transforms")(lambda *xs: sum(xs[1:], xs[0]))
 op("xw_plus_b", "blas")(lambda x, w, b: x @ w + b)
 op("svd", "blas", differentiable=False)(
     lambda x, full_matrices=False, compute_uv=True: jnp.linalg.svd(

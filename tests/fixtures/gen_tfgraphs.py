@@ -1,0 +1,235 @@
+"""TF-graph regression corpus generator.
+
+Mirrors the reference's checked-in TFGraphs corpus
+(`/root/reference/nd4j/nd4j-backends/nd4j-tests/src/test/java/org/nd4j/imports/TFGraphs/TFGraphTestAllSameDiff.java`
++ resources): each case is a frozen GraphDef plus real-TF-computed
+inputs/expected outputs. Run `python tests/fixtures/gen_tfgraphs.py` to
+(re)generate `tests/fixtures/tfgraphs/<case>.pb` + `<case>.npz`; the
+fixtures are committed so the corpus test needs no TF at test time.
+
+npz layout: input arrays under `in_<placeholder>`, expected outputs
+under `out_<i>`, output node names in `out_names` (pipe-joined str).
+"""
+import os
+import sys
+
+import numpy as np
+
+OUT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "tfgraphs")
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def _freeze(fn, specs):
+    import tensorflow as tf
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+    cf = tf.function(fn).get_concrete_function(*specs)
+    frozen = convert_variables_to_constants_v2(cf)
+    return frozen, frozen.graph.as_graph_def()
+
+
+def _save(name, fn, specs, inputs):
+    """Freeze fn, run real TF on `inputs`, write .pb + .npz."""
+    import tensorflow as tf
+    frozen, gd = _freeze(fn, specs)
+    outs = frozen(*[tf.constant(v) for v in inputs])
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    # map structural outputs back to graph node names (Identity nodes)
+    out_nodes = [t.name.split(":")[0] for t in frozen.outputs]
+    os.makedirs(OUT_DIR, exist_ok=True)
+    with open(os.path.join(OUT_DIR, f"{name}.pb"), "wb") as f:
+        f.write(gd.SerializeToString())
+    payload = {"out_names": np.asarray("|".join(out_nodes))}
+    for spec, arr in zip(specs, inputs):
+        payload[f"in_{spec.name}"] = arr
+    for i, o in enumerate(outs):
+        payload[f"out_{i}"] = o.numpy()
+    np.savez(os.path.join(OUT_DIR, f"{name}.npz"), **payload)
+    ops = sorted({n.op for n in gd.node})
+    print(f"{name}: {len(gd.node)} nodes, ops={ops}")
+
+
+def main():
+    import tensorflow as tf
+    rs = np.random.RandomState(42)
+    f32 = lambda *s: rs.randn(*s).astype(np.float32)
+
+    spec = tf.TensorSpec
+
+    # 1. MLP with erf-GELU
+    w1, b1 = f32(8, 16), f32(16)
+    w2, b2 = f32(16, 4), f32(4)
+    _save("mlp_gelu",
+          lambda x: tf.nn.softmax(
+              tf.matmul(tf.nn.gelu(tf.matmul(x, w1) + b1,
+                                   approximate=False), w2) + b2),
+          [spec([5, 8], tf.float32, name="x")], [f32(5, 8)])
+
+    # 2. CNN: conv + fused batchnorm + relu + maxpool + flatten + dense
+    kern = f32(3, 3, 2, 4) * 0.3
+    g, be = np.abs(f32(4)) + 0.5, f32(4)
+    mu, var = f32(4) * 0.1, np.abs(f32(4)) + 0.8
+
+    def cnn(img):
+        y = tf.nn.conv2d(img, kern, strides=1, padding="SAME")
+        y = tf.nn.batch_normalization(y, mu, var, be, g, 1e-3)
+        y = tf.nn.relu(y)
+        y = tf.nn.max_pool2d(y, 2, 2, padding="VALID")
+        y = tf.reshape(y, [-1, 4 * 4 * 4])
+        return tf.matmul(y, f32(64, 3))
+    _save("cnn_bn_pool", cnn, [spec([2, 8, 8, 2], tf.float32, name="img")],
+          [f32(2, 8, 8, 2)])
+
+    # 3. layer norm decomposition (Mean/SquaredDifference/Rsqrt)
+    lg, lb = np.abs(f32(12)) + 0.5, f32(12)
+
+    def ln(x):
+        m = tf.reduce_mean(x, axis=-1, keepdims=True)
+        v = tf.reduce_mean(tf.math.squared_difference(x, m), axis=-1,
+                           keepdims=True)
+        return (x - m) * tf.math.rsqrt(v + 1e-6) * lg + lb
+    _save("layernorm", ln, [spec([3, 7, 12], tf.float32, name="x")],
+          [f32(3, 7, 12)])
+
+    # 4. single attention head (BatchMatMul + mask + softmax)
+    def attn(q, k, v, mask):
+        s = tf.matmul(q, k, transpose_b=True) / np.float32(np.sqrt(8))
+        s += (1.0 - mask[:, None, :]) * -1e4
+        pr = tf.nn.softmax(s, axis=-1)
+        return tf.matmul(pr, v)
+    msk = (rs.rand(2, 6) > 0.2).astype(np.float32)
+    _save("attention_head", attn,
+          [spec([2, 6, 8], tf.float32, name="q"),
+           spec([2, 6, 8], tf.float32, name="k"),
+           spec([2, 6, 8], tf.float32, name="v"),
+           spec([2, 6], tf.float32, name="mask")],
+          [f32(2, 6, 8), f32(2, 6, 8), f32(2, 6, 8), msk])
+
+    # 5. reductions with negative axes / keepdims
+    def reds(x):
+        return (tf.reduce_mean(x, axis=-1),
+                tf.reduce_sum(x, axis=[0, 2], keepdims=True),
+                tf.reduce_max(x, axis=1),
+                tf.reduce_min(x), tf.reduce_prod(x, axis=-2))
+    _save("reduce_mixed", reds, [spec([3, 4, 5], tf.float32, name="x")],
+          [f32(3, 4, 5)])
+
+    # 6. strided slice zoo: shrink axis, masks, negative stride, newaxis
+    def slices(x):
+        return (x[:, 0], x[1:, ::2], x[..., -1], x[:, tf.newaxis, 2:4],
+                x[::-1], x[0, 1:3])
+    _save("strided_slice_zoo", slices,
+          [spec([4, 6], tf.float32, name="x")], [f32(4, 6)])
+
+    # 7. embeddings: gather / one-hot / cast
+    table = f32(11, 5)
+
+    def emb(ids):
+        e = tf.gather(table, ids)
+        oh = tf.one_hot(ids, 11, on_value=2.0, off_value=-1.0)
+        return e + tf.matmul(oh, table), tf.cast(ids, tf.float32)
+    ids = rs.randint(0, 11, (3, 7)).astype(np.int32)
+    _save("embedding_gather", emb, [spec([3, 7], tf.int32, name="ids")],
+          [ids])
+
+    # 8. broadcasting binary zoo
+    def bins(a, b):
+        return (a + b, a - b, a * b, a / (tf.abs(b) + 1.0),
+                tf.pow(tf.abs(a) + 0.5, 2.0),
+                tf.math.squared_difference(a, b),
+                tf.maximum(a, b), tf.minimum(a, b))
+    _save("binary_broadcast", bins,
+          [spec([4, 1, 5], tf.float32, name="a"),
+           spec([3, 5], tf.float32, name="b")],
+          [f32(4, 1, 5), f32(3, 5)])
+
+    # 9. comparisons + select + clip + logicals
+    def logic(a, b):
+        c = tf.where(a > b, a, b)
+        d = tf.clip_by_value(a, -0.5, 0.5)
+        e = tf.cast(tf.logical_and(a > 0.0, b > 0.0), tf.float32)
+        f = tf.cast(tf.logical_or(a >= b, tf.logical_not(b <= a)),
+                    tf.float32)
+        g_ = tf.cast(tf.not_equal(tf.sign(a), tf.sign(b)), tf.float32)
+        return c, d, e, f, g_
+    _save("logical_select", logic,
+          [spec([4, 5], tf.float32, name="a"),
+           spec([4, 5], tf.float32, name="b")],
+          [f32(4, 5), f32(4, 5)])
+
+    # 10. shape ops: transpose/expand/squeeze/concat/pack/tile/pad/
+    #     split/unstack/slice
+    def shapes(x):
+        t = tf.transpose(x, [1, 0, 2])
+        e = tf.expand_dims(x, 1)
+        sq = tf.squeeze(e, 1)
+        c = tf.concat([x, x * 2.0], axis=-1)
+        pk = tf.stack([x, x + 1.0], axis=0)
+        tl = tf.tile(x, [1, 2, 1])
+        pd = tf.pad(x, [[0, 0], [1, 1], [0, 0]])
+        s1, s2 = tf.split(x, 2, axis=2)
+        u = tf.unstack(x, axis=0)
+        sl = tf.slice(x, [0, 1, 0], [2, 2, -1])
+        return t, sq, c, pk, tl, pd, s1, s2, u[0], sl
+    _save("shape_ops", shapes, [spec([3, 4, 6], tf.float32, name="x")],
+          [f32(3, 4, 6)])
+
+    # 11. unary zoo
+    def unary(x):
+        xp = tf.abs(x) + 0.5
+        return (tf.exp(x), tf.math.log(xp), tf.sqrt(xp),
+                tf.math.rsqrt(xp), tf.tanh(x), tf.sigmoid(x),
+                tf.math.erf(x), tf.math.erfc(x), tf.sign(x),
+                tf.floor(x), tf.round(x), tf.math.reciprocal(xp),
+                tf.math.expm1(x), tf.math.log1p(xp), tf.square(x),
+                tf.sin(x), tf.cos(x), tf.atan(x))
+    _save("unary_zoo", unary, [spec([3, 9], tf.float32, name="x")],
+          [f32(3, 9)])
+
+    # 12. matmul variants + einsum + AddN
+    wa, wb = f32(7, 9), f32(9, 7)
+
+    def mms(x, y):
+        m1 = tf.matmul(x, wa)                      # plain
+        m2 = tf.matmul(x, wb, transpose_b=True)    # transpose_b
+        m3 = tf.matmul(y, y, adjoint_b=True)       # batch adj
+        m4 = tf.einsum("bij,bjk->bik", y, y)
+        return m1 + m2, m3, tf.add_n([m4, m3, m3])
+    _save("matmul_variants", mms,
+          [spec([4, 7], tf.float32, name="x"),
+           spec([2, 5, 5], tf.float32, name="y")],
+          [f32(4, 7), f32(2, 5, 5)])
+
+    # 13. softmax family
+    def smf(x):
+        return (tf.nn.softmax(x), tf.nn.log_softmax(x),
+                tf.cast(tf.argmax(x, axis=-1), tf.int32),
+                tf.one_hot(tf.cast(tf.argmax(x, axis=-1), tf.int32), 6))
+    _save("softmax_family", smf, [spec([5, 6], tf.float32, name="x")],
+          [f32(5, 6)])
+
+    # 14. BERT-mini classifier (the flagship import case)
+    from deeplearning4j_tpu.interop.tf_bert import build_frozen_bert
+    graph_bytes, meta = build_frozen_bert(
+        vocab=100, seq_len=16, n_classes=2, preset="tiny", seed=7)
+    ids = rs.randint(0, 100, (4, 16)).astype(np.int32)
+    mask = np.ones((4, 16), np.int32)
+    mask[:, 12:] = 0
+    from deeplearning4j_tpu.interop.tf_bert import reference_outputs
+    expected = reference_outputs(graph_bytes,
+                                 {"ids": ids, "mask": mask},
+                                 meta["output"])
+    with open(os.path.join(OUT_DIR, "bert_tiny.pb"), "wb") as f:
+        f.write(graph_bytes)
+    np.savez(os.path.join(OUT_DIR, "bert_tiny.npz"),
+             out_names=np.asarray(meta["output"]),
+             in_ids=ids, in_mask=mask, out_0=expected)
+    print(f"bert_tiny: frozen, expected {expected.shape}")
+
+
+if __name__ == "__main__":
+    main()

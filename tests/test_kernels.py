@@ -51,6 +51,28 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-3, atol=1e-4)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_gradients_multiblock(self, np_rng, causal):
+        # several q/k blocks so the Pallas backward's streaming
+        # accumulation (dq over k-blocks, dk/dv over q-blocks) is
+        # exercised, including the ragged final block
+        q, k, v = _qkv(np_rng, B=1, T=80, H=2, D=16)
+
+        def lf(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                           block_q=32, block_k=32,
+                                           interpret=True) ** 2)
+
+        def lp(q, k, v):
+            return jnp.sum(dot_product_attention(q, k, v,
+                                                 causal=causal) ** 2)
+
+        gf = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
+        gp = jax.grad(lp, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gp):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-4)
+
     def test_jit_compatible(self, np_rng):
         q, k, v = _qkv(np_rng, T=32)
         f = jax.jit(lambda q, k, v: flash_attention(
