@@ -78,14 +78,20 @@ class SelfAttentionLayer(Layer):
                                          dot_product_attention)
         impl = self.implementation
         if impl == "auto":
-            impl = "blockwise" if q.shape[1] > 2048 else "plain"
+            # TPU: the Pallas flash kernel is the default once the
+            # sequence is long enough to amortize the grid launch; it
+            # handles key-padding masks natively. Elsewhere (CPU mesh)
+            # the interpreter is slow, so use fused-XLA plain/blockwise.
+            from ...kernels.flash_attention import default_platform
+            on_tpu = default_platform() == "tpu"
+            if on_tpu and q.shape[1] >= 256:
+                impl = "flash"
+            else:
+                impl = "blockwise" if q.shape[1] > 2048 else "plain"
         if impl == "flash":
             from ...kernels import flash_attention
-            if mask is None:
-                return flash_attention(q, k, v, causal=self.causal)
-            # flash kernel has no key-padding input; blockwise keeps the
-            # O(T) memory property for masked long sequences
-            impl = "blockwise"
+            return flash_attention(q, k, v, causal=self.causal,
+                                   key_mask=mask)
         if impl == "blockwise":
             return blockwise_attention(q, k, v, causal=self.causal,
                                        key_mask=mask)
