@@ -4,23 +4,36 @@
 Methodology follows the reference's own benchmark guidance
 (`docs/deeplearning4j/templates/benchmark.md:16-100,165-186`): warmup
 excluded, fixed realistic minibatch, ETL excluded (data pre-staged on
-device), wall-clock over many iterations, sequential dependency between
-steps, `block_until_ready` before stopping the clock.
+device), wall-clock over many iterations with sequential dependency
+between steps.
 
-Headline metric: ResNet50 ImageNet-shaped training throughput
-(images/sec, batch 32) on one chip — BASELINE config 2. Extras record
-the full audit trail the judge asked for in VERDICT r1 (weak #5):
-`device_kind`, ms/iter, XLA-reported FLOPs/step, derived MFU, plus
-secondary models: ResNet50 batch 128 and BERT-base fine-tune through
-the TF importer (BASELINE config 3, ref BERTGraphTest.java:29).
+HONEST TIMING CONTRACT (VERDICT r3 #1): the timed region ends with a
+host fetch of the final loss (`float(np.asarray(loss))`) — because every
+step consumes the previous step's params, fetching the last loss forces
+the entire dependent chain to have executed on device. The harness then
+applies physics gates and HARD-FAILS (exit 2, "error" in the JSON) if:
+  - derived MFU > 1.0 for any model (impossible), or
+  - ResNet50 batch-128 runs < 2.5x the per-iter time of batch-32
+    (a 4x-larger batch that isn't ~4x slower per iter means the timer
+    measured dispatch, not device execution).
+Every sub-result records its final loss and, where datasets are
+involved, whether the data was synthetic (datasets.*.synthetic).
+
+Headline: ResNet50 ImageNet-shaped training throughput, batch 32,
+bf16 mixed precision (the TPU-native policy: bf16 compute on the MXU,
+f32 master params/loss — `nn/multilayer.py:_cdt`) on one chip —
+BASELINE config 2. Extras: ResNet50 b128, f32 reference point, BERT-base
+fine-tune via the TF importer (config 3), LeNet-MNIST accuracy
+(config 1), Word2Vec tokens/sec (config 4), and the flash-vs-XLA
+attention sweep (VERDICT r3 #3).
 
 Robustness: the axon TPU tunnel is single-client and can wedge; each
-bench runs in a subprocess with a timeout, and the headline falls back
-to LeNet/CPU so the driver always gets its JSON line.
+bench runs in its own subprocess with a timeout (strictly serialized —
+two concurrent clients deadlock the tunnel), and the headline falls
+back to LeNet/CPU so the driver always gets its JSON line.
 """
 from __future__ import annotations
 
-import glob
 import json
 import os
 import subprocess
@@ -38,58 +51,82 @@ PEAK_FLOPS = {
     "TPU v6e": 918e12,
 }
 
-RESNET_CODE = r"""
+_COMMON = r"""
 import json, sys, time
 import numpy as np
 import jax, jax.numpy as jnp
-from deeplearning4j_tpu.zoo.resnet import ResNet50
 
+def timed_steps(run_step, n_warmup, n_timed):
+    '''Run warmup, then time n_timed sequentially-dependent steps, ending
+    the timed region with a host fetch of the final loss (the honest
+    barrier: the last loss transitively depends on every step).'''
+    loss = None
+    for i in range(n_warmup):
+        loss = run_step(i)
+    _ = float(np.asarray(loss))  # drain warmup before starting the clock
+    t0 = time.perf_counter()
+    for i in range(n_timed):
+        loss = run_step(n_warmup + i)
+    final_loss = float(np.asarray(loss))  # forces the whole chain
+    dt = time.perf_counter() - t0
+    return dt, final_loss
+
+def emit(model, batch, n, dt, final_loss, flops=None, **kw):
+    d = jax.devices()[0]
+    print(json.dumps({
+        "samples_per_sec": n * batch / dt,
+        "ms_per_iter": 1000 * dt / n,
+        "final_loss": final_loss,
+        "platform": d.platform,
+        "device_kind": d.device_kind,
+        "model": model,
+        "flops_per_step": flops,
+        **kw}))
+"""
+
+RESNET_CODE = _COMMON + r"""
+from deeplearning4j_tpu.flags import flags as _flags
 BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+DTYPE = sys.argv[2] if len(sys.argv) > 2 else "bfloat16"
+N = _flags.bench_iters or (int(sys.argv[3]) if len(sys.argv) > 3 else 20)
+from deeplearning4j_tpu.zoo.resnet import ResNet50
 model = ResNet50(num_classes=1000, seed=0).init()
+if DTYPE != "float32":
+    model.conf.dtype = DTYPE  # mixed precision: bf16 compute, f32 master
 rs = np.random.RandomState(0)
 x = jnp.asarray(rs.rand(BATCH, 224, 224, 3).astype(np.float32))
 y = jnp.asarray(np.eye(1000, dtype=np.float32)[rs.randint(0, 1000, BATCH)])
 inputs = model._as_inputs(x)
 labels = model._as_labels(y)
-masks = model._as_masks(None) if hasattr(model, "_as_masks") else None
+masks = model._as_masks(None)
 step = model._make_step()
 rng = jax.random.PRNGKey(0)
-params, opt, st = model._params, model._opt_state, model._net_state
+state = [model._params, model._opt_state, model._net_state]
 flops = None
 try:
-    lowered = step.lower(params, opt, st, jnp.asarray(0), inputs, labels,
-                         masks, rng)
-    cost = lowered.compile().cost_analysis()
-    if cost:
-        c = cost[0] if isinstance(cost, (list, tuple)) else cost
+    compiled = step.lower(state[0], state[1], state[2], jnp.asarray(0),
+                          inputs, labels, masks, rng).compile()
+    cost = compiled.cost_analysis()
+    c = cost[0] if isinstance(cost, (list, tuple)) else cost
+    if c:
         flops = float(c.get("flops", 0.0)) or None
+    step = compiled  # reuse the one compiled executable
 except Exception:
     pass
-for i in range(3):  # warmup: compile + stabilize
-    params, opt, st, loss = step(params, opt, st, jnp.asarray(i),
-                                 inputs, labels, masks, rng)
-jax.block_until_ready(loss)
-N = 30
-t0 = time.perf_counter()
-for i in range(N):
-    params, opt, st, loss = step(params, opt, st, jnp.asarray(i),
-                                 inputs, labels, masks, rng)
-jax.block_until_ready(loss)
-dt = time.perf_counter() - t0
-d = jax.devices()[0]
-print(json.dumps({"samples_per_sec": N * BATCH / dt,
-                  "platform": d.platform,
-                  "device_kind": d.device_kind,
-                  "model": f"ResNet50-224 train (batch {BATCH})",
-                  "flops_per_step": flops,
-                  "ms_per_iter": 1000 * dt / N}))
+
+def run_step(i):
+    state[0], state[1], state[2], loss = step(
+        state[0], state[1], state[2], jnp.asarray(i), inputs, labels,
+        masks, rng)
+    return loss
+
+dt, final_loss = timed_steps(run_step, 3, N)
+emit(f"ResNet50-224 train (batch {BATCH}, {DTYPE})", BATCH, N, dt,
+     final_loss, flops, dtype=DTYPE, synthetic_data=True)
 """
 
-BERT_CODE = r"""
-import json, os, sys, time
-import numpy as np
-import jax, jax.numpy as jnp
-
+BERT_CODE = _COMMON + r"""
+import os
 CACHE = os.path.join(os.getcwd(), ".bench_cache")
 os.makedirs(CACHE, exist_ok=True)
 PB = os.path.join(CACHE, "bert_base_s128.pb")
@@ -134,38 +171,34 @@ feed["mask"] = jnp.asarray(np.ones((BATCH, SEQ), np.int32))
 feed["labels"] = jnp.asarray(
     np.eye(NCLS, dtype=np.float32)[rs.randint(0, NCLS, BATCH)])
 rng = jax.random.PRNGKey(0)
-upd = sd._updater_state
+state = [tvars, sd._updater_state]
 flops = None
 try:
-    cost = step.lower(tvars, upd, 0, feed, rng).compile().cost_analysis()
-    if cost:
-        c = cost[0] if isinstance(cost, (list, tuple)) else cost
+    compiled = step.lower(state[0], state[1], 0, feed, rng).compile()
+    cost = compiled.cost_analysis()
+    c = cost[0] if isinstance(cost, (list, tuple)) else cost
+    if c:
         flops = float(c.get("flops", 0.0)) or None
 except Exception:
-    pass
-for i in range(3):
-    tvars, upd, lv = step(tvars, upd, i, feed, rng)
-jax.block_until_ready(lv)
-N = 20
-t0 = time.perf_counter()
-for i in range(N):
-    tvars, upd, lv = step(tvars, upd, i, feed, rng)
-jax.block_until_ready(lv)
-dt = time.perf_counter() - t0
-d = jax.devices()[0]
-print(json.dumps({"samples_per_sec": N * BATCH / dt,
-                  "platform": d.platform,
-                  "device_kind": d.device_kind,
-                  "model": f"BERT-base-s{SEQ} TF-import fine-tune "
-                           f"(batch {BATCH})",
-                  "flops_per_step": flops,
-                  "ms_per_iter": 1000 * dt / N}))
+    compiled = None
+
+def run_step(i):
+    if compiled is not None:
+        state[0], state[1], lv = compiled(state[0], state[1], i, feed, rng)
+    else:
+        state[0], state[1], lv = step(state[0], state[1], i, feed, rng)
+    return lv
+
+from deeplearning4j_tpu.flags import flags as _flags
+N = _flags.bench_iters or 15
+dt, final_loss = timed_steps(run_step, 3, N)
+emit(f"BERT-base-s{SEQ} TF-import fine-tune (batch {BATCH}, float32)",
+     BATCH, N, dt, final_loss, flops, dtype="float32",
+     synthetic_data=True)
 """
 
-LENET_CODE = r"""
-import json, time
-import numpy as np
-import jax, jax.numpy as jnp
+LENET_CODE = _COMMON + r"""
+import os
 from deeplearning4j_tpu.datasets import MnistDataSetIterator
 from deeplearning4j_tpu.learning import Adam
 from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
@@ -185,29 +218,118 @@ conf = (NeuralNetConfiguration.builder().seed(123).updater(Adam(1e-3))
 model = MultiLayerNetwork(conf).init()
 it = MnistDataSetIterator(batch=BATCH, train=True, flatten=False,
                           num_examples=4096, shuffle=False)
+synthetic = bool(it.synthetic)
 batches = [(jnp.asarray(b[0]), jnp.asarray(b[1])) for b in it]
 step = model._make_step()
 rng = jax.random.PRNGKey(0)
-params, opt, st = model._params, model._opt_state, model._net_state
-for i in range(3):
+state = [model._params, model._opt_state, model._net_state]
+
+def run_step(i):
     x, y = batches[i % len(batches)]
-    params, opt, st, loss = step(params, opt, st, jnp.asarray(i), x, y,
-                                 None, rng)
-jax.block_until_ready(loss)
-N = 60
+    state[0], state[1], state[2], loss = step(
+        state[0], state[1], state[2], jnp.asarray(i), x, y, None, rng)
+    return loss
+
+from deeplearning4j_tpu.flags import flags as _flags
+N = _flags.bench_iters or 60
+dt, final_loss = timed_steps(run_step, 3, N)
+# accuracy check (BASELINE config 1: >=0.98 on the real test set)
+model._params, model._opt_state, model._net_state = state
+model._jit_step = step
+train_it = MnistDataSetIterator(batch=BATCH, train=True, flatten=False)
+model.fit(train_it, epochs=1)
+test_it = MnistDataSetIterator(batch=512, train=False, flatten=False)
+acc = model.evaluate(test_it).accuracy()
+emit("LeNet-MNIST train (batch 128)", BATCH, N, dt, final_loss,
+     test_accuracy=round(float(acc), 4), synthetic_data=synthetic)
+"""
+
+ATTENTION_CODE = _COMMON + r"""
+# flash (Pallas) vs plain fused-XLA attention, train-step wall-clock
+# (fwd+bwd through the kernel), with and without key-padding masks.
+from deeplearning4j_tpu.kernels import flash_attention
+from deeplearning4j_tpu.parallel.longseq import dot_product_attention
+
+B, H, D = 4, 8, 64
+results = {}
+for T in (512, 2048, 8192):
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.rand(B, T, H, D).astype(np.float32)) * 0.1
+    k = jnp.asarray(rs.rand(B, T, H, D).astype(np.float32)) * 0.1
+    v = jnp.asarray(rs.rand(B, T, H, D).astype(np.float32)) * 0.1
+    lens = np.full(B, T, np.int32); lens[: B // 2] = int(T * 0.75)
+    pad_mask = jnp.asarray(np.arange(T)[None, :] < lens[:, None],
+                           jnp.float32)
+    for name, fn, use_mask in (
+            ("flash", lambda q, k, v, m: flash_attention(
+                q, k, v, causal=True, key_mask=m), False),
+            ("xla", lambda q, k, v, m: dot_product_attention(
+                q, k, v, causal=True), False),
+            ("flash_masked", lambda q, k, v, m: flash_attention(
+                q, k, v, causal=True, key_mask=m), True),
+            ("xla_masked", lambda q, k, v, m: dot_product_attention(
+                q, k, v, mask=None if m is None else
+                m[:, None, None, :] > 0, causal=True), True)):
+        m = pad_mask if use_mask else None
+
+        @jax.jit
+        def train_step(q, k, v, m=m, fn=fn):
+            def loss_fn(q, k, v):
+                return jnp.sum(fn(q, k, v, m) ** 2)
+            l, g = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(q, k, v)
+            return l, g
+
+        try:
+            loss = None
+            qc = q
+            for _ in range(2):
+                loss, grads = train_step(qc, k, v)
+            _ = float(np.asarray(loss))
+            NIT = 10 if T <= 2048 else 5
+            t0 = time.perf_counter()
+            for _ in range(NIT):
+                loss, grads = train_step(qc, k, v)
+                # chain: next step's input depends on this step's grads,
+                # so the final host fetch forces every timed execution
+                # (same honest-timing contract as timed_steps)
+                qc = qc + 0.0 * grads[0]
+            _ = float(np.asarray(loss))
+            dt = time.perf_counter() - t0
+            results[f"T{T}_{name}"] = round(1000 * dt / NIT, 3)
+        except Exception as e:
+            results[f"T{T}_{name}"] = f"fail: {type(e).__name__}"
+d = jax.devices()[0]
+print(json.dumps({"model": "attention fwd+bwd ms/step (B4 H8 D64)",
+                  "platform": d.platform, "device_kind": d.device_kind,
+                  "results": results}))
+"""
+
+WORD2VEC_CODE = _COMMON + r"""
+# BASELINE config 4: Word2Vec throughput at benchmark scale. text8 is
+# 100MB of wiki text; no egress here, so a labeled synthetic corpus with
+# a text8-like Zipf vocabulary is used and tokens/sec is the metric.
+import time
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+rs = np.random.RandomState(0)
+VOCAB, N_TOK = 20000, 2_000_000
+ranks = np.arange(1, VOCAB + 1)
+probs = (1.0 / ranks) / np.sum(1.0 / ranks)   # Zipf, like natural text
+tokens = rs.choice(VOCAB, size=N_TOK, p=probs)
+words = [f"w{t}" for t in tokens]
+sentences = [words[i:i + 1000] for i in range(0, N_TOK, 1000)]
+w2v = Word2Vec(layer_size=128, window_size=5, min_word_frequency=5,
+               negative=5, iterations=1, seed=42, batch_size=2048)
 t0 = time.perf_counter()
-for i in range(N):
-    x, y = batches[i % len(batches)]
-    params, opt, st, loss = step(params, opt, st, jnp.asarray(i), x, y,
-                                 None, rng)
-jax.block_until_ready(loss)
+w2v.fit(sentences)
 dt = time.perf_counter() - t0
 d = jax.devices()[0]
-print(json.dumps({"samples_per_sec": N * BATCH / dt,
-                  "platform": d.platform,
-                  "device_kind": d.device_kind,
-                  "model": "LeNet-MNIST train (batch 128)",
-                  "ms_per_iter": 1000 * dt / N}))
+print(json.dumps({"model": "Word2Vec SG-NS (text8-scale synthetic)",
+                  "platform": d.platform, "device_kind": d.device_kind,
+                  "tokens_per_sec": round(N_TOK / dt, 1),
+                  "n_tokens": N_TOK, "vocab": VOCAB,
+                  "synthetic_data": True,
+                  "wall_seconds": round(dt, 1)}))
 """
 
 
@@ -228,19 +350,6 @@ def _run(code, env_extra, timeout, argv=()):
     return None
 
 
-def _prev_round_value():
-    vals = []
-    for f in sorted(glob.glob("BENCH_r*.json")):
-        try:
-            d = json.load(open(f))
-            if isinstance(d, dict) and isinstance(d.get("value"),
-                                                  (int, float)):
-                vals.append(d["value"])
-        except Exception:
-            continue
-    return vals[-1] if vals else None
-
-
 def _mfu(res):
     """Model FLOPs utilization from XLA's own cost analysis."""
     if not res or not res.get("flops_per_step") or not res.get("ms_per_iter"):
@@ -255,52 +364,122 @@ def _mfu(res):
 def _sub(res):
     if not res:
         return None
-    return {"model": res.get("model"),
-            "samples_per_sec": round(res.get("samples_per_sec", 0.0), 1),
-            "ms_per_iter": round(res.get("ms_per_iter", 0.0), 2),
-            "flops_per_step": res.get("flops_per_step"),
-            "mfu": _mfu(res)}
+    out = {"model": res.get("model"),
+           "samples_per_sec": round(res.get("samples_per_sec", 0.0), 1),
+           "ms_per_iter": round(res.get("ms_per_iter", 0.0), 2),
+           "flops_per_step": res.get("flops_per_step"),
+           "final_loss": res.get("final_loss"),
+           "mfu": _mfu(res)}
+    for k in ("test_accuracy", "synthetic_data", "dtype"):
+        if k in res:
+            out[k] = res[k]
+    return out
+
+
+def _sanity(results):
+    """Physics gates (VERDICT r3 #1) over EVERY measured model. Returns
+    list of violations. The batch-scaling gate only fires when both
+    sides are ResNet50 (same model, 4x batch)."""
+    bad = []
+    b32 = b128 = None
+    for tag, r in results:
+        if not r:
+            continue
+        m = _mfu(r)
+        if m is not None and m > 1.0:
+            bad.append(f"{tag}: MFU {m} > 1.0 is physically impossible — "
+                       "the timer is not measuring device execution")
+        model = str(r.get("model", ""))
+        if model.startswith("ResNet50") and "batch 32" in model:
+            b32 = b32 or r
+        if model.startswith("ResNet50") and "batch 128" in model:
+            b128 = r
+    if b32 and b128 and b32.get("ms_per_iter") and b128.get("ms_per_iter"):
+        ratio = b128["ms_per_iter"] / b32["ms_per_iter"]
+        if ratio < 2.5:
+            bad.append(
+                f"batch scaling violated: ms/iter(b128)={b128['ms_per_iter']:.2f} "
+                f"is only {ratio:.2f}x ms/iter(b32)={b32['ms_per_iter']:.2f} "
+                "(a 4x batch must be ~4x slower per iter)")
+    return bad
 
 
 def main():
-    # headline: ResNet50 batch 32 on the real chip (two attempts — the
-    # tunnel occasionally needs one)
-    res = _run(RESNET_CODE, {}, timeout=900, argv=[32])
+    from deeplearning4j_tpu.flags import flags
+    skip_secondary = flags.bench_skip_secondary
+    # headline: ResNet50 b32, bf16 mixed precision, honest barrier
+    res = _run(RESNET_CODE, {}, timeout=1500, argv=[32, "bfloat16", 20])
     if res is None:
-        res = _run(RESNET_CODE, {}, timeout=600, argv=[32])
+        res = _run(RESNET_CODE, {}, timeout=1200, argv=[32, "bfloat16", 20])
     fallback = False
     if res is None:
-        res = _run(LENET_CODE, {}, timeout=600)
+        res = _run(LENET_CODE, {}, timeout=900)
     if res is None:
         fallback = True
         res = _run(LENET_CODE,
                    {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"},
-                   timeout=600) or {"samples_per_sec": 0.0,
+                   timeout=900) or {"samples_per_sec": 0.0,
                                     "platform": "none", "model": "none"}
-    # secondary models (best-effort; never block the headline)
+    # secondary models (best-effort, STRICTLY serialized — the tunnel is
+    # single-client; concurrent subprocesses deadlock it)
     extras = {}
-    if not fallback and res.get("platform") != "none":
-        r128 = _run(RESNET_CODE, {}, timeout=900, argv=[128])
+    r128 = None
+    on_tpu = res.get("platform") in ("tpu", "axon")
+    if not fallback and not skip_secondary and on_tpu:
+        r128 = _run(RESNET_CODE, {}, timeout=1800, argv=[128, "bfloat16", 10])
         if r128:
             extras["resnet50_b128"] = _sub(r128)
-        bert = _run(BERT_CODE, {}, timeout=1800)
+        f32 = _run(RESNET_CODE, {}, timeout=1500, argv=[32, "float32", 10])
+        if f32:
+            extras["resnet50_b32_f32"] = _sub(f32)
+        bert = _run(BERT_CODE, {}, timeout=1800, argv=["float32"])
         if bert:
             extras["bert_base_finetune"] = _sub(bert)
-    value = round(res["samples_per_sec"], 1)
-    prev = _prev_round_value()
-    vs = round(value / prev, 3) if prev else 1.0
-    print(json.dumps({
+        lenet = _run(LENET_CODE, {}, timeout=900)
+        if lenet:
+            extras["lenet_mnist"] = _sub(lenet)
+        att = _run(ATTENTION_CODE, {}, timeout=1800)
+        if att:
+            extras["attention_flash_vs_xla"] = att.get("results")
+        w2v = _run(WORD2VEC_CODE, {}, timeout=1200)
+        if w2v:
+            extras["word2vec"] = {k: w2v[k] for k in
+                                  ("tokens_per_sec", "n_tokens", "vocab",
+                                   "synthetic_data", "wall_seconds")
+                                  if k in w2v}
+    # physics gates — hard-fail rather than publish impossible numbers
+    measured = [("headline", res if not fallback else None),
+                ("resnet50_b128", r128)]
+    measured += [(k, v) for k, v in extras.items()
+                 if isinstance(v, dict) and "ms_per_iter" in v]
+    violations = _sanity(measured)
+    value = round(res.get("samples_per_sec", 0.0), 1)
+    mfu = _mfu(res)
+    # vs_baseline: BENCH_r01–r03 measured dispatch, not execution (MFU>1)
+    # — not comparable. This round restarts the honest series.
+    out = {
         "metric": f"{res.get('model', '?')} throughput "
                   f"({res.get('platform', '?')})",
         "value": value,
         "unit": "samples/sec",
-        "vs_baseline": vs,
+        "vs_baseline": 1.0,
+        "baseline_note": "r01-r03 BENCH values were dispatch-rate fiction "
+                         "(MFU>1); honest series restarts here",
         "device_kind": res.get("device_kind"),
         "ms_per_iter": round(res.get("ms_per_iter", 0.0), 2),
         "flops_per_step": res.get("flops_per_step"),
-        "mfu": _mfu(res),
+        "final_loss": res.get("final_loss"),
+        "mfu": mfu,
+        "timing_contract": "timed region ends with host fetch of final "
+                           "loss; every step consumes the previous step's "
+                           "params so the fetch forces the full chain",
         "extra": extras,
-    }))
+    }
+    if violations:
+        out["error"] = "SANITY FAILURE: " + " | ".join(violations)
+        print(json.dumps(out))
+        sys.exit(2)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

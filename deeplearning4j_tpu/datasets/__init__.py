@@ -202,12 +202,13 @@ class AsyncDataSetIterator(DataSetIterator):
 # synthetic data so tests/benchmarks run hermetically.
 # ---------------------------------------------------------------------------
 
-_MNIST_DIRS = [
-    os.path.expanduser("~/.deeplearning4j_tpu/mnist"),
-    os.path.expanduser("~/.cache/mnist"),
-    "/root/data/mnist",
-    "/data/mnist",
-]
+def _mnist_dirs():
+    from ..flags import flags
+    return [flags.mnist_dir,
+            os.path.join(flags.data_dir, "mnist"),
+            os.path.expanduser("~/.cache/mnist"),
+            "/root/data/mnist",
+            "/data/mnist"]
 
 
 def _read_idx_images(path: str) -> np.ndarray:
@@ -229,8 +230,8 @@ def _read_idx_labels(path: str) -> np.ndarray:
 def _find_mnist() -> Optional[str]:
     names = ["train-images-idx3-ubyte", "train-labels-idx1-ubyte",
              "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"]
-    for d in _MNIST_DIRS:
-        if not os.path.isdir(d):
+    for d in _mnist_dirs():
+        if not d or not os.path.isdir(d):
             continue
         ok = all(os.path.exists(os.path.join(d, n)) or
                  os.path.exists(os.path.join(d, n + ".gz")) for n in names)
@@ -350,8 +351,9 @@ class IrisDataSetIterator(ArrayDataSetIterator):
 
 
 def _find_cifar10() -> Optional[str]:
-    for d in (os.environ.get("CIFAR10_DATA_DIR", ""),
-              os.path.expanduser("~/.deeplearning4j_tpu/cifar10"),
+    from ..flags import flags
+    for d in (flags.cifar10_dir,
+              os.path.join(flags.data_dir, "cifar10"),
               "/data/cifar10", "/root/data/cifar10"):
         if d and os.path.exists(os.path.join(d, "data_batch_1.bin")):
             return d

@@ -87,12 +87,22 @@ class MultiLayerConfiguration:
 
     # -- serde (the JSON round-trip property that powers golden-file tests
     # and Keras import in the reference) ---------------------------------
+    @staticmethod
+    def _defaults_to_json(defaults: dict) -> dict:
+        out = {}
+        for k, v in defaults.items():
+            if isinstance(v, list):
+                out[k] = [x.to_json() if hasattr(x, "to_json") else x
+                          for x in v]
+            else:
+                out[k] = v.to_json() if hasattr(v, "to_json") else v
+        return out
+
     def to_json(self) -> str:
         return json.dumps({
             "seed": self.seed,
             "updater": self.updater.to_json(),
-            "defaults": {k: (v.to_json() if hasattr(v, "to_json") else v)
-                         for k, v in self.defaults.items()},
+            "defaults": self._defaults_to_json(self.defaults),
             "input_type": self.input_type.to_json() if self.input_type else None,
             "tbptt_fwd_length": self.tbptt_fwd_length,
             "tbptt_bwd_length": self.tbptt_bwd_length,
@@ -173,9 +183,14 @@ class NeuralNetConfiguration:
         self._l1 = 0.0
         self._l2 = 0.0
         self._dropout = 0.0
+        self._weight_noise = None
+        self._constraints = []
         self._max_grad_norm = None
         self._grad_clip_value = None
-        self._dtype = "float"
+        # global default dtype (ref: ND4JSystemProperties.DTYPE); the
+        # builder's .data_type() overrides per configuration
+        from ...flags import flags as _flags
+        self._dtype = _flags.dtype or "float"
 
     @staticmethod
     def builder() -> "NeuralNetConfiguration":
@@ -205,8 +220,24 @@ class NeuralNetConfiguration:
         self._l2 = float(v)
         return self
 
-    def dropout(self, v: float):
-        self._dropout = float(v)
+    def dropout(self, v):
+        """Float = plain dropout prob; or an IDropout scheme (Gaussian/
+        Alpha/Spatial/noise — ref: Builder.dropOut overloads)."""
+        self._dropout = float(v) if isinstance(v, (int, float)) else v
+        return self
+
+    def weight_noise(self, wn):
+        """Global DropConnect / Gaussian weight noise default (ref:
+        NeuralNetConfiguration.Builder.weightNoise)."""
+        from .weightnoise import get as _wn_get
+        self._weight_noise = _wn_get(wn)
+        return self
+
+    def constrain_weights(self, *constraints):
+        """Global weight constraints, applied post-update (ref:
+        Builder.constrainWeights)."""
+        from .constraint import get as _con_get
+        self._constraints = [_con_get(c) for c in constraints]
         return self
 
     def gradient_normalization(self, max_norm: Optional[float] = None,
@@ -248,6 +279,10 @@ class NeuralNetConfiguration:
             d["l2"] = self._l2
         if self._dropout:
             d["dropout"] = self._dropout
+        if self._weight_noise is not None:
+            d["weight_noise"] = self._weight_noise
+        if self._constraints:
+            d["constraints"] = list(self._constraints)
         return d
 
     def list(self) -> ListBuilder:

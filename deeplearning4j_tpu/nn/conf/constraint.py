@@ -1,0 +1,158 @@
+"""Parameter constraints (ref: `nn/conf/constraint/` in deeplearning4j-nn:
+`BaseConstraint.java` (applyConstraint — called AFTER each parameter
+update), `MaxNormConstraint.java`, `MinMaxNormConstraint.java`,
+`UnitNormConstraint.java`, `NonNegativeConstraint.java`).
+
+TPU-first: a constraint is a pure projection applied to the updated
+weight inside the jitted train step (`MultiLayerNetwork._make_step_fn` /
+`ComputationGraph._make_step_fn`), so it fuses with the updater math.
+Reference semantics preserved:
+- norms are computed over the input dimensions of the weight (all axes
+  except the last — the reference defaults to dimension 0 for dense,
+  [1,2,3] for conv, i.e. "per output unit"),
+- constraints apply to WEIGHT params only by default
+  (`BaseConstraint.applyToWeights`; biases opt-in via apply_to_biases).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import jax.numpy as jnp
+
+
+class LayerConstraint:
+    """Base (ref: `api/layers/LayerConstraint.java` + BaseConstraint)."""
+
+    kind = "constraint"
+
+    def __init__(self, apply_to_weights: bool = True,
+                 apply_to_biases: bool = False):
+        self.apply_to_weights = bool(apply_to_weights)
+        self.apply_to_biases = bool(apply_to_biases)
+
+    def project(self, w):
+        """The projection itself (ref: BaseConstraint.apply)."""
+        raise NotImplementedError
+
+    def applies_to(self, param_name: str, bias_names) -> bool:
+        is_bias = param_name in bias_names
+        return self.apply_to_biases if is_bias else self.apply_to_weights
+
+    @staticmethod
+    def _norm(w, eps: float = 1e-8):
+        """L2 norm per output unit: reduce over all axes except the last
+        (dense [in, out] -> per-column; conv HWIO -> per output channel;
+        matches BaseConstraint's default dimensions)."""
+        axes = tuple(range(w.ndim - 1)) or (0,)
+        return jnp.sqrt(jnp.sum(jnp.square(w), axis=axes, keepdims=True)) \
+            + eps
+
+    def to_json(self) -> Dict[str, Any]:
+        d = {"@class": self.kind,
+             "apply_to_weights": self.apply_to_weights,
+             "apply_to_biases": self.apply_to_biases}
+        d.update(self._extra_json())
+        return d
+
+    def _extra_json(self) -> Dict[str, Any]:
+        return {}
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.to_json() == other.to_json()
+
+
+class MaxNormConstraint(LayerConstraint):
+    """Rescale any unit whose norm exceeds max_norm down to it (ref:
+    `MaxNormConstraint.java`)."""
+
+    kind = "max_norm"
+
+    def __init__(self, max_norm: float = 1.0, **kw):
+        super().__init__(**kw)
+        self.max_norm = float(max_norm)
+
+    def project(self, w):
+        n = self._norm(w)
+        return w * jnp.minimum(1.0, self.max_norm / n)
+
+    def _extra_json(self):
+        return {"max_norm": self.max_norm}
+
+
+class MinMaxNormConstraint(LayerConstraint):
+    """Clamp unit norms into [min, max] with interpolation rate (ref:
+    `MinMaxNormConstraint.java`: w *= (rate*clip(n,min,max)/n + 1-rate))."""
+
+    kind = "min_max_norm"
+
+    def __init__(self, min_norm: float = 0.0, max_norm: float = 1.0,
+                 rate: float = 1.0, **kw):
+        super().__init__(**kw)
+        self.min_norm = float(min_norm)
+        self.max_norm = float(max_norm)
+        self.rate = float(rate)
+
+    def project(self, w):
+        n = self._norm(w)
+        clipped = jnp.clip(n, self.min_norm, self.max_norm)
+        scale = self.rate * clipped / n + (1.0 - self.rate)
+        return w * scale
+
+    def _extra_json(self):
+        return {"min_norm": self.min_norm, "max_norm": self.max_norm,
+                "rate": self.rate}
+
+
+class UnitNormConstraint(LayerConstraint):
+    """Normalize every unit to norm 1 (ref: `UnitNormConstraint.java`)."""
+
+    kind = "unit_norm"
+
+    def project(self, w):
+        return w / self._norm(w)
+
+
+class NonNegativeConstraint(LayerConstraint):
+    """Clamp negatives to zero (ref: `NonNegativeConstraint.java`)."""
+
+    kind = "non_negative"
+
+    def __init__(self, **kw):
+        # applies to everything by default in the reference
+        kw.setdefault("apply_to_biases", True)
+        super().__init__(**kw)
+
+    def project(self, w):
+        return jnp.maximum(w, 0.0)
+
+
+_REGISTRY = {c.kind: c for c in
+             (MaxNormConstraint, MinMaxNormConstraint, UnitNormConstraint,
+              NonNegativeConstraint)}
+
+
+def get(spec) -> Optional[LayerConstraint]:
+    if spec is None or isinstance(spec, LayerConstraint):
+        return spec
+    d = dict(spec)
+    kind = d.pop("@class")
+    return _REGISTRY[kind](**d)
+
+
+def from_json(d: dict) -> LayerConstraint:
+    return get(d)
+
+
+def apply_constraints(constraints: Sequence[LayerConstraint],
+                      params: Dict[str, Any], bias_names) -> Dict[str, Any]:
+    """Project a layer's updated params (ref: BaseConstraint.applyConstraint
+    invoked from the updater path post-update)."""
+    if not constraints:
+        return params
+    out = dict(params)
+    for name, w in params.items():
+        for c in constraints:
+            if c.applies_to(name, bias_names):
+                w = c.project(w)
+        out[name] = w
+    return out

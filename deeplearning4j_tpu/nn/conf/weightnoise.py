@@ -1,0 +1,97 @@
+"""Weight noise schemes (ref: `nn/conf/weightnoise/` in deeplearning4j-nn:
+`DropConnect.java`, `WeightNoise.java` implementing `IWeightNoise` —
+applied to the WEIGHTS each forward pass during training, as opposed to
+dropout which perturbs activations).
+
+TPU-first: a pure transform over the layer's weight params inside the
+jitted step; the per-step Bernoulli/Gaussian mask fuses into the
+matmul's producers. Applied to weight params only (reference:
+`DropConnect.getParameter` applies to weights via the
+paramname-is-weight check), never to biases or norm gains.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class IWeightNoise:
+    """Base (ref: `nn/conf/weightnoise/IWeightNoise.java`)."""
+
+    kind = "weightnoise"
+
+    def apply(self, w, rng, train: bool):
+        raise NotImplementedError
+
+    def to_json(self) -> Dict[str, Any]:
+        d = {"@class": self.kind}
+        d.update(self._extra_json())
+        return d
+
+    def _extra_json(self) -> Dict[str, Any]:
+        return {}
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.to_json() == other.to_json()
+
+
+class DropConnect(IWeightNoise):
+    """Bernoulli weight masking (ref: `DropConnect.java`, Wan et al. 2013):
+    each weight is zeroed with probability 1-keep each step. Like the
+    reference, applied at train time only and NOT rescaled (the reference
+    applies the raw mask)."""
+
+    kind = "dropconnect"
+
+    def __init__(self, keep_prob: float = 0.5):
+        self.keep_prob = float(keep_prob)
+
+    def apply(self, w, rng, train):
+        if not train or self.keep_prob >= 1.0 or rng is None:
+            return w
+        mask = jax.random.bernoulli(rng, self.keep_prob, w.shape)
+        return jnp.where(mask, w, jnp.zeros((), w.dtype))
+
+    def _extra_json(self):
+        return {"keep_prob": self.keep_prob}
+
+
+class WeightNoise(IWeightNoise):
+    """Additive or multiplicative Gaussian weight noise (ref:
+    `WeightNoise.java` — takes a distribution + additive flag)."""
+
+    kind = "weight_gaussian_noise"
+
+    def __init__(self, stddev: float = 0.1, mean: float = 0.0,
+                 additive: bool = True):
+        self.stddev = float(stddev)
+        self.mean = float(mean)
+        self.additive = bool(additive)
+
+    def apply(self, w, rng, train):
+        if not train or rng is None:
+            return w
+        noise = self.mean + self.stddev * jax.random.normal(
+            rng, w.shape, w.dtype)
+        return w + noise if self.additive else w * noise
+
+    def _extra_json(self):
+        return {"stddev": self.stddev, "mean": self.mean,
+                "additive": self.additive}
+
+
+_REGISTRY = {c.kind: c for c in (DropConnect, WeightNoise)}
+
+
+def get(spec) -> Optional[IWeightNoise]:
+    if spec is None or isinstance(spec, IWeightNoise):
+        return spec
+    d = dict(spec)
+    kind = d.pop("@class")
+    return _REGISTRY[kind](**d)
+
+
+def from_json(d: dict) -> IWeightNoise:
+    return get(d)

@@ -323,7 +323,7 @@ class ComputationGraphConfiguration:
                  seed: int = 12345, updater=None, defaults: Optional[dict] = None,
                  max_grad_norm: Optional[float] = None,
                  grad_clip_value: Optional[float] = None,
-                 tbptt_fwd_length: int = 0):
+                 tbptt_fwd_length: int = 0, dtype: str = "float"):
         self.nodes = nodes
         self.graph_inputs = graph_inputs
         self.graph_outputs = graph_outputs
@@ -334,6 +334,7 @@ class ComputationGraphConfiguration:
         self.max_grad_norm = max_grad_norm
         self.grad_clip_value = grad_clip_value
         self.tbptt_fwd_length = tbptt_fwd_length
+        self.dtype = dtype
 
     # topological order (ref: ComputationGraph.topologicalSortOrder :463)
     def topo_order(self) -> List[str]:
@@ -353,17 +354,18 @@ class ComputationGraphConfiguration:
         return order
 
     def to_json(self) -> str:
+        from ..conf import MultiLayerConfiguration as _MLC
         return json.dumps({
             "seed": self.seed,
             "updater": self.updater.to_json(),
-            "defaults": {k: (v.to_json() if hasattr(v, "to_json") else v)
-                         for k, v in self.defaults.items()},
+            "defaults": _MLC._defaults_to_json(self.defaults),
             "inputs": self.graph_inputs,
             "outputs": self.graph_outputs,
             "input_types": {k: v.to_json() for k, v in self.input_types.items()},
             "max_grad_norm": self.max_grad_norm,
             "grad_clip_value": self.grad_clip_value,
             "tbptt_fwd_length": self.tbptt_fwd_length,
+            "dtype": self.dtype,
             "nodes": [{
                 "name": n.name, "inputs": n.inputs,
                 **({"layer": n.layer.to_json()} if n.layer is not None else {}),
@@ -390,7 +392,8 @@ class ComputationGraphConfiguration:
             updater=U.get(d["updater"]) if d.get("updater") else None,
             defaults=defaults, max_grad_norm=d.get("max_grad_norm"),
             grad_clip_value=d.get("grad_clip_value"),
-            tbptt_fwd_length=d.get("tbptt_fwd_length", 0))
+            tbptt_fwd_length=d.get("tbptt_fwd_length", 0),
+            dtype=d.get("dtype", "float"))
 
 
 class GraphBuilder:
@@ -437,7 +440,7 @@ class GraphBuilder:
         if b is not None:
             kw = dict(seed=b._seed, updater=b._updater, defaults=b._defaults(),
                       max_grad_norm=b._max_grad_norm,
-                      grad_clip_value=b._grad_clip_value)
+                      grad_clip_value=b._grad_clip_value, dtype=b._dtype)
         return ComputationGraphConfiguration(
             nodes=self._nodes, graph_inputs=self._inputs,
             graph_outputs=self._outputs, input_types=self._input_types,
@@ -527,6 +530,8 @@ class ComputationGraph:
                 p = params.get(name, {})
                 s = net_state.get(name, {})
                 r = node_rngs[i] if rng is not None else None
+                if layer.weight_noise is not None:
+                    p = layer._maybe_weight_noise(p, train, r)
                 if getattr(layer, "is_rnn", False):
                     m = fmask if ins[0].ndim == 3 else None
                     act, s2, _ = layer.apply_seq(
@@ -543,19 +548,33 @@ class ComputationGraph:
                 break
         return acts, new_state
 
+    @property
+    def _cdt(self):
+        """Compute dtype under mixed precision (policy shared with
+        MultiLayerNetwork — see nn/precision.py)."""
+        from ..precision import compute_dtype
+        return compute_dtype(getattr(self.conf, "dtype", None))
+
     def _loss_fn(self, params, net_state, inputs, labels: Dict[str, jnp.ndarray],
                  masks, train, rng):
         """Sum of output-layer losses + L1/L2 (ref: computeGradientAndScore
         :1320 sums scores over output layers)."""
+        from ..precision import (cast_feats_to_f32, cast_input_for_compute,
+                                 cast_params_for_compute)
         r_fwd = r_out = None
         if rng is not None:
             r_fwd, r_out = jax.random.split(rng)
-        acts, new_state = self._forward(params, net_state, inputs, train, r_fwd,
-                                        fmask=None)
+        cdt = self._cdt
+        params_c = cast_params_for_compute(
+            params, set(self.conf.graph_outputs), cdt)
+        inputs_c = {k: cast_input_for_compute(v, cdt)
+                    for k, v in inputs.items()} if cdt is not None else inputs
+        acts, new_state = self._forward(params_c, net_state, inputs_c, train,
+                                        r_fwd, fmask=None)
         total = 0.0
         for out_name in self.conf.graph_outputs:
             node = self.conf.nodes[out_name]
-            feats = acts[node.inputs[0]]
+            feats = cast_feats_to_f32(acts[node.inputs[0]])
             y = labels[out_name]
             m = None if masks is None else masks.get(out_name)
             total = total + node.layer.compute_loss(
@@ -574,6 +593,8 @@ class ComputationGraph:
         max_norm = self.conf.max_grad_norm
         clip_value = self.conf.grad_clip_value
 
+        nodes = self.conf.nodes
+
         def step_fn(params, opt_state, net_state, step, inputs, labels, masks, rng):
             (loss, new_net_state), grads = jax.value_and_grad(
                 lambda p: self._loss_fn(p, net_state, inputs, labels, masks,
@@ -584,8 +605,14 @@ class ComputationGraph:
             for key, p in params.items():
                 st, upd = updaters[key].apply(opt_state[key], grads[key], step)
                 new_opt[key] = st
-                new_params[key] = jax.tree_util.tree_map(
+                new_p = jax.tree_util.tree_map(
                     lambda a, u: a - u, p, upd)
+                layer = nodes[key].layer
+                if layer is not None and layer.constraints:
+                    from ..conf.constraint import apply_constraints
+                    new_p = apply_constraints(layer.constraints, new_p,
+                                              layer.bias_param_names())
+                new_params[key] = new_p
             return new_params, new_opt, new_net_state, loss
 
         return step_fn

@@ -46,16 +46,29 @@ class Layer:
 
     kind = "layer"
 
-    def __init__(self, name: Optional[str] = None, dropout: Optional[float] = None,
+    def __init__(self, name: Optional[str] = None, dropout=None,
                  activation=None, weight_init: Optional[str] = None,
                  bias_init: float = 0.0, updater=None,
                  l1: Optional[float] = None, l2: Optional[float] = None,
-                 l1_bias: Optional[float] = None, l2_bias: Optional[float] = None):
+                 l1_bias: Optional[float] = None, l2_bias: Optional[float] = None,
+                 weight_noise=None, constraints=None):
         # None means "unset — inherit the conf-level default at build()";
         # an explicit 0.0 opts out of a nonzero global default (the
         # reference distinguishes unset from set-to-zero the same way).
         self.name = name
-        self.dropout = None if dropout is None else float(dropout)
+        # dropout: float shorthand (drop prob) or an IDropout scheme
+        # (ref: Layer.Builder.dropOut(double) vs .dropOut(IDropout))
+        if dropout is None or isinstance(dropout, (int, float)):
+            self.dropout = None if dropout is None else float(dropout)
+        else:
+            from ..conf.dropout import get as _dropout_get
+            self.dropout = _dropout_get(dropout)
+        # weight noise (ref: Layer.Builder.weightNoise — DropConnect etc.)
+        from ..conf.weightnoise import get as _wn_get
+        self.weight_noise = _wn_get(weight_noise)
+        # post-update constraints (ref: Layer.Builder.constrainWeights)
+        from ..conf.constraint import get as _con_get
+        self.constraints = [_con_get(c) for c in (constraints or [])]
         self.activation = A.get(activation) if activation is not None else None
         self.weight_init = weight_init
         self.bias_init = float(bias_init)
@@ -87,7 +100,17 @@ class Layer:
         if self.l2_bias is None:
             self.l2_bias = defaults.get("l2_bias", 0.0)
         if self.dropout is None:
-            self.dropout = defaults.get("dropout", 0.0)
+            dd = defaults.get("dropout", 0.0)
+            if dd is not None and not isinstance(dd, (int, float)):
+                from ..conf.dropout import get as _dropout_get
+                dd = _dropout_get(dd)
+            self.dropout = dd
+        if self.weight_noise is None and defaults.get("weight_noise") is not None:
+            from ..conf.weightnoise import get as _wn_get
+            self.weight_noise = _wn_get(defaults["weight_noise"])
+        if not self.constraints and defaults.get("constraints"):
+            from ..conf.constraint import get as _con_get
+            self.constraints = [_con_get(c) for c in defaults["constraints"]]
         self.input_shape = tuple(input_shape)
         self._built = True
 
@@ -105,13 +128,35 @@ class Layer:
 
     # -- helpers -------------------------------------------------------
     def _maybe_dropout(self, x, train, rng):
-        """Inverted dropout, applied to the layer INPUT (reference semantics:
-        `dropOut` in BaseLayer applies to input activations)."""
-        if not train or not self.dropout or rng is None:
+        """Dropout/noise applied to the layer INPUT (reference semantics:
+        `dropOut` in BaseLayer applies to input activations). A float is
+        plain inverted dropout; an IDropout scheme (Gaussian/Alpha/
+        Spatial/noise — `nn/conf/dropout.py`) applies itself."""
+        d = self.dropout
+        if not train or d is None or rng is None:
             return x
-        keep = 1.0 - self.dropout
-        mask = jax.random.bernoulli(rng, keep, x.shape)
-        return jnp.where(mask, x / keep, 0.0)
+        if isinstance(d, (int, float)):
+            if not d:
+                return x
+            from ..conf.dropout import Dropout
+            d = Dropout(float(d))  # float shorthand shares the one impl
+        return d.apply(x, rng, train)
+
+    def _maybe_weight_noise(self, params, train, rng):
+        """Apply the configured IWeightNoise (DropConnect / Gaussian) to
+        this layer's weight params for one forward pass (ref:
+        `BaseLayer.getParamWithNoise`). Biases/norm gains are exempt."""
+        wn = self.weight_noise
+        if wn is None or not train or rng is None or not params:
+            return params
+        bias = self.bias_param_names()
+        base = jax.random.fold_in(rng, 0x5EED)
+        out = dict(params)
+        for i, n in enumerate(sorted(params)):
+            if n not in bias:
+                out[n] = wn.apply(params[n], jax.random.fold_in(base, i),
+                                  train)
+        return out
 
     @property
     def has_params(self) -> bool:
@@ -146,12 +191,19 @@ class Layer:
         d: Dict[str, Any] = {"@class": self.kind}
         for f in self._JSON_FIELDS:
             v = getattr(self, f, None)
+            if f == "dropout" and v is not None and \
+                    not isinstance(v, (int, float)):
+                v = v.to_json()
             if v is not None:
                 d[f] = v
         if self.activation is not None:
             d["activation"] = self.activation.to_json()
         if self.updater is not None:
             d["updater"] = self.updater.to_json()
+        if self.weight_noise is not None:
+            d["weight_noise"] = self.weight_noise.to_json()
+        if self.constraints:
+            d["constraints"] = [c.to_json() for c in self.constraints]
         d.update(self._extra_json())
         return d
 
@@ -473,9 +525,13 @@ class BatchNormalization(Layer):
 
     def apply(self, params, x, state, train, rng):
         axes = tuple(range(x.ndim - 1))
+        # statistics in f32 even under a bf16 compute policy: batch
+        # mean/var over ~1e5 elements loses real precision in bf16, and
+        # the running stats (state) are always f32
+        xs = x.astype(jnp.float32)
         if train:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            mean = jnp.mean(xs, axis=axes)
+            var = jnp.var(xs, axis=axes)
             new_state = {
                 "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
                 "var": self.decay * state["var"] + (1 - self.decay) * var,
@@ -483,10 +539,11 @@ class BatchNormalization(Layer):
         else:
             mean, var = state["mean"], state["var"]
             new_state = state
-        xn = (x - mean) / jnp.sqrt(var + self.eps)
+        xn = (xs - mean) * jax.lax.rsqrt(var + self.eps)
         if not self.lock_gamma_beta:
-            xn = xn * params["gamma"] + params["beta"]
-        return self.activation(xn), new_state
+            xn = xn * params["gamma"].astype(jnp.float32) \
+                + params["beta"].astype(jnp.float32)
+        return self.activation(xn).astype(x.dtype), new_state
 
     def _extra_json(self):
         return {"decay": self.decay, "eps": self.eps,
@@ -699,3 +756,4 @@ for _cls in (LSTM, GravesLSTM, SimpleRnn, Bidirectional,
 from . import convolutional  # noqa: E402,F401  (registers conv-family layers)
 from .attention import (SelfAttentionLayer,  # noqa: E402,F401
                         TransformerEncoderLayer)
+from .variational import VariationalAutoencoder  # noqa: E402,F401

@@ -82,9 +82,11 @@ class SelfAttentionLayer(Layer):
             # sequence is long enough to amortize the grid launch; it
             # handles key-padding masks natively. Elsewhere (CPU mesh)
             # the interpreter is slow, so use fused-XLA plain/blockwise.
+            from ...flags import flags as _flags
             from ...kernels.flash_attention import default_platform
             on_tpu = default_platform() == "tpu"
-            if on_tpu and q.shape[1] >= 256:
+            if (on_tpu and _flags.flash_attention
+                    and q.shape[1] >= _flags.flash_min_seq):
                 impl = "flash"
             else:
                 impl = "blockwise" if q.shape[1] > 2048 else "plain"

@@ -1,0 +1,167 @@
+"""Variational autoencoder layer (ref:
+`nn/conf/layers/variational/VariationalAutoencoder.java:59` — config:
+encoderLayerSizes/decoderLayerSizes/nOut(latent)/pzxActivationFunction/
+reconstructionDistribution/numSamples — and the runtime
+`nn/layers/variational/VariationalAutoencoder.java`: unsupervised
+pretraining on the variational lower bound (Kingma & Welling 2013),
+supervised forward = mean of q(z|x)).
+
+TPU-first: the whole ELBO (encoder -> reparameterized sample -> decoder
+-> reconstruction log-prob + KL) is one pure function; `MultiLayerNetwork
+.pretrain` jits it per layer. The reparameterization trick keeps the
+sampling differentiable, so the same JAX autodiff path covers it — the
+reference hand-writes the doBackward chain.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...weightinit import init_weights
+from . import Layer, register
+
+
+class VariationalAutoencoder(Layer):
+    """VAE as a (pretrainable) layer. Supervised forward returns the
+    latent mean — the reference's `activate` does the same, so a VAE can
+    sit mid-stack as a learned feature extractor."""
+
+    kind = "vae"
+    is_pretrain_layer = True
+
+    def __init__(self, n_out: int, encoder_layer_sizes: Sequence[int] = (100,),
+                 decoder_layer_sizes: Sequence[int] = (100,),
+                 reconstruction_distribution: str = "gaussian",
+                 pzx_activation: str = "identity", num_samples: int = 1,
+                 **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        self.n_out = int(n_out)
+        self.encoder_layer_sizes = tuple(int(s) for s in encoder_layer_sizes)
+        self.decoder_layer_sizes = tuple(int(s) for s in decoder_layer_sizes)
+        if reconstruction_distribution not in ("gaussian", "bernoulli"):
+            raise ValueError(
+                f"unknown reconstruction {reconstruction_distribution!r}")
+        self.reconstruction_distribution = reconstruction_distribution
+        self.pzx_activation = pzx_activation
+        self.num_samples = int(num_samples)
+        self.n_in: Optional[int] = None
+
+    # -- config ---------------------------------------------------------
+    def build(self, input_shape, defaults=None):
+        super().build(input_shape, defaults)
+        self.n_in = int(input_shape[-1])
+
+    def output_shape(self, input_shape) -> Tuple[int, ...]:
+        return (self.n_out,)
+
+    def param_shapes(self) -> Dict[str, Tuple[int, ...]]:
+        shapes: Dict[str, Tuple[int, ...]] = {}
+        d = self.n_in
+        for i, h in enumerate(self.encoder_layer_sizes):
+            shapes[f"e{i}_W"], shapes[f"e{i}_b"] = (d, h), (h,)
+            d = h
+        # q(z|x): mean and log-variance heads (ref: pZxMean/pZxLogStdev2)
+        shapes["zm_W"], shapes["zm_b"] = (d, self.n_out), (self.n_out,)
+        shapes["zv_W"], shapes["zv_b"] = (d, self.n_out), (self.n_out,)
+        d = self.n_out
+        for i, h in enumerate(self.decoder_layer_sizes):
+            shapes[f"d{i}_W"], shapes[f"d{i}_b"] = (d, h), (h,)
+            d = h
+        # p(x|z) head: gaussian emits mean+logvar, bernoulli emits logits
+        out = 2 * self.n_in if self.reconstruction_distribution == "gaussian" \
+            else self.n_in
+        shapes["xr_W"], shapes["xr_b"] = (d, out), (out,)
+        return shapes
+
+    def init_params(self, rng, dtype=jnp.float32):
+        shapes = self.param_shapes()
+        keys = jax.random.split(rng, len(shapes))
+        params = {}
+        for (name, shape), k in zip(sorted(shapes.items()), keys):
+            if name.endswith("_b"):
+                params[name] = jnp.full(shape, self.bias_init, dtype)
+            else:
+                fan_in, fan_out = shape
+                params[name] = init_weights(k, shape, fan_in, fan_out,
+                                            self.weight_init, dtype)
+        return params
+
+    # -- forward pieces --------------------------------------------------
+    def _encode(self, params, x):
+        """x -> (mean, logvar) of q(z|x)."""
+        h = x
+        for i in range(len(self.encoder_layer_sizes)):
+            h = self.activation(h @ params[f"e{i}_W"] + params[f"e{i}_b"])
+        from ... import activations as A
+        pzx = A.get(self.pzx_activation)
+        mean = pzx(h @ params["zm_W"] + params["zm_b"])
+        logvar = h @ params["zv_W"] + params["zv_b"]
+        return mean, logvar
+
+    def _decode(self, params, z):
+        h = z
+        for i in range(len(self.decoder_layer_sizes)):
+            h = self.activation(h @ params[f"d{i}_W"] + params[f"d{i}_b"])
+        return h @ params["xr_W"] + params["xr_b"]
+
+    # -- supervised path: activation = E[q(z|x)] (ref runtime activate) --
+    def apply(self, params, x, state, train, rng):
+        x = self._maybe_dropout(x, train, rng)
+        mean, _ = self._encode(params, x)
+        return mean, state
+
+    # -- unsupervised pretraining loss (the negative ELBO) ---------------
+    def pretrain_loss(self, params, x, rng):
+        """-ELBO = KL(q(z|x) || N(0,I)) - E_q[log p(x|z)] averaged over
+        the batch (ref: VariationalAutoencoder.computeGradientAndScore —
+        score is the negative variational lower bound)."""
+        mean, logvar = self._encode(params, x)
+        # KL(q||N(0,I)) = -0.5 * sum(1 + logvar - mean^2 - e^logvar)
+        kl = -0.5 * jnp.sum(1.0 + logvar - jnp.square(mean)
+                            - jnp.exp(logvar), axis=-1)
+        rec = 0.0
+        keys = jax.random.split(rng, self.num_samples)
+        for k in keys:
+            eps = jax.random.normal(k, mean.shape, mean.dtype)
+            z = mean + jnp.exp(0.5 * logvar) * eps   # reparameterization
+            out = self._decode(params, z)
+            if self.reconstruction_distribution == "gaussian":
+                xm, xlv = out[..., :self.n_in], out[..., self.n_in:]
+                # log N(x; xm, e^xlv) summed over features
+                ll = -0.5 * jnp.sum(
+                    xlv + math.log(2.0 * math.pi)
+                    + jnp.square(x - xm) / jnp.exp(xlv), axis=-1)
+            else:
+                # bernoulli logits: log p = sum x*log(sig) + (1-x)*log(1-sig)
+                ll = -jnp.sum(
+                    jnp.maximum(out, 0) - out * x
+                    + jnp.log1p(jnp.exp(-jnp.abs(out))), axis=-1)
+            rec = rec + ll
+        rec = rec / self.num_samples
+        return jnp.mean(kl - rec)
+
+    def reconstruct(self, params, x, rng=None):
+        """Deterministic reconstruction through the latent mean (ref:
+        VariationalAutoencoder.generateAtMeanGivenZ / reconstructionProbability
+        utilities)."""
+        mean, _ = self._encode(params, x)
+        out = self._decode(params, mean)
+        if self.reconstruction_distribution == "gaussian":
+            return out[..., :self.n_in]
+        return jax.nn.sigmoid(out)
+
+    def _extra_json(self):
+        return {"n_out": self.n_out,
+                "encoder_layer_sizes": list(self.encoder_layer_sizes),
+                "decoder_layer_sizes": list(self.decoder_layer_sizes),
+                "reconstruction_distribution":
+                    self.reconstruction_distribution,
+                "pzx_activation": self.pzx_activation,
+                "num_samples": self.num_samples}
+
+
+register(VariationalAutoencoder)

@@ -165,6 +165,8 @@ class MultiLayerNetwork:
             p = params.get(key, {})
             s = net_state.get(key, {})
             r = layer_rngs[i] if rng is not None else None
+            if layer.weight_noise is not None:
+                p = layer._maybe_weight_noise(p, train, r)
             if getattr(layer, "is_rnn", False):
                 m = fmask if act.ndim == 3 else None
                 act, s2, c2 = layer.apply_seq(p, act, s, train, r,
@@ -176,17 +178,31 @@ class MultiLayerNetwork:
                 new_state[key] = s2
         return act, new_state, new_carries
 
+    @property
+    def _cdt(self):
+        """Compute dtype under the mixed-precision policy, or None
+        (see nn/precision.py for the policy)."""
+        from .precision import compute_dtype
+        return compute_dtype(self.conf.dtype)
+
     def _loss_fn(self, params, net_state, x, y, mask, train: bool, rng,
                  carries=None):
         """Data loss + L1/L2 score terms (ref: BaseLayer.calcRegularizationScore).
         `mask` doubles as the per-timestep feature+label mask for sequence
         models (the common DL4J case where both coincide)."""
+        from .precision import (cast_feats_to_f32, cast_input_for_compute,
+                                cast_params_for_compute)
         r_fwd = r_out = None
         if rng is not None:
             r_fwd, r_out = jax.random.split(rng)
+        cdt = self._cdt
+        params_c = cast_params_for_compute(params, {self._layer_keys[-1]},
+                                           cdt)
+        x = cast_input_for_compute(x, cdt)
         feats, new_state, new_carries = self._forward(
-            params, net_state, x, train, r_fwd,
+            params_c, net_state, x, train, r_fwd,
             upto=len(self.layers) - 1, carries=carries, fmask=mask)
+        feats = cast_feats_to_f32(feats)
         out_layer = self.layers[-1]
         out_key = self._layer_keys[-1]
         lmask = mask
@@ -209,6 +225,8 @@ class MultiLayerNetwork:
         max_norm = self.conf.max_grad_norm
         clip_value = self.conf.grad_clip_value
 
+        layers = self.layers
+
         def step_fn(params, opt_state, net_state, step, x, y, mask, rng):
             # NOTE: _loss_fn includes the L1/L2 penalty terms, so these
             # grads already carry l2*W + l1*sign(W) (ref semantics:
@@ -225,8 +243,14 @@ class MultiLayerNetwork:
                     continue
                 st, upd = updaters[i].apply(opt_state[key], grads[key], step)
                 new_opt[key] = st
-                new_params[key] = jax.tree_util.tree_map(
+                new_p = jax.tree_util.tree_map(
                     lambda p, u: p - u, params[key], upd)
+                if layers[i].constraints:
+                    # ref: BaseConstraint.applyConstraint — post-update
+                    from .conf.constraint import apply_constraints
+                    new_p = apply_constraints(layers[i].constraints, new_p,
+                                              layers[i].bias_param_names())
+                new_params[key] = new_p
             return new_params, new_opt, new_net_state, loss
 
         return step_fn
@@ -344,6 +368,69 @@ class MultiLayerNetwork:
                 self._params, self._opt_state, self._net_state,
                 jnp.asarray(self._step), xc, yc, mc, sub, carries)
         return loss
+
+    # -- layerwise unsupervised pretraining (ref: MultiLayerNetwork.pretrain
+    # :~1100 — used by the VariationalAutoencoder layer) -----------------
+    def pretrain(self, iterator, epochs: int = 1):
+        """Unsupervised layerwise pretraining: every pretrainable layer
+        (VAE) is trained in stack order on the activations of the layers
+        below it (ref: MultiLayerNetwork.pretrain(DataSetIterator))."""
+        if self._params is None:
+            self.init()
+        # materialize generators once — a plain generator would be
+        # exhausted by the first pretrainable layer and silently yield
+        # zero batches for the next (same guard as fit())
+        if not hasattr(iterator, "reset") and \
+                not isinstance(iterator, (list, tuple)):
+            iterator = list(iterator)
+        for i, layer in enumerate(self.layers):
+            if getattr(layer, "is_pretrain_layer", False):
+                self.pretrain_layer(i, iterator, epochs=epochs)
+        return self
+
+    def pretrain_layer(self, i: int, iterator, epochs: int = 1):
+        """Pretrain layer i on its unsupervised loss (ref:
+        MultiLayerNetwork.pretrainLayer). Inputs are the frozen forward
+        activations of layers [0, i); only layer i's params move."""
+        layer = self.layers[i]
+        if not getattr(layer, "is_pretrain_layer", False):
+            raise ValueError(f"layer {i} ({type(layer).__name__}) is not "
+                             "pretrainable")
+        key = self._layer_keys[i]
+        updater = self._updaters[i]
+
+        @jax.jit
+        def pre_step(p, opt, step, feats, rng):
+            loss, g = jax.value_and_grad(
+                lambda pp: layer.pretrain_loss(pp, feats, rng))(p)
+            st, upd = updater.apply(opt, g, step)
+            new_p = jax.tree_util.tree_map(lambda a, u: a - u, p, upd)
+            return new_p, st, loss
+
+        @jax.jit
+        def features(params, net_state, x):
+            act, _, _ = self._forward(params, net_state, x, False, None,
+                                      upto=i)
+            return act
+
+        p, opt = self._params[key], self._opt_state[key]
+        step = 0
+        data = iterator if isinstance(iterator, (list, tuple)) \
+            else list(iterator)
+        loss = None
+        for _ in range(epochs):
+            for item in data:
+                x = self._unpack(item)[0]
+                x = self._reshape_input(jnp.asarray(x))
+                feats = features(self._params, self._net_state, x)
+                self._rng, sub = jax.random.split(self._rng)
+                p, opt, loss = pre_step(p, opt, jnp.asarray(step), feats,
+                                        sub)
+                step += 1
+        self._params[key] = p
+        self._opt_state[key] = opt
+        self._last_loss = loss
+        return self
 
     # -- stateful RNN inference (ref: rnnTimeStep / rnnClearPreviousState)
     def rnn_time_step(self, x):

@@ -1,0 +1,51 @@
+"""Mixed-precision policy shared by MultiLayerNetwork and ComputationGraph.
+
+Ref: the reference's global dtype switch (`ND4JSystemProperties.DTYPE`,
+`NeuralNetConfiguration.Builder.dataType` — DataType.HALF on CUDA). TPU
+redesign: "half" is bfloat16 on the MXU; the policy is standard bf16
+mixed precision — cast the forward/backward COMPUTE to bf16 while master
+params, updater state, BatchNorm statistics, the output layer, and the
+loss stay float32. bf16 keeps f32's exponent range, so no loss scaling
+is needed (unlike fp16).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Set
+
+import jax
+import jax.numpy as jnp
+
+_HALF_NAMES = ("bfloat16", "bf16", "half", "float16", "fp16")
+
+
+def compute_dtype(conf_dtype: Optional[str]):
+    """Map a configuration dtype string to the compute dtype, or None
+    for pure f32."""
+    if (conf_dtype or "float").lower() in _HALF_NAMES:
+        return jnp.bfloat16
+    return None
+
+
+def cast_params_for_compute(params: Dict, exempt_keys: Set[str], cdt):
+    """Cast every f32 param leaf to `cdt`, except layers in
+    `exempt_keys` (the output layers — logits/softmax/loss stay f32)."""
+    if cdt is None:
+        return params
+    return {
+        k: (p if k in exempt_keys else jax.tree_util.tree_map(
+            lambda a: a.astype(cdt) if a.dtype == jnp.float32 else a, p))
+        for k, p in params.items()}
+
+
+def cast_input_for_compute(x, cdt):
+    if cdt is None or not jnp.issubdtype(x.dtype, jnp.floating):
+        return x
+    return x.astype(cdt)
+
+
+def cast_feats_to_f32(feats):
+    """Promote pre-output activations back to f32 for the loss."""
+    if feats.dtype != jnp.float32 and jnp.issubdtype(feats.dtype,
+                                                     jnp.floating):
+        return feats.astype(jnp.float32)
+    return feats
