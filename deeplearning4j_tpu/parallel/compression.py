@@ -67,6 +67,41 @@ def topk_decode(indices, values, shape):
 
 
 # ---------------------------------------------------------------------------
+# in-graph Strom encoding (jit-side, static shapes) — the piece the
+# training step consumes (ref: EncodingHandler.encodeThreshold +
+# ResidualPostProcessor, compiled into the SPMD step)
+# ---------------------------------------------------------------------------
+def strom_encode_decode(update, residual, threshold):
+    """One worker's Strom-2015 threshold quantization, in-graph:
+    entries of (update + residual) with |u| >= t are transmitted as
+    sign(u) * t; everything else stays in the residual for later steps
+    (ref: `EncodingHandler.java:51` — the wire format is the sparse
+    index stream; on ICI the psum carries the decoded-dense equivalent,
+    so semantics — quantization + residual carry — are preserved while
+    the transport is the compiled collective).
+
+    Returns (decoded, new_residual)."""
+    u = update + residual
+    fire = jnp.abs(u) >= threshold
+    decoded = jnp.where(fire, jnp.sign(u) * threshold,
+                        jnp.zeros((), u.dtype))
+    return decoded, u - decoded
+
+
+def adapt_threshold(threshold, sparsity, min_sparsity=1e-4,
+                    max_sparsity=1e-2, adapt_factor=1.2):
+    """Jit-friendly AdaptiveThresholdAlgorithm: multiplicative nudge
+    keeping the fired fraction inside the target band (ref:
+    `AdaptiveThresholdAlgorithm.java` — raise t when too dense, lower
+    when too sparse)."""
+    too_dense = sparsity > max_sparsity
+    too_sparse = sparsity < min_sparsity
+    return jnp.where(too_dense, threshold * adapt_factor,
+                     jnp.where(too_sparse, threshold / adapt_factor,
+                               threshold))
+
+
+# ---------------------------------------------------------------------------
 # adaptive threshold (ref: ThresholdAlgorithm + AdaptiveThresholdAlgorithm)
 # ---------------------------------------------------------------------------
 class EncodingHandler:

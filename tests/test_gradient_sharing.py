@@ -1,0 +1,146 @@
+"""Gradient-sharing (compressed update bus) wired into ParallelWrapper
+training (VERDICT r3 #4 — ref: `EncodedGradientsAccumulator.java:286-314`,
+`StochasticGradientDescent.java:52-93`, `EncodingHandler.java:51`).
+
+Runs on the virtual 8-device CPU mesh (conftest), the reference's
+DummyTransport analogue. The contract under test: training through the
+threshold-quantized + residual-carried bus converges to within epsilon of
+dense all-reduce training."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+from deeplearning4j_tpu.learning import Sgd
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel import (GradientSharingAccumulator,
+                                         ParallelWrapper)
+from deeplearning4j_tpu.parallel.compression import (adapt_threshold,
+                                                     strom_encode_decode)
+
+
+def _conf(seed=7):
+    return (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+            .input_type_feed_forward(4).build())
+
+
+def _data(n=512, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 4).astype(np.float32) * 2 - 1
+    y = (x.sum(-1) > 0).astype(np.int64)
+    return x, np.eye(2, dtype=np.float32)[y]
+
+
+def _losses_over(model, wrapper, x, y, epochs):
+    losses = []
+    for _ in range(epochs):
+        wrapper.fit(ArrayDataSetIterator(x, y, batch=128, shuffle=False),
+                    epochs=1)
+        losses.append(model.score_)
+    return losses
+
+
+class TestStromPrimitives:
+    def test_encode_decode_quantizes_and_carries_residual(self):
+        u = jnp.asarray([0.5, -0.3, 0.05, 0.0, -2.0])
+        r = jnp.zeros(5)
+        dec, res = strom_encode_decode(u, r, 0.1)
+        np.testing.assert_allclose(np.asarray(dec),
+                                   [0.1, -0.1, 0.0, 0.0, -0.1], atol=1e-7)
+        # residual keeps everything the wire dropped
+        np.testing.assert_allclose(np.asarray(dec + res), np.asarray(u),
+                                   atol=1e-7)
+
+    def test_residual_eventually_fires(self):
+        # a sub-threshold signal accumulates and fires within ceil(t/u)
+        u = jnp.full((1,), 0.03)
+        r = jnp.zeros(1)
+        fired = 0.0
+        for _ in range(4):
+            dec, r = strom_encode_decode(u, r, 0.1)
+            fired += float(dec[0])
+        assert fired > 0.0  # 4 * 0.03 = 0.12 > 0.1 -> fired once
+        assert abs(4 * 0.03 - (fired + float(r[0]))) < 1e-6
+
+    def test_adapt_threshold_moves_toward_band(self):
+        t = jnp.asarray(1e-3)
+        assert float(adapt_threshold(t, 0.5)) > 1e-3       # too dense
+        assert float(adapt_threshold(t, 1e-6)) < 1e-3      # too sparse
+        assert float(adapt_threshold(t, 5e-3)) == pytest.approx(1e-3)
+
+
+class TestGradientSharingTraining:
+    def test_quantized_training_learns_with_sparsity_in_band(self):
+        """Strom semantics: each fired entry transmits sign * threshold
+        (NOT its value), so dense equality is never exact — the
+        guarantees are (a) error feedback: the residual keeps what the
+        wire dropped (TestStromPrimitives), (b) training still learns,
+        (c) the adaptive threshold lands the fired fraction in the
+        configured band (ref: AdaptiveThresholdAlgorithm's contract)."""
+        x, y = _data()
+        comp = MultiLayerNetwork(_conf()).init()
+        acc = GradientSharingAccumulator(threshold=1e-3, adaptive=True,
+                                         min_sparsity=1e-3,
+                                         max_sparsity=0.5)
+        lc = _losses_over(comp, ParallelWrapper(comp, accumulator=acc),
+                          x, y, 12)
+        assert lc[-1] < lc[0] - 0.05, lc
+        assert 1e-3 * 0.5 <= float(acc.last_sparsity) <= 0.5 * 1.2
+
+    def test_realistic_threshold_converges_within_eps_of_dense(self):
+        """The convergence-parity bar from the verdict: compressed
+        training ends within epsilon of dense all-reduce."""
+        x, y = _data()
+        dense = MultiLayerNetwork(_conf()).init()
+        comp = MultiLayerNetwork(_conf()).init()
+        ld = _losses_over(dense, ParallelWrapper(dense), x, y, 30)
+        acc = GradientSharingAccumulator(threshold=1e-3)
+        pw = ParallelWrapper(comp, accumulator=acc)
+        lc = _losses_over(comp, pw, x, y, 30)
+        assert lc[-1] < ld[0], "compressed training did not learn"
+        assert abs(lc[-1] - ld[-1]) < 0.1, (lc[-1], ld[-1])
+        ev = comp.evaluate(ArrayDataSetIterator(x, y, batch=128))
+        assert ev.accuracy() > 0.9, ev.stats()
+
+    def test_residual_state_carries_between_steps(self):
+        x, y = _data(n=128)
+        model = MultiLayerNetwork(_conf()).init()
+        acc = GradientSharingAccumulator(threshold=0.05, adaptive=False)
+        pw = ParallelWrapper(model, accumulator=acc)
+        pw.fit(ArrayDataSetIterator(x, y, batch=128, shuffle=False),
+               epochs=2)
+        res_leaves = jax.tree_util.tree_leaves(acc.residuals)
+        assert res_leaves, "no residual state installed"
+        total = sum(float(jnp.sum(jnp.abs(l))) for l in res_leaves)
+        assert total > 0.0, "residuals never carried anything"
+        # each worker keeps its OWN residual (leading device axis)
+        assert res_leaves[0].shape[0] == 8
+
+    def test_adaptive_threshold_reacts_to_sparsity(self):
+        x, y = _data(n=256)
+        model = MultiLayerNetwork(_conf()).init()
+        # absurdly small start threshold -> everything fires -> adapt up
+        acc = GradientSharingAccumulator(threshold=1e-9, adaptive=True)
+        pw = ParallelWrapper(model, accumulator=acc)
+        pw.fit(ArrayDataSetIterator(x, y, batch=128, shuffle=False),
+               epochs=4)
+        assert float(acc.threshold) > 1e-9
+        assert 0.0 <= float(acc.last_sparsity) <= 1.0
+
+    def test_compressed_step_keeps_params_replicated(self):
+        """Every device must hold identical params after a compressed
+        step (the updater consumes the SAME psum'd update everywhere)."""
+        x, y = _data(n=128)
+        model = MultiLayerNetwork(_conf()).init()
+        acc = GradientSharingAccumulator(threshold=1e-3)
+        pw = ParallelWrapper(model, accumulator=acc)
+        pw.fit(ArrayDataSetIterator(x, y, batch=128, shuffle=False),
+               epochs=1)
+        for leaf in jax.tree_util.tree_leaves(model._params):
+            # fully-replicated arrays are fully addressable on each device
+            assert leaf.sharding.is_fully_replicated, leaf.sharding
