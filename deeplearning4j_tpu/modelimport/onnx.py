@@ -76,15 +76,15 @@ def _parse_attr(buf: bytes) -> Tuple[str, Any]:
             val = v.decode("utf-8", "replace")
         elif f == 5:  # t (tensor)
             val = _parse_tensor(v)
-        elif f == 7:  # ints (repeated)
+        elif f == 8:  # ints (repeated) — AttributeProto field 8
             if wt == 2:
                 pos = 0
                 while pos < len(v):
                     iv, pos = _read_varint(v, pos)
-                    ints.append(iv)
+                    ints.append(iv if iv < (1 << 62) else iv - (1 << 64))
             else:
-                ints.append(v)
-        elif f == 6:  # floats
+                ints.append(v if v < (1 << 62) else v - (1 << 64))
+        elif f == 7:  # floats (repeated) — AttributeProto field 7
             if wt == 2:
                 floats.extend(struct.unpack(f"<{len(v)//4}f", v))
             else:
@@ -187,14 +187,19 @@ class OnnxGraphMapper:
         for name, arr in inits.items():
             env[name] = sd.constant(arr, name=name.replace("/", "_")
                                     .replace(".", "_"))
+        graph_inputs = []
         for name, shape in inputs:
             if name in env:
                 continue  # initializer doubling as graph input
             shape = None if shape is None else [
                 None if (d is None or d == 0) else int(d) for d in shape]
             env[name] = sd.placeholder(name.replace("/", "_"), shape)
+            graph_inputs.append(env[name].name)
         for n in nodes:
             OnnxGraphMapper._map_node(sd, n, env)
+        # positional input/output names for callers feeding by order
+        # (mirrors TFGraphMapper's tf_name_map contract)
+        sd._onnx_inputs = graph_inputs
         sd._onnx_outputs = [env[o].name for o in outputs]
         return sd
 
@@ -217,7 +222,25 @@ class OnnxGraphMapper:
         def const_of(name):
             return np.asarray(sd.get_variable(env[name].name).get_arr())
 
-        if op == "Gemm":
+        if op == "Constant":
+            # value arrives as a TensorProto attribute (value / value_float
+            # / value_int variants; torch emits `value`)
+            val = a.get("value")
+            if val is None:
+                val = np.asarray(a.get("value_float",
+                                       a.get("value_int", 0.0)))
+            env[n.outputs[0]] = sd.constant(np.asarray(val), name=safe)
+        elif op == "Shape":
+            shape = env[ins[0]].shape
+            if shape is None or any(s is None for s in shape):
+                raise ValueError("Shape op on dynamic input unsupported")
+            env[n.outputs[0]] = sd.constant(
+                np.asarray(shape, np.int64), name=safe)
+        elif op in ("Cast", "CastLike"):
+            to = {1: "float32", 6: "int32", 7: "int64", 9: "bool",
+                  11: "float64"}.get(a.get("to", 1), "float32")
+            rec("cast", env[ins[0]], dtype=to)
+        elif op == "Gemm":
             alpha = a.get("alpha", 1.0)
             beta = a.get("beta", 1.0)
             x, w = env[ins[0]], env[ins[1]]
@@ -313,16 +336,45 @@ class OnnxGraphMapper:
             y = x * scale + shift
             y.rename(safe)
             env[n.outputs[0]] = y
-        elif op == "ReduceMean":
-            rec("reduce_mean", env[ins[0]],
-                axes=tuple(a.get("axes", [])) or None,
+        elif op in ("ReduceMean", "ReduceSum", "ReduceMax", "ReduceMin"):
+            # opset 18 moved `axes` from attribute to a (constant) input
+            if len(ins) > 1 and ins[1]:
+                axes = tuple(int(i) for i in const_of(ins[1]).ravel())
+            else:
+                axes = tuple(a.get("axes", [])) or None
+            cat = {"ReduceMean": "reduce_mean", "ReduceSum": "reduce_sum",
+                   "ReduceMax": "reduce_max", "ReduceMin": "reduce_min"}
+            rec(cat[op], env[ins[0]], axes=axes,
                 keep_dims=bool(a.get("keepdims", 1)))
         elif op == "Clip":
-            lo = float(const_of(ins[1])) if len(ins) > 2 else \
-                a.get("min", -np.inf)
-            hi = float(const_of(ins[2])) if len(ins) > 2 else \
-                a.get("max", np.inf)
-            rec("clipbyvalue", env[ins[0]], clip_min=lo, clip_max=hi)
+            # opset 11+ carries min/max as optional (constant) inputs
+            lo = float(const_of(ins[1]).ravel()[0]) \
+                if len(ins) > 1 and ins[1] else a.get("min", -np.inf)
+            hi = float(const_of(ins[2]).ravel()[0]) \
+                if len(ins) > 2 and ins[2] else a.get("max", np.inf)
+            rec("clipbyvalue", env[ins[0]], lo, hi)
+        elif op == "Unsqueeze":
+            if len(ins) > 1 and ins[1]:
+                axes = [int(i) for i in const_of(ins[1]).ravel()]
+            else:
+                axes = list(a.get("axes", []))
+            x = env[ins[0]]
+            shape = list(x.shape)
+            for ax in sorted(axes):
+                shape.insert(ax if ax >= 0 else ax + len(shape) + 1, 1)
+            rec("reshape", x, shape=tuple(int(s) for s in shape))
+        elif op == "Squeeze":
+            if len(ins) > 1 and ins[1]:
+                axes = [int(i) for i in const_of(ins[1]).ravel()]
+            else:
+                axes = list(a.get("axes", []))
+            x = env[ins[0]]
+            shape = [s for i, s in enumerate(x.shape)
+                     if not (i in axes or i - len(x.shape) in axes)]
+            rec("reshape", x, shape=tuple(int(s) for s in shape))
+        elif op == "Gather":
+            rec("gather", env[ins[0]], env[ins[1]],
+                axis=a.get("axis", 0))
         else:
             raise ValueError(f"unsupported ONNX op {op!r} (node "
                              f"{n.name!r}); extend OnnxGraphMapper")
