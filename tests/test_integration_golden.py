@@ -1,0 +1,48 @@
+"""Golden-file integration regression runner (VERDICT r3 #7 — ref:
+`dl4j-integration-tests/.../IntegrationTestRunner.java`: each TestCase's
+predictions, parameters, and scores after N seeded updates are compared
+against checked-in baselines generated once).
+
+If a legitimate change alters numerics (e.g. a new updater formula),
+regenerate with tests/fixtures/integration/generate.py and commit the
+diff — exactly the reference's baseline-regeneration workflow.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from integration_cases import CASES, N_STEPS, run_case
+
+BASE = os.path.join(os.path.dirname(__file__), "fixtures", "integration")
+
+
+def _load(name):
+    data = np.load(os.path.join(BASE, f"{name}.npz"))
+    params = {k[2:]: data[k] for k in data.files if k.startswith("p:")}
+    return params, data["__preds__"], data["__losses__"]
+
+
+def test_baselines_are_committed():
+    missing = [n for n in CASES
+               if not os.path.exists(os.path.join(BASE, f"{n}.npz"))]
+    assert not missing, (
+        f"missing golden baselines {missing}; run "
+        "tests/fixtures/integration/generate.py and commit the outputs")
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_case_matches_golden(name):
+    want_params, want_preds, want_losses = _load(name)
+    got_params, got_preds, got_losses = run_case(name)
+    assert set(got_params) == set(want_params), (
+        set(got_params) ^ set(want_params))
+    # losses first: the most interpretable drift signal
+    np.testing.assert_allclose(got_losses, want_losses, rtol=1e-5,
+                               atol=1e-6, err_msg=f"{name}: loss curve")
+    np.testing.assert_allclose(got_preds, want_preds, rtol=1e-4,
+                               atol=1e-5, err_msg=f"{name}: predictions")
+    for k in sorted(want_params):
+        np.testing.assert_allclose(
+            got_params[k], want_params[k], rtol=1e-4, atol=1e-5,
+            err_msg=f"{name}: param {k} after {N_STEPS} steps")
