@@ -3,6 +3,7 @@ DefaultTokenizerFactory.java`, `NGramTokenizerFactory.java`,
 `tokenizer/preprocessor/CommonPreprocessor.java`)."""
 from __future__ import annotations
 
+import itertools
 import re
 from typing import Callable, List, Optional
 
@@ -73,3 +74,86 @@ class NGramTokenizerFactory(DefaultTokenizerFactory):
             for i in range(len(base) - n + 1):
                 out.append(" ".join(base[i:i + n]))
         return _Tokenizer(out)
+
+
+# ---------------------------------------------------------------------------
+# CJK-aware tokenization (ref: deeplearning4j-nlp-parent's
+# ChineseTokenizerFactory (ansj), JapaneseTokenizerFactory (kuromoji),
+# KoreanTokenizerFactory; those wrap JVM segmenter libraries with no
+# Python/TPU counterpart in this image, so the capability — tokenizing
+# unsegmented CJK text — is provided self-contained via Unicode-script
+# segmentation with the CJKAnalyzer-style ideograph bigram scheme.)
+# ---------------------------------------------------------------------------
+
+def _char_script(ch: str) -> str:
+    cp = ord(ch)
+    if 0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF \
+            or 0xF900 <= cp <= 0xFAFF \
+            or 0x20000 <= cp <= 0x2EBEF or 0x2F800 <= cp <= 0x2FA1F \
+            or 0x30000 <= cp <= 0x323AF:
+        return "han"  # BMP + extensions B..H + compatibility planes
+    if 0x3040 <= cp <= 0x309F:
+        return "hiragana"
+    if 0x30A0 <= cp <= 0x30FF or 0x31F0 <= cp <= 0x31FF \
+            or 0xFF66 <= cp <= 0xFF9F:
+        return "katakana"  # incl. halfwidth forms + voicing marks
+    if 0xAC00 <= cp <= 0xD7AF or 0x1100 <= cp <= 0x11FF \
+            or 0x3130 <= cp <= 0x318F or 0xFFA0 <= cp <= 0xFFDC:
+        return "hangul"  # incl. halfwidth jamo
+    if ch.isalnum():
+        return "word"
+    return "other"
+
+
+class CJKTokenizerFactory(DefaultTokenizerFactory):
+    """Segment mixed CJK/Latin text without a dictionary segmenter:
+
+    - Latin/digit runs -> whole words (as DefaultTokenizerFactory),
+    - Han ideograph runs -> overlapping bigrams (Lucene CJKAnalyzer
+      scheme; ``unigrams=True`` switches to per-character),
+    - kana runs -> one token per run (katakana loanwords stay whole),
+    - Hangul runs -> one token per run (Korean is space-delimited;
+      syllable blocks inside a run stay together).
+
+    Role parity with ChineseTokenizerFactory / JapaneseTokenizerFactory /
+    KoreanTokenizerFactory — dictionary-based morphological analysis is
+    out of scope in-image (JVM-only libs, zero egress)."""
+
+    def __init__(self, unigrams: bool = False,
+                 preprocessor: Optional[CommonPreprocessor] = None):
+        super().__init__(preprocessor)
+        self.unigrams = bool(unigrams)
+
+    def _segment(self, text: str) -> List[str]:
+        out: List[str] = []
+        for sc, group in itertools.groupby(text, key=_char_script):
+            if sc == "other":
+                continue
+            run = "".join(group)
+            if sc == "han" and not self.unigrams and len(run) > 1:
+                out.extend(run[i:i + 2] for i in range(len(run) - 1))
+            elif sc == "han" and self.unigrams:
+                out.extend(run)
+            else:
+                out.append(run)
+        return out
+
+    def create(self, text: str) -> _Tokenizer:
+        toks = self._segment(text)
+        if self.preprocessor is not None:
+            toks = [self.preprocessor.pre_process(t) for t in toks]
+        return _Tokenizer([t for t in toks if t])
+
+
+class UnicodeTokenizerFactory(DefaultTokenizerFactory):
+    """Unicode word-boundary tokenizer (ref: UimaTokenizerFactory's role
+    — language-agnostic tokenization without per-language config; UIMA
+    itself is a JVM framework with no counterpart here)."""
+
+    _WORD = re.compile(r"\w+", re.UNICODE)
+
+    def create(self, text: str) -> _Tokenizer:
+        toks = self._WORD.findall(text)
+        if self.preprocessor is not None:
+            toks = [self.preprocessor.pre_process(t) for t in toks]
+        return _Tokenizer([t for t in toks if t])

@@ -757,3 +757,5 @@ from . import convolutional  # noqa: E402,F401  (registers conv-family layers)
 from .attention import (SelfAttentionLayer,  # noqa: E402,F401
                         TransformerEncoderLayer)
 from .variational import VariationalAutoencoder  # noqa: E402,F401
+from .specialized_outputs import (CenterLossOutputLayer,  # noqa: E402,F401
+                                  OCNNOutputLayer)

@@ -1,0 +1,172 @@
+"""Specialized output layers (ref: deeplearning4j-nn
+`nn/conf/layers/CenterLossOutputLayer.java` +
+`nn/layers/training/CenterLossOutputLayer.java`, and
+`nn/conf/layers/misc/OCNNOutputLayer.java` +
+`nn/layers/ocnn/OCNNOutputLayer.java`) — the last two D2 inventory rows.
+
+TPU-first redesign notes:
+
+- CenterLoss (Wen et al. 2016): the reference updates class centers with
+  a dedicated alpha moving-average pass inside backprop. Here centers
+  are ordinary params and the center term's gradient (lambda * (c_y - x)
+  per assigned sample) IS the update — the paper's center update rule is
+  exactly a scaled gradient step, so the same jitted updater chain
+  covers it (alpha maps to the learning rate on the centers).
+- OCNN (Chalapathy et al. 2018): the reference re-solves the bias r as
+  the nu-quantile of scores every windowSize iterations on the host.
+  Here r is a parameter of the same jitted loss: d/dr of
+  (1/nu)*mean(relu(r - s)) - r vanishes exactly when
+  P(s < r) = nu, so gradient descent drives r to the nu-quantile with
+  no host round-trip or dynamic control flow — the XLA-friendly form of
+  the same alternating optimization.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...weightinit import init_weights
+from . import Layer, OutputLayer, register
+
+
+class CenterLossOutputLayer(OutputLayer):
+    """Softmax head + center loss: total = CE + (lambda/2) * mean
+    ||x - c_y||^2 (ref: CenterLossOutputLayer.java — alpha/lambda/
+    gradientCheck config at :~50)."""
+
+    kind = "centerloss_output"
+
+    def __init__(self, n_out: int = None, alpha: float = 0.05,
+                 lambda_: float = 2e-4, **kw):
+        super().__init__(n_out=n_out, **kw)
+        self.alpha = float(alpha)
+        self.lambda_ = float(lambda_)
+
+    def param_shapes(self):
+        sh = dict(super().param_shapes())
+        sh["centers"] = (self.n_out, self.n_in)
+        return sh
+
+    def init_params(self, rng, dtype=jnp.float32):
+        p = super().init_params(rng, dtype)
+        p["centers"] = jnp.zeros((self.n_out, self.n_in), dtype)
+        return p
+
+    def bias_param_names(self) -> set:
+        # centers are not weights: exempt from l1/l2 weight decay and
+        # from weight noise/constraints (ref: centers bypass the
+        # regular updater's regularization entirely)
+        return super().bias_param_names() | {"centers"}
+
+    def compute_loss(self, params, x, labels, mask=None, train: bool = False,
+                     rng=None):
+        if getattr(self, "_flatten_input", False) and x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        ce = self.loss.score(labels, super().pre_output(params, x, train,
+                                                        rng),
+                             self.activation, mask)
+        # center term: squared distance of each sample to ITS class
+        # center. alpha scales the centers' own gradient (their update
+        # rate) without changing the features' pull strength.
+        assigned = labels @ params["centers"]          # [B, n_in]
+        assigned = self.alpha * assigned + \
+            (1.0 - self.alpha) * jax.lax.stop_gradient(assigned)
+        d2 = jnp.sum(jnp.square(x - assigned), axis=-1)
+        if mask is not None and mask.ndim == 1:
+            d2 = d2 * mask
+            denom = jnp.maximum(jnp.sum(mask), 1.0)
+        else:
+            denom = d2.shape[0]
+        return ce + 0.5 * self.lambda_ * jnp.sum(d2) / denom
+
+    def _extra_json(self):
+        d = super()._extra_json()
+        d.update(alpha=self.alpha, lambda_=self.lambda_)
+        return d
+
+
+class OCNNOutputLayer(Layer):
+    """One-class NN output layer for anomaly detection (ref:
+    OCNNOutputLayer.java — hiddenSize/nu/initialRValue/windowSize
+    config; score = w·g(Vx) - r, objective eq. 4 of the paper):
+
+        L = 0.5||V||^2 + 0.5||w||^2 + (1/nu) mean relu(r - s) - r
+
+    `apply` returns the decision score s - r ([B, 1]); >= 0 means
+    inlier at the nu working point. Labels are ignored (one-class =
+    unsupervised), matching the reference layer which trains on
+    features only."""
+
+    kind = "ocnn_output"
+
+    def __init__(self, hidden_size: int = 100, nu: float = 0.04,
+                 initial_r: float = 0.1, window_size: int = 10000, **kw):
+        kw.setdefault("activation", "sigmoid")
+        super().__init__(**kw)
+        self.hidden_size = int(hidden_size)
+        self.nu = float(nu)
+        self.initial_r = float(initial_r)
+        self.window_size = int(window_size)  # accepted for API parity
+        self.n_in: Optional[int] = None
+
+    def build(self, input_shape, defaults=None):
+        super().build(input_shape, defaults)
+        self._flatten_input = len(input_shape) == 3
+        self.n_in = int(math.prod(input_shape)) if self._flatten_input \
+            else int(input_shape[-1])
+
+    def param_shapes(self):
+        return {"V": (self.n_in, self.hidden_size),
+                "w": (self.hidden_size, 1),
+                "r_b": (1,)}
+
+    def bias_param_names(self) -> set:
+        return {"r_b"}
+
+    def init_params(self, rng, dtype=jnp.float32):
+        kV, kw_ = jax.random.split(rng)
+        return {"V": init_weights(kV, (self.n_in, self.hidden_size),
+                                  self.n_in, self.hidden_size,
+                                  self.weight_init, dtype),
+                "w": init_weights(kw_, (self.hidden_size, 1),
+                                  self.hidden_size, 1, self.weight_init,
+                                  dtype),
+                "r_b": jnp.full((1,), self.initial_r, dtype)}
+
+    def _score(self, params, x, train=False, rng=None):
+        if getattr(self, "_flatten_input", False) and x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        x = self._maybe_dropout(x, train, rng)
+        return self.activation(x @ params["V"]) @ params["w"]   # [B, 1]
+
+    def apply(self, params, x, state, train, rng):
+        s = self._score(params, x, train, rng)
+        return s - params["r_b"], state
+
+    def output_shape(self, input_shape) -> Tuple[int, ...]:
+        return (1,)
+
+    def compute_loss(self, params, x, labels=None, mask=None,
+                     train: bool = False, rng=None):
+        s = self._score(params, x, train, rng)[:, 0]
+        r = params["r_b"][0]
+        hinge = jnp.maximum(0.0, r - s)
+        if mask is not None and mask.ndim == 1:
+            mean_h = jnp.sum(hinge * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        else:
+            mean_h = jnp.mean(hinge)
+        reg = 0.5 * jnp.sum(jnp.square(params["V"])) \
+            + 0.5 * jnp.sum(jnp.square(params["w"]))
+        return reg + mean_h / self.nu - r
+
+    def _extra_json(self):
+        return {"hidden_size": self.hidden_size, "nu": self.nu,
+                "initial_r": self.initial_r,
+                "window_size": self.window_size}
+
+
+register(CenterLossOutputLayer)
+register(OCNNOutputLayer)

@@ -20,7 +20,9 @@ from .mdp import MDP, CartPole, GridWorld
 from .policy import BoltzmannPolicy, EpsGreedy, GreedyPolicy, play
 from .qlearning import QLearningConfiguration, QLearningDiscrete
 from .a3c import A3C, A3CConfiguration
+from .gym import GymClient, GymClientError, GymEnv
 
 __all__ = ["MDP", "CartPole", "GridWorld", "QLearningDiscrete",
            "QLearningConfiguration", "A3C", "A3CConfiguration",
-           "EpsGreedy", "GreedyPolicy", "BoltzmannPolicy", "play"]
+           "EpsGreedy", "GreedyPolicy", "BoltzmannPolicy", "play",
+           "GymClient", "GymClientError", "GymEnv"]

@@ -1,0 +1,147 @@
+"""Gym HTTP client (ref: `gym-java-client/` — a ~1k-LoC REST client for
+the OpenAI gym-http-api server: `ClientFactory.java`, `Client.java`
+with envCreate/envReset/envStep/envClose, `GymObservationSpace.java`,
+and `rl4j-gym`'s `GymEnv` adapter onto the MDP SPI).
+
+Same protocol, Python-native: the client speaks the gym-http-api JSON
+REST surface (POST /v1/envs/, POST /v1/envs/{id}/reset/,
+POST /v1/envs/{id}/step/, GET action/observation space, DELETE close)
+over stdlib http.client, and :class:`GymEnv` adapts a remote env onto
+this framework's :class:`~deeplearning4j_tpu.rl.mdp.MDP` interface so
+every agent (DQN/A3C) can train against a remote gym server unchanged.
+
+Testing follows the reference's DummyTransport philosophy: the suite
+runs an in-process fake gym-http-api server (no egress, no gym
+install) and drives the full client/env/agent path against it.
+"""
+from __future__ import annotations
+
+import json
+from http.client import HTTPConnection
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .mdp import MDP
+
+
+class GymClientError(RuntimeError):
+    pass
+
+
+class GymClient:
+    """REST client for a gym-http-api server (ref: `Client.java` —
+    the v1 route constants and the envCreate/reset/step calls)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 5000,
+                 timeout: float = 10.0):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+
+    # -- wire ----------------------------------------------------------
+    def _request(self, method: str, path: str,
+                 body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        conn = HTTPConnection(self.host, self.port, timeout=self.timeout)
+        try:
+            payload = None if body is None else json.dumps(body)
+            headers = {"Content-Type": "application/json"}
+            conn.request(method, path, payload, headers)
+            resp = conn.getresponse()
+            data = resp.read().decode("utf-8") or "{}"
+            if resp.status >= 400:
+                raise GymClientError(
+                    f"{method} {path} -> HTTP {resp.status}: {data[:200]}")
+            try:
+                return json.loads(data)
+            except json.JSONDecodeError as e:
+                raise GymClientError(
+                    f"{method} {path} -> malformed JSON body "
+                    f"{data[:200]!r}") from e
+        except (ConnectionError, OSError) as e:
+            raise GymClientError(
+                f"gym server unreachable at {self.host}:{self.port}: {e}"
+            ) from e
+        finally:
+            conn.close()
+
+    # -- gym-http-api surface (ref: Client.java route constants) -------
+    def env_create(self, env_id: str) -> str:
+        out = self._request("POST", "/v1/envs/", {"env_id": env_id})
+        return out["instance_id"]
+
+    def env_list(self) -> Dict[str, str]:
+        return self._request("GET", "/v1/envs/").get("all_envs", {})
+
+    def env_reset(self, instance_id: str) -> np.ndarray:
+        out = self._request("POST", f"/v1/envs/{instance_id}/reset/")
+        return np.asarray(out["observation"], np.float32)
+
+    def env_step(self, instance_id: str, action: int,
+                 render: bool = False) -> Tuple[np.ndarray, float, bool,
+                                                Dict[str, Any]]:
+        out = self._request(
+            "POST", f"/v1/envs/{instance_id}/step/",
+            {"action": int(action), "render": bool(render)})
+        return (np.asarray(out["observation"], np.float32),
+                float(out["reward"]), bool(out["done"]),
+                out.get("info", {}))
+
+    def env_action_space(self, instance_id: str) -> Dict[str, Any]:
+        return self._request(
+            "GET", f"/v1/envs/{instance_id}/action_space/")["info"]
+
+    def env_observation_space(self, instance_id: str) -> Dict[str, Any]:
+        return self._request(
+            "GET", f"/v1/envs/{instance_id}/observation_space/")["info"]
+
+    def env_close(self, instance_id: str) -> None:
+        self._request("POST", f"/v1/envs/{instance_id}/close/")
+
+    def env_monitor_start(self, instance_id: str, directory: str,
+                          force: bool = False) -> None:
+        self._request("POST", f"/v1/envs/{instance_id}/monitor/start/",
+                      {"directory": directory, "force": force})
+
+    def env_monitor_close(self, instance_id: str) -> None:
+        self._request("POST", f"/v1/envs/{instance_id}/monitor/close/")
+
+
+class GymEnv(MDP):
+    """Remote gym environment as an MDP (ref: rl4j-gym `GymEnv.java` —
+    wraps the client behind the MDP SPI so QLearning/A3C run on it
+    unchanged)."""
+
+    def __init__(self, env_id: str, client: Optional[GymClient] = None,
+                 host: str = "127.0.0.1", port: int = 5000):
+        self.client = client or GymClient(host, port)
+        self.env_id = env_id
+        self.instance_id = self.client.env_create(env_id)
+        act = self.client.env_action_space(self.instance_id)
+        obs = self.client.env_observation_space(self.instance_id)
+        if act.get("name") != "Discrete":
+            raise GymClientError(
+                f"only Discrete action spaces supported, got {act}")
+        self.n_actions = int(act["n"])
+        shape = obs.get("shape") or [1]
+        self.obs_size = int(np.prod(shape))
+        self._done = True
+
+    def reset(self) -> np.ndarray:
+        self._done = False
+        return self.client.env_reset(self.instance_id).reshape(-1)
+
+    def step(self, action: int) -> Tuple[np.ndarray, float, bool]:
+        obs, reward, done, _ = self.client.env_step(self.instance_id,
+                                                    action)
+        self._done = done
+        return obs.reshape(-1), reward, done
+
+    def is_done(self) -> bool:
+        return self._done
+
+    def close(self):
+        try:
+            self.client.env_close(self.instance_id)
+        except GymClientError:
+            pass

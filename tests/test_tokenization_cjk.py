@@ -1,0 +1,52 @@
+"""CJK/Unicode tokenizer variants (ref: deeplearning4j-nlp-parent's
+Chinese/Japanese/Korean tokenizer factories + UimaTokenizerFactory)."""
+from deeplearning4j_tpu.nlp.tokenization import (CJKTokenizerFactory,
+                                                 CommonPreprocessor,
+                                                 UnicodeTokenizerFactory)
+
+
+class TestCJKTokenizer:
+    def test_han_bigrams(self):
+        toks = CJKTokenizerFactory().tokenize("深度学习")
+        assert toks == ["深度", "度学", "学习"]
+
+    def test_han_unigrams(self):
+        toks = CJKTokenizerFactory(unigrams=True).tokenize("深度学习")
+        assert toks == ["深", "度", "学", "习"]
+
+    def test_mixed_cjk_latin(self):
+        toks = CJKTokenizerFactory().tokenize("用TPU训练模型fast")
+        assert "TPU" in toks and "fast" in toks
+        assert "训练" in toks and "练模" in toks and "模型" in toks
+
+    def test_japanese_kana_runs_stay_whole(self):
+        # katakana loanword stays one token; han bigrams around it
+        toks = CJKTokenizerFactory().tokenize("テンソル計算")
+        assert "テンソル" in toks
+        assert "計算" in toks
+
+    def test_hangul_runs(self):
+        toks = CJKTokenizerFactory().tokenize("딥러닝 모델")
+        assert toks == ["딥러닝", "모델"]
+
+    def test_word2vec_integration(self):
+        """CJK corpus through the Word2Vec stack end to end."""
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+        fac = CJKTokenizerFactory()
+        corpus = ["深度学习模型训练", "深度模型推理", "学习训练数据"] * 30
+        w2v = Word2Vec(layer_size=16, window_size=2, min_word_frequency=1,
+                       negative=3, seed=1, batch_size=64,
+                       tokenizer_factory=fac)
+        w2v.fit(corpus)
+        vec = w2v.word_vector("深度")
+        assert vec is not None and len(vec) == 16
+
+
+class TestUnicodeTokenizer:
+    def test_word_boundaries(self):
+        toks = UnicodeTokenizerFactory().tokenize("héllo wörld, foo-bar!")
+        assert toks == ["héllo", "wörld", "foo", "bar"]
+
+    def test_preprocessor_applies(self):
+        fac = UnicodeTokenizerFactory(preprocessor=CommonPreprocessor())
+        assert fac.tokenize("Hello WORLD 123") == ["hello", "world"]
