@@ -304,11 +304,29 @@ class EvaluationCalibration:
     probability histogram; expected calibration error added as the
     summary scalar)."""
 
-    def __init__(self, num_bins: int = 10):
+    def __init__(self, num_bins: int = 10, residual_bins: int = 20,
+                 histogram_bins: int = 20):
         self.num_bins = num_bins
+        self.residual_bins = int(residual_bins)
+        self.histogram_bins = int(histogram_bins)
         self._counts = np.zeros(num_bins)
         self._pos = np.zeros(num_bins)
         self._prob_sum = np.zeros(num_bins)
+        # residual/probability histograms are per-class, allocated when
+        # the class count is first seen (ref: EvaluationCalibration's
+        # residualPlot + predictionCounts structures)
+        self._n_classes: int = 0
+        self._residual_all = np.zeros(self.residual_bins)
+        self._residual_by_class = None   # [C, residual_bins]
+        self._prob_all = None            # [C, histogram_bins]
+        self._prob_when_true = None      # [C, histogram_bins]
+
+    def _ensure_classes(self, c: int):
+        if self._residual_by_class is None:
+            self._n_classes = c
+            self._residual_by_class = np.zeros((c, self.residual_bins))
+            self._prob_all = np.zeros((c, self.histogram_bins))
+            self._prob_when_true = np.zeros((c, self.histogram_bins))
 
     def eval(self, labels, predictions, mask=None):
         labels = np.asarray(labels)
@@ -318,9 +336,15 @@ class EvaluationCalibration:
             cls = pred.argmax(-1)
             p = np.take_along_axis(pred, cls[..., None], -1)[..., 0]
             hit = (labels.argmax(-1) == cls).astype(np.float64)
+            lab2 = labels.reshape(-1, labels.shape[-1])
+            pred2 = pred.reshape(-1, pred.shape[-1])
         else:
             p = pred
             hit = (labels > 0.5).astype(np.float64)
+            lab1 = labels.reshape(-1)
+            lab2 = np.stack([1.0 - lab1, lab1], -1)
+            pr1 = np.clip(pred.reshape(-1), 0.0, 1.0)
+            pred2 = np.stack([1.0 - pr1, pr1], -1)
         bins = np.clip((p * self.num_bins).astype(int), 0,
                        self.num_bins - 1)
         for b, h, pr in zip(bins.reshape(-1), hit.reshape(-1),
@@ -328,6 +352,48 @@ class EvaluationCalibration:
             self._counts[b] += 1
             self._pos[b] += h
             self._prob_sum[b] += pr
+        # residual plot: |label - predicted prob| over every
+        # (sample, class) cell, aggregate + per class (ref:
+        # EvaluationCalibration.getResidualPlotAllClasses / :classIdx)
+        self._ensure_classes(lab2.shape[-1])
+        resid = np.abs(lab2 - pred2)
+        rb = np.clip((resid * self.residual_bins).astype(int), 0,
+                     self.residual_bins - 1)
+        pb = np.clip((np.clip(pred2, 0, 1)
+                      * self.histogram_bins).astype(int), 0,
+                     self.histogram_bins - 1)
+        true_cls = lab2.argmax(-1)
+        for c in range(self._n_classes):
+            self._residual_by_class[c] += np.bincount(
+                rb[:, c], minlength=self.residual_bins)
+            self._residual_all += np.bincount(
+                rb[:, c], minlength=self.residual_bins)
+            self._prob_all[c] += np.bincount(
+                pb[:, c], minlength=self.histogram_bins)
+            sel = true_cls == c
+            if sel.any():
+                self._prob_when_true[c] += np.bincount(
+                    pb[sel, c], minlength=self.histogram_bins)
+
+    # -- residual / probability histograms (ref: getResidualPlot,
+    # getProbabilityHistogram in EvaluationCalibration.java) -----------
+    def residual_plot(self, class_idx=None):
+        """Histogram counts of |label - p| over [0, 1]; aggregated over
+        all classes when class_idx is None."""
+        if self._residual_by_class is None:
+            return np.zeros(self.residual_bins)
+        if class_idx is None:
+            return self._residual_all.copy()
+        return self._residual_by_class[class_idx].copy()
+
+    def probability_histogram(self, class_idx: int, when_true: bool = False):
+        """Distribution of predicted probabilities for class_idx over
+        [0, 1] — all samples, or only samples whose TRUE label is that
+        class (when_true)."""
+        if self._prob_all is None:
+            return np.zeros(self.histogram_bins)
+        src = self._prob_when_true if when_true else self._prob_all
+        return src[class_idx].copy()
 
     def reliability_curve(self):
         """Returns (mean predicted prob per bin, empirical accuracy per

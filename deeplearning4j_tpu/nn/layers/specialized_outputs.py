@@ -139,6 +139,11 @@ class OCNNOutputLayer(Layer):
     def _score(self, params, x, train=False, rng=None):
         if getattr(self, "_flatten_input", False) and x.ndim > 2:
             x = x.reshape(x.shape[0], -1)
+        if x.ndim != 2:
+            raise ValueError(
+                "OCNNOutputLayer expects flat [B, F] features; reduce "
+                "sequences first (LastTimeStep / GlobalPoolingLayer), "
+                f"got rank-{x.ndim} input")
         x = self._maybe_dropout(x, train, rng)
         return self.activation(x @ params["V"]) @ params["w"]   # [B, 1]
 

@@ -29,6 +29,7 @@ class ProfilingMode(Enum):
     INF_PANIC = "inf_panic"
     ANY_PANIC = "any_panic"
     OPERATIONS = "operations"
+    SCOPE_PANIC = "scope_panic"
     ALL = "all"
 
 
@@ -148,3 +149,95 @@ class ProfilerListener:
 
     def on_epoch_end(self, model):
         pass
+
+
+# ---------------------------------------------------------------------------
+# SCOPE_PANIC-style workspace lifetime validation (ref: the reference's
+# workspace validation — `DebugMode`/SCOPE_PANIC crash when an array
+# allocated inside a closed workspace scope is touched afterwards
+# (scope-panic message cited at `InferenceSession.java:39`; enums in
+# `nd4j-buffer/.../memory/enums/DebugMode.java`). XLA owns buffer
+# lifetimes on TPU, so the hazard this guards is the EAGER one: host
+# code holding a reference to an array whose workspace scope (or
+# donated buffer) is gone. The validator reproduces the crash-early
+# contract without native scopes.)
+# ---------------------------------------------------------------------------
+class ScopePanicException(ND4JOpProfilerException):
+    """Raised when a scope-tracked array is touched after its scope
+    closed (ref: the SCOPE_PANIC workspace error)."""
+
+
+class ScopedArray:
+    """Proxy handing out the underlying array only while its scope is
+    open. Unwraps via `.value`, `np.asarray(...)`, or jnp use (both go
+    through __array__). Carries the scope GENERATION it was tracked in,
+    so re-entering the same scope object does not resurrect arrays from
+    a previous pass."""
+
+    __slots__ = ("_arr", "_scope", "_gen")
+
+    def __init__(self, arr, scope):
+        self._arr = arr
+        self._scope = scope
+        self._gen = scope._gen
+
+    def _check(self):
+        if self._scope.closed or self._gen != self._scope._gen:
+            mode = OpProfiler.get_instance().mode
+            if mode in (ProfilingMode.SCOPE_PANIC, ProfilingMode.ALL):
+                raise ScopePanicException(
+                    f"array of shape {getattr(self._arr, 'shape', '?')} "
+                    f"used after workspace scope "
+                    f"'{self._scope.name}' closed (SCOPE_PANIC; ref "
+                    "Nd4jWorkspace scope validation)")
+        return self._arr
+
+    @property
+    def value(self):
+        return self._check()
+
+    def __array__(self, dtype=None, copy=None):
+        import numpy as _np
+        a = _np.asarray(self._check())
+        return a.astype(dtype) if dtype is not None else a
+
+    def __jax_array__(self):
+        return self._check()
+
+    @property
+    def shape(self):
+        return getattr(self._arr, "shape", None)
+
+    @property
+    def dtype(self):
+        return getattr(self._arr, "dtype", None)
+
+    def __repr__(self):
+        state = "CLOSED" if self._scope.closed else "open"
+        return f"ScopedArray(shape={self.shape}, scope={state})"
+
+
+class WorkspaceScope:
+    """Context manager mirroring `try (MemoryWorkspace ws =
+    ws.notifyScopeEntered())` semantics: arrays `track()`ed inside are
+    invalid after exit, and touching them raises under SCOPE_PANIC."""
+
+    def __init__(self, name: str = "WS"):
+        self.name = name
+        self.closed = False
+        self._gen = 0
+
+    def track(self, arr) -> ScopedArray:
+        if self.closed:
+            raise ScopePanicException(
+                f"cannot allocate in closed scope '{self.name}'")
+        return ScopedArray(arr, self)
+
+    def __enter__(self):
+        self.closed = False
+        self._gen += 1
+        return self
+
+    def __exit__(self, *exc):
+        self.closed = True
+        return False

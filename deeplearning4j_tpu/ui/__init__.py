@@ -82,17 +82,27 @@ class FileStatsStorage:
 # ---------------------------------------------------------------------------
 class StatsListener(TrainingListener):
     """Collects per-iteration score + parameter/update statistics into a
-    StatsStorage (ref: StatsListener collects score, param/update/
-    activation mean magnitudes + histograms; the mean-magnitude core is
-    reproduced here)."""
+    StatsStorage (ref: StatsListener collects score, param/update
+    mean magnitudes + histograms — the inputs to the reference UI's
+    overview/model tabs, including the update:param ratio chart)."""
 
     def __init__(self, storage, session_id: Optional[str] = None,
-                 report_every: int = 1, collect_params: bool = True):
+                 report_every: int = 1, collect_params: bool = True,
+                 collect_histograms: bool = False, histogram_bins: int = 20):
         self.storage = storage
         self.session_id = session_id or f"session_{int(time.time())}"
         self.report_every = report_every
         self.collect_params = collect_params
+        self.collect_histograms = collect_histograms
+        self.histogram_bins = int(histogram_bins)
         self._last_time = None
+        self._prev_params: Optional[dict] = None
+
+    @staticmethod
+    def _flat_items(params):
+        for lkey, ptree in params.items():
+            for pname, arr in ptree.items():
+                yield f"{lkey}.{pname}", np.asarray(arr)
 
     def iteration_done(self, model, iteration: int, epoch: int):
         if iteration % self.report_every:
@@ -104,12 +114,29 @@ class StatsListener(TrainingListener):
             update["iter_seconds"] = now - self._last_time
         self._last_time = now
         if self.collect_params and getattr(model, "_params", None):
-            mm = {}
-            for lkey, ptree in model._params.items():
-                for pname, arr in ptree.items():
-                    a = np.asarray(arr)
-                    mm[f"{lkey}.{pname}"] = float(np.mean(np.abs(a)))
+            mm, um, hists, snap = {}, {}, {}, {}
+            for name, a in self._flat_items(model._params):
+                snap[name] = a  # one device->host fetch per param
+                mm[name] = float(np.mean(np.abs(a)))
+                # update magnitude = |param delta| since last report
+                # (the updater's applied step — ref StatsListener's
+                # update stats, which feed the log10 update:param
+                # ratio chart used for LR tuning)
+                if self._prev_params is not None and \
+                        name in self._prev_params:
+                    um[name] = float(np.mean(np.abs(
+                        a - self._prev_params[name])))
+                if self.collect_histograms:
+                    counts, edges = np.histogram(a, bins=self.histogram_bins)
+                    hists[name] = {"counts": counts.tolist(),
+                                   "min": float(edges[0]),
+                                   "max": float(edges[-1])}
             update["param_mean_magnitudes"] = mm
+            if um:
+                update["update_mean_magnitudes"] = um
+            if hists:
+                update["param_histograms"] = hists
+            self._prev_params = snap
         self.storage.put_update(self.session_id, update)
 
 
@@ -117,33 +144,108 @@ class StatsListener(TrainingListener):
 # server (ref: PlayUIServer attach :337)
 # ---------------------------------------------------------------------------
 _PAGE = """<!doctype html><html><head><title>dl4j-tpu training UI</title>
-<style>body{font-family:sans-serif;margin:2em}#chart{border:1px solid #ccc}
-</style></head><body><h2>Training score</h2>
-<select id=sess></select> <canvas id=chart width=800 height=300></canvas>
+<style>body{font-family:sans-serif;margin:2em}canvas{border:1px solid #ccc}
+h3{margin-bottom:4px}#sys{font-size:13px;color:#444}</style></head><body>
+<h2>dl4j-tpu training UI</h2>
+<select id=sess></select> <select id=param></select>
+<h3>Score vs iteration</h3><canvas id=score width=800 height=240></canvas>
+<h3>Mean magnitudes: parameters (blue) / updates (orange)</h3>
+<canvas id=mags width=800 height=200></canvas>
+<h3>log10 update:param ratio (healthy ~ -3)</h3>
+<canvas id=ratio width=800 height=160></canvas>
+<h3>Latest parameter histogram</h3>
+<canvas id=hist width=800 height=160></canvas>
+<h3>System</h3><pre id=sys></pre>
 <script>
-async function sessions(){
-  const s = await (await fetch('/sessions')).json();
-  const sel = document.getElementById('sess');
-  sel.innerHTML = s.map(x=>`<option>${x}</option>`).join('');
-  if (s.length) draw(s[0]);
-  sel.onchange = () => draw(sel.value);
-}
-async function draw(id){
-  const u = await (await fetch('/train/'+id+'/overview')).json();
-  const c = document.getElementById('chart').getContext('2d');
-  c.clearRect(0,0,800,300);
-  const xs = u.map(p=>p.iteration), ys = u.map(p=>p.score);
+let CUR = null, PARAM = null;
+function line(cv, xs, ys, color, clear=true){
+  const c = document.getElementById(cv).getContext('2d');
+  const W = c.canvas.width, H = c.canvas.height;
+  if (clear) c.clearRect(0,0,W,H);
   if (!xs.length) return;
   const xmax = Math.max(...xs), ymax = Math.max(...ys),
         ymin = Math.min(...ys);
   c.beginPath();
-  u.forEach((p,i)=>{const x = 10+780*p.iteration/Math.max(xmax,1);
-    const y = 290-280*(p.score-ymin)/Math.max(ymax-ymin,1e-9);
-    i?c.lineTo(x,y):c.moveTo(x,y);});
-  c.strokeStyle='#2060c0'; c.stroke();
+  xs.forEach((x,i)=>{const px = 10+(W-20)*x/Math.max(xmax,1);
+    const py = H-10-(H-20)*(ys[i]-ymin)/Math.max(ymax-ymin,1e-12);
+    i?c.lineTo(px,py):c.moveTo(px,py);});
+  c.strokeStyle=color; c.stroke();
+  c.fillStyle='#888'; c.font='11px sans-serif';
+  c.fillText(ymax.toPrecision(4), 2, 10);
+  c.fillText(ymin.toPrecision(4), 2, H-2);
+}
+function bars(cv, counts, lo, hi){
+  const c = document.getElementById(cv).getContext('2d');
+  const W = c.canvas.width, H = c.canvas.height;
+  c.clearRect(0,0,W,H);
+  if (!counts || !counts.length) return;
+  const m = Math.max(...counts), bw = (W-20)/counts.length;
+  c.fillStyle='#2060c0';
+  counts.forEach((n,i)=>c.fillRect(10+i*bw, H-10-(H-20)*n/Math.max(m,1),
+                                   bw-1, (H-20)*n/Math.max(m,1)));
+  c.fillStyle='#888'; c.font='11px sans-serif';
+  c.fillText(lo.toPrecision(3), 2, H-2);
+  c.fillText(hi.toPrecision(3), W-60, H-2);
+}
+async function sessions(){
+  const s = await (await fetch('/sessions')).json();
+  const sel = document.getElementById('sess');
+  const had = CUR;
+  sel.innerHTML = s.map(x=>`<option>${x}</option>`).join('');
+  if (had && s.includes(had)) sel.value = had;
+  if (s.length) { CUR = sel.value; draw(); }
+  sel.onchange = () => { CUR = sel.value; PARAM = null; draw(); };
+  const sys = await (await fetch('/system')).json();
+  document.getElementById('sys').textContent =
+    JSON.stringify(sys, null, 1);
+}
+async function draw(){
+  if (!CUR) return;
+  const u = await (await fetch('/train/'+CUR+'/overview')).json();
+  line('score', u.map(p=>p.iteration), u.map(p=>p.score), '#2060c0');
+  const m = await (await fetch('/train/'+CUR+'/model')).json();
+  const names = m.params ? Object.keys(m.params) : [];
+  const psel = document.getElementById('param');
+  const sig = names.join('|');
+  if (psel.dataset.sig !== sig){
+    psel.innerHTML = names.map(x=>`<option>${x}</option>`).join('');
+    psel.dataset.sig = sig;
+    psel.onchange = () => { PARAM = psel.value; draw(); };
+  }
+  if ((!PARAM || !names.includes(PARAM)) && names.length) PARAM = names[0];
+  if (PARAM && m.params[PARAM]){
+    const pm = m.params[PARAM], um = (m.updates||{})[PARAM]||[];
+    line('mags', m.iterations, pm, '#2060c0');
+    if (um.length)
+      line('mags', m.iterations.slice(-um.length), um, '#e08020', false);
+    if (um.length){
+      const r = um.map((u,i)=>Math.log10(Math.max(u,1e-12)/
+        Math.max(pm[pm.length-um.length+i],1e-12)));
+      line('ratio', m.iterations.slice(-um.length), r, '#208040');
+    }
+    const h = (m.histograms||{})[PARAM];
+    if (h) bars('hist', h.counts, h.min, h.max);
+  }
 }
 sessions(); setInterval(sessions, 5000);
 </script></body></html>"""
+
+
+def _system_info() -> dict:
+    """System tab payload (ref: the reference UI's system tab — JVM
+    memory/devices; here: python/jax versions, devices, RSS)."""
+    import platform
+    import resource
+    info = {"python": platform.python_version(),
+            "rss_mb": round(resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1)}
+    try:
+        import jax
+        info["jax"] = jax.__version__
+        info["devices"] = [str(d) for d in jax.devices()]
+    except Exception as e:
+        info["jax"] = f"unavailable: {type(e).__name__}"
+    return info
 
 
 class UIServer:
@@ -180,6 +282,8 @@ class UIServer:
                     for st in server.storages:
                         ids.extend(st.list_session_ids())
                     self._json(sorted(set(ids)))
+                elif self.path == "/system":
+                    self._json(_system_info())
                 elif self.path.startswith("/train/") and \
                         self.path.endswith("/overview"):
                     sid = self.path[len("/train/"):-len("/overview")]
@@ -187,6 +291,30 @@ class UIServer:
                     for st in server.storages:
                         out.extend(st.get_updates(sid))
                     self._json(out)
+                elif self.path.startswith("/train/") and \
+                        self.path.endswith("/model"):
+                    # model tab: per-param mean-magnitude series for
+                    # params and updates + the latest histograms (ref:
+                    # TrainModule's model view)
+                    sid = self.path[len("/train/"):-len("/model")]
+                    ups = []
+                    for st in server.storages:
+                        ups.extend(st.get_updates(sid))
+                    iters, params, updates, hists = [], {}, {}, {}
+                    for u in ups:
+                        mm = u.get("param_mean_magnitudes")
+                        if not mm:
+                            continue
+                        iters.append(u.get("iteration", 0))
+                        for k, v in mm.items():
+                            params.setdefault(k, []).append(v)
+                        for k, v in u.get("update_mean_magnitudes",
+                                          {}).items():
+                            updates.setdefault(k, []).append(v)
+                        for k, v in u.get("param_histograms", {}).items():
+                            hists[k] = v  # keep latest
+                    self._json({"iterations": iters, "params": params,
+                                "updates": updates, "histograms": hists})
                 else:
                     self._json({"error": "not found"}, 404)
 

@@ -1,0 +1,118 @@
+"""Preemption hook (§5.3 gap) + SCOPE_PANIC workspace validation
+(§5.2 gap). Ref: technicalref.md restart semantics; DebugMode /
+SCOPE_PANIC workspace enums."""
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.learning import Sgd
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel.elastic import (FaultTolerantTrainer,
+                                                 PreemptionHandler)
+from deeplearning4j_tpu.profiler import (OpProfiler, ProfilingMode,
+                                         ScopePanicException,
+                                         WorkspaceScope)
+
+
+def _model():
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.1))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+            .input_type_feed_forward(4).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data():
+    rs = np.random.RandomState(0)
+    x = rs.rand(32, 4).astype(np.float32)
+    return x, np.eye(2, dtype=np.float32)[rs.randint(0, 2, 32)]
+
+
+class TestPreemptionHandler:
+    def test_sigterm_flushes_checkpoint_and_resumes(self, tmp_path):
+        m = _model()
+        x, y = _data()
+        trainer = FaultTolerantTrainer(m, str(tmp_path),
+                                       save_every_n_epochs=100)
+        fired = []
+        with PreemptionHandler(trainer, signals=(signal.SIGTERM,),
+                               on_preempt=fired.append,
+                               reraise=False) as h:
+            assert h.installed
+            m.fit([(x, y)], epochs=3)     # no checkpoint yet (every=100)
+            assert not FaultTolerantTrainer.list_checkpoints(str(tmp_path))
+            os.kill(os.getpid(), signal.SIGTERM)   # the preemption
+            assert h.preempted
+        assert fired == [signal.SIGTERM]
+        ckpts = FaultTolerantTrainer.list_checkpoints(str(tmp_path))
+        assert len(ckpts) == 1, ckpts
+        restored = FaultTolerantTrainer.resume(str(tmp_path))
+        np.testing.assert_allclose(
+            np.asarray(restored.output(x)), np.asarray(m.output(x)),
+            rtol=1e-6)
+
+    def test_previous_handler_restored_and_chained(self, tmp_path):
+        m = _model()
+        trainer = FaultTolerantTrainer(m, str(tmp_path))
+        seen = []
+        prev = signal.signal(signal.SIGUSR1, lambda s, f: seen.append("prev"))
+        try:
+            with PreemptionHandler(trainer, signals=(signal.SIGUSR1,),
+                                   reraise=True):
+                os.kill(os.getpid(), signal.SIGUSR1)
+            assert seen == ["prev"], "previous handler not chained"
+            assert signal.getsignal(signal.SIGUSR1) is not None
+            os.kill(os.getpid(), signal.SIGUSR1)
+            assert seen == ["prev", "prev"], "handler not restored on exit"
+        finally:
+            signal.signal(signal.SIGUSR1, prev)
+
+
+class TestScopePanic:
+    def setup_method(self):
+        OpProfiler.get_instance().set_mode(ProfilingMode.SCOPE_PANIC)
+
+    def teardown_method(self):
+        OpProfiler.get_instance().set_mode(ProfilingMode.DISABLED)
+
+    def test_use_inside_scope_ok(self):
+        with WorkspaceScope("WS_ACT") as ws:
+            a = ws.track(np.ones((3, 3)))
+            assert np.asarray(a).sum() == 9.0
+            assert a.shape == (3, 3)
+
+    def test_use_after_close_panics(self):
+        with WorkspaceScope("WS_ACT") as ws:
+            a = ws.track(np.ones(4))
+        with pytest.raises(ScopePanicException, match="WS_ACT"):
+            np.asarray(a)
+        with pytest.raises(ScopePanicException):
+            _ = a.value
+
+    def test_alloc_in_closed_scope_panics(self):
+        ws = WorkspaceScope("WS_X")
+        with ws:
+            pass
+        with pytest.raises(ScopePanicException, match="closed scope"):
+            ws.track(np.ones(1))
+
+    def test_reentered_scope_does_not_resurrect(self):
+        ws = WorkspaceScope("WS_LOOP")
+        with ws:
+            leaked = ws.track(np.ones(2))
+        with ws:  # new generation — old arrays stay dead
+            fresh = ws.track(np.ones(2))
+            assert np.asarray(fresh).sum() == 2.0
+            with pytest.raises(ScopePanicException):
+                np.asarray(leaked)
+
+    def test_disabled_mode_does_not_panic(self):
+        OpProfiler.get_instance().set_mode(ProfilingMode.DISABLED)
+        with WorkspaceScope("WS_ACT") as ws:
+            a = ws.track(np.ones(4))
+        # lenient outside SCOPE_PANIC (ref: validation only in debug mode)
+        assert np.asarray(a).sum() == 4.0

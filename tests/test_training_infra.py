@@ -189,7 +189,7 @@ class TestStatsUI:
                 base + "/train/sess/overview", timeout=5).read())
             assert overview[0]["score"] == 0.9
             page = urllib.request.urlopen(base + "/", timeout=5).read()
-            assert b"Training score" in page
+            assert b"Score vs iteration" in page
         finally:
             server.stop()
 

@@ -1,0 +1,134 @@
+"""Training UI dashboard depth (VERDICT r3 weak #7 — ref:
+`deeplearning4j-ui-parent`: TrainModule overview/model/system views,
+StatsListener update stats feeding the log10 update:param ratio chart)
+and EvaluationCalibration residual/probability histograms (ref:
+`EvaluationCalibration.java` getResidualPlot/getProbabilityHistogram)."""
+import json
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu.eval import EvaluationCalibration
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.ui import InMemoryStatsStorage, StatsListener, UIServer
+
+
+def _train(storage, session="s1", iters=6, **listener_kw):
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+            .input_type_feed_forward(4).build())
+    m = MultiLayerNetwork(conf).init()
+    m.set_listeners(StatsListener(storage, session_id=session,
+                                  **listener_kw))
+    rs = np.random.RandomState(0)
+    x = rs.rand(32, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 32)]
+    m.fit(x, y, epochs=iters)
+    return m
+
+
+class TestStatsListenerDepth:
+    def test_update_magnitudes_collected(self):
+        st = InMemoryStatsStorage()
+        _train(st)
+        ups = st.get_updates("s1")
+        assert len(ups) == 6
+        assert "param_mean_magnitudes" in ups[0]
+        # update magnitudes appear from the second report on
+        assert "update_mean_magnitudes" not in ups[0]
+        assert "update_mean_magnitudes" in ups[1]
+        um = ups[1]["update_mean_magnitudes"]
+        assert any(v > 0 for v in um.values()), um
+
+    def test_histograms_optional(self):
+        st = InMemoryStatsStorage()
+        _train(st, session="h1", collect_histograms=True,
+               histogram_bins=12)
+        ups = st.get_updates("h1")
+        h = ups[0]["param_histograms"]
+        some = next(iter(h.values()))
+        assert len(some["counts"]) == 12
+        assert some["min"] <= some["max"]
+        st2 = InMemoryStatsStorage()
+        _train(st2, session="h2")
+        assert "param_histograms" not in st2.get_updates("h2")[0]
+
+
+class TestUIServerEndpoints:
+    def _get(self, port, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+            return json.loads(r.read().decode())
+
+    def test_model_and_system_endpoints(self):
+        st = InMemoryStatsStorage()
+        _train(st, session="m1", collect_histograms=True)
+        srv = UIServer(port=0)
+        try:
+            srv.attach(st)
+            assert "m1" in self._get(srv.port, "/sessions")
+            model = self._get(srv.port, "/train/m1/model")
+            assert model["iterations"], model
+            assert model["params"], "no param series"
+            name, series = next(iter(model["params"].items()))
+            assert len(series) == len(model["iterations"])
+            assert model["updates"], "no update series"
+            assert model["histograms"], "no histograms"
+            sysinfo = self._get(srv.port, "/system")
+            assert "python" in sysinfo and "rss_mb" in sysinfo
+            page = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/", timeout=5).read().decode()
+            for frag in ("score", "mags", "ratio", "hist", "sys"):
+                assert f'id={frag}' in page, frag
+        finally:
+            srv.stop()
+
+
+class TestCalibrationDepth:
+    def test_residual_plot_shifts_with_error(self):
+        rs = np.random.RandomState(0)
+        y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 600)]
+        good = np.clip(y + rs.rand(600, 3) * 0.08, 0, 1)
+        good /= good.sum(-1, keepdims=True)
+        bad = np.full((600, 3), 1 / 3.0)
+        ev_good, ev_bad = EvaluationCalibration(), EvaluationCalibration()
+        ev_good.eval(y, good)
+        ev_bad.eval(y, bad)
+        rg, rb = ev_good.residual_plot(), ev_bad.residual_plot()
+        # good predictions: residual mass near 0; uniform: mass near 1/3
+        centers = (np.arange(20) + 0.5) / 20
+        assert np.average(centers, weights=rg) < \
+            np.average(centers, weights=rb)
+        # per-class residuals sum to the aggregate
+        per = sum(ev_good.residual_plot(c) for c in range(3))
+        np.testing.assert_array_equal(per, rg)
+
+    def test_probability_histograms(self):
+        rs = np.random.RandomState(1)
+        y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 400)]
+        pred = np.clip(y * 0.9 + 0.05 + rs.rand(400, 2) * 0.02, 0, 1)
+        pred /= pred.sum(-1, keepdims=True)
+        ev = EvaluationCalibration()
+        ev.eval(y, pred)
+        all0 = ev.probability_histogram(0)
+        true0 = ev.probability_histogram(0, when_true=True)
+        assert all0.sum() == 400          # every sample contributes
+        assert true0.sum() == float((y.argmax(-1) == 0).sum())
+        # when the true class IS 0, its predicted prob is high:
+        centers = (np.arange(20) + 0.5) / 20
+        assert np.average(centers, weights=true0) > 0.7
+        # ECE still works alongside
+        assert 0.0 <= ev.expected_calibration_error() <= 1.0
+
+    def test_binary_path(self):
+        rs = np.random.RandomState(2)
+        y = (rs.rand(300) > 0.5).astype(np.float32)
+        p = np.clip(y * 0.8 + 0.1 + rs.rand(300) * 0.05, 0, 1)
+        ev = EvaluationCalibration()
+        ev.eval(y, p)
+        assert ev.residual_plot().sum() == 600  # 2 classes x 300
+        assert ev.probability_histogram(1).sum() == 300
