@@ -345,3 +345,69 @@ class RandomProjectionLSH:
         order = np.argsort(-sims)[:k]
         return [int(i) for i in idx[order]], \
             [float(1.0 - s) for s in sims[order]]
+
+
+class RPTree:
+    """One random-projection tree (ref: `nearestneighbor-core/.../
+    randomprojection/RPTree.java` + RPHyperPlanes/RPUtils): internal
+    nodes split points by the median of their projection onto a random
+    unit direction; leaves hold index buckets. Median splits keep the
+    tree balanced (depth ~ log2(n/leaf_size))."""
+
+    def __init__(self, data: np.ndarray, leaf_size: int = 32,
+                 rng: Optional[np.random.RandomState] = None):
+        self.data = np.asarray(data, np.float64)
+        self.leaf_size = max(2, int(leaf_size))
+        self._rng = rng or np.random.RandomState(0)
+        d = self.data.shape[1]
+        self._root = self._build(np.arange(len(self.data)), d, 0)
+
+    def _build(self, idx, d, depth):
+        if len(idx) <= self.leaf_size or depth > 40:
+            return ("leaf", idx)
+        w = self._rng.randn(d)
+        w /= max(np.linalg.norm(w), 1e-12)
+        proj = self.data[idx] @ w
+        med = np.median(proj)
+        left = idx[proj <= med]
+        right = idx[proj > med]
+        if not len(left) or not len(right):   # degenerate projections
+            return ("leaf", idx)
+        return ("node", w, med, self._build(left, d, depth + 1),
+                self._build(right, d, depth + 1))
+
+    def query_bucket(self, q: np.ndarray) -> np.ndarray:
+        """Leaf bucket the query routes to."""
+        node = self._root
+        q = np.asarray(q, np.float64)
+        while node[0] == "node":
+            _, w, med, l, r = node
+            node = l if q @ w <= med else r
+        return node[1]
+
+
+class RPForest:
+    """Random-projection forest for approximate nearest neighbors
+    (ref: `randomprojection/RPForest.java` — n_trees trees queried
+    together, candidate union re-ranked exactly; the ANN structure the
+    reference offers beside VPTree/KDTree/LSH, closing the last D19
+    inventory row)."""
+
+    def __init__(self, data, n_trees: int = 10, leaf_size: int = 32,
+                 seed: int = 0):
+        self.data = np.asarray(data, np.float64)
+        rng = np.random.RandomState(seed)
+        self.trees = [RPTree(self.data, leaf_size, rng)
+                      for _ in range(int(n_trees))]
+
+    def query(self, q, k: int = 1) -> Tuple[List[int], List[float]]:
+        """Approximate k-NN: union of every tree's bucket, exact
+        distances on the candidates (ref: RPUtils.queryAll ->
+        getAllCandidates -> sort by distance)."""
+        q = np.asarray(q, np.float64)
+        cand = np.unique(np.concatenate(
+            [t.query_bucket(q) for t in self.trees]))
+        dists = np.linalg.norm(self.data[cand] - q, axis=1)
+        order = np.argsort(dists)[:k]
+        return [int(i) for i in cand[order]], \
+            [float(d) for d in dists[order]]

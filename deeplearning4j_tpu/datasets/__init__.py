@@ -398,3 +398,96 @@ class Cifar10DataSetIterator(ArrayDataSetIterator):
         onehot = np.eye(10, dtype=np.float32)[labels]
         super().__init__(feats, onehot, batch=batch, shuffle=shuffle,
                          seed=seed)
+
+
+# -- TinyImageNet (ref: deeplearning4j-datasets TinyImageNetFetcher /
+# TinyImageNetDataSetIterator — 200 classes, 64x64 RGB, the standard
+# tiny-imagenet-200 directory layout) --------------------------------------
+def _find_tiny_imagenet() -> Optional[str]:
+    from ..flags import flags
+    for d in (os.path.join(flags.data_dir, "tiny-imagenet-200"),
+              "/data/tiny-imagenet-200", "/root/data/tiny-imagenet-200"):
+        if d and os.path.isdir(os.path.join(d, "train")):
+            return d
+    return None
+
+
+class TinyImageNetDataSetIterator(ArrayDataSetIterator):
+    """Ref: `TinyImageNetDataSetIterator.java` (fetcher at
+    `deeplearning4j-data/deeplearning4j-datasets/.../fetchers/
+    TinyImageNetFetcher.java` — downloads + reads the tiny-imagenet-200
+    layout: train/<wnid>/images/*.JPEG, val/images + val_annotations.txt).
+
+    Reads the standard on-disk layout when present (decoding via PIL;
+    if the dataset is on disk but PIL is not importable, a warning is
+    emitted before falling back). With no dataset and no egress, falls
+    back to a LABELED deterministic synthetic set (`.synthetic`) of
+    64x64x3 images over `num_classes` prototype textures — the same
+    hermetic contract as the MNIST/CIFAR iterators."""
+
+    IMG = 64
+
+    def __init__(self, batch: int, train: bool = True, shuffle: bool = True,
+                 seed: int = 6, num_examples: Optional[int] = None,
+                 num_classes: int = 200, data_dir: Optional[str] = None):
+        d = data_dir or _find_tiny_imagenet()
+        imgs = labels = None
+        if d is not None:
+            imgs, labels = self._read_disk(d, train, num_classes)
+        self.synthetic = imgs is None
+        if imgs is not None and num_examples:
+            # shuffle before truncating: disk data is class-sorted, so a
+            # prefix would contain only the first few classes
+            rng = np.random.RandomState(seed)
+            idx = rng.permutation(len(imgs))[:num_examples]
+            imgs, labels = imgs[idx], labels[idx]
+        if imgs is None:
+            n = num_examples or (8192 if train else 2048)
+            rng = np.random.RandomState(33 if train else 44)
+            labels = rng.randint(0, num_classes, n)
+            protos = np.random.RandomState(777).rand(
+                num_classes, self.IMG, self.IMG, 3).astype(np.float32)
+            imgs = ((protos[labels] * 0.7
+                     + rng.rand(n, self.IMG, self.IMG, 3) * 0.3)
+                    * 255).astype(np.uint8)
+        if num_examples:
+            imgs, labels = imgs[:num_examples], labels[:num_examples]
+        feats = imgs.astype(np.float32) / 255.0
+        onehot = np.eye(num_classes, dtype=np.float32)[labels]
+        super().__init__(feats, onehot, batch=batch, shuffle=shuffle,
+                         seed=seed)
+
+    def _read_disk(self, d: str, train: bool, num_classes: int):
+        try:
+            from PIL import Image  # optional; not baked in every image
+        except ImportError:
+            import warnings
+            warnings.warn(
+                f"tiny-imagenet-200 found at {d} but PIL is not "
+                "installed — falling back to SYNTHETIC data "
+                "(.synthetic=True)", RuntimeWarning)
+            return None, None
+        wnids = sorted(os.listdir(os.path.join(d, "train")))[:num_classes]
+        cls = {w: i for i, w in enumerate(wnids)}
+        imgs, labels = [], []
+        if train:
+            for w in wnids:
+                img_dir = os.path.join(d, "train", w, "images")
+                for f in sorted(os.listdir(img_dir)):
+                    im = Image.open(os.path.join(img_dir, f)).convert("RGB")
+                    imgs.append(np.asarray(im, np.uint8))
+                    labels.append(cls[w])
+        else:
+            ann = os.path.join(d, "val", "val_annotations.txt")
+            with open(ann) as fh:
+                for line in fh:
+                    parts = line.split("\t")
+                    if len(parts) < 2 or parts[1] not in cls:
+                        continue
+                    im = Image.open(os.path.join(
+                        d, "val", "images", parts[0])).convert("RGB")
+                    imgs.append(np.asarray(im, np.uint8))
+                    labels.append(cls[parts[1]])
+        if not imgs:
+            return None, None
+        return np.stack(imgs), np.asarray(labels)

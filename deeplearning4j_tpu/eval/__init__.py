@@ -327,6 +327,10 @@ class EvaluationCalibration:
             self._residual_by_class = np.zeros((c, self.residual_bins))
             self._prob_all = np.zeros((c, self.histogram_bins))
             self._prob_when_true = np.zeros((c, self.histogram_bins))
+        elif c != self._n_classes:
+            raise ValueError(
+                f"EvaluationCalibration was built with {self._n_classes} "
+                f"classes; got a batch with {c}")
 
     def eval(self, labels, predictions, mask=None):
         labels = np.asarray(labels)
@@ -345,10 +349,20 @@ class EvaluationCalibration:
             lab2 = np.stack([1.0 - lab1, lab1], -1)
             pr1 = np.clip(pred.reshape(-1), 0.0, 1.0)
             pred2 = np.stack([1.0 - pr1, pr1], -1)
+        # honor the (per-sample or per-timestep) mask everywhere: rows
+        # with mask==0 contribute to NO statistic
+        keep = None
+        if mask is not None:
+            keep = np.asarray(mask).reshape(-1) > 0
+            lab2, pred2 = lab2[keep], pred2[keep]
         bins = np.clip((p * self.num_bins).astype(int), 0,
                        self.num_bins - 1)
-        for b, h, pr in zip(bins.reshape(-1), hit.reshape(-1),
-                            np.asarray(p).reshape(-1)):
+        flat = zip(bins.reshape(-1), hit.reshape(-1),
+                   np.asarray(p).reshape(-1),
+                   keep if keep is not None else np.ones(bins.size, bool))
+        for b, h, pr, k in flat:
+            if not k:
+                continue
             self._counts[b] += 1
             self._pos[b] += h
             self._prob_sum[b] += pr

@@ -51,13 +51,20 @@ class FaultTolerantTrainer:
         return sorted(paths, key=epoch_of)
 
     def _save(self, epoch: int):
-        path = self._ckpt_path(epoch)
-        tmp = path + ".tmp"
-        ModelSerializer.write_model(self.model, tmp, save_updater=True)
-        os.replace(tmp, path)  # atomic: partial writes never become live
-        ckpts = self.list_checkpoints(self.dir)
-        for old in ckpts[:-self.keep_last]:
-            os.remove(old)
+        # _saving guards signal-handler re-entry: a SIGTERM landing
+        # mid-write must not reuse the same .tmp path (see
+        # PreemptionHandler._handle)
+        self._saving = True
+        try:
+            path = self._ckpt_path(epoch)
+            tmp = path + ".tmp"
+            ModelSerializer.write_model(self.model, tmp, save_updater=True)
+            os.replace(tmp, path)  # atomic: partial writes never go live
+            ckpts = self.list_checkpoints(self.dir)
+            for old in ckpts[:-self.keep_last]:
+                os.remove(old)
+        finally:
+            self._saving = False
 
     # -- training ------------------------------------------------------
     def fit(self, iterator, epochs: int):
@@ -120,10 +127,13 @@ class PreemptionHandler:
     def _handle(self, signum, frame):
         self.preempted = True
         # flush the current (possibly mid-epoch) training state — but
-        # never clobber an existing clean epoch-boundary checkpoint
-        # that carries the same epoch tag
+        # never clobber an existing clean epoch-boundary checkpoint with
+        # the same tag, and never re-enter a _save the signal interrupted
+        # mid-write (the shared .tmp would corrupt the live checkpoint;
+        # skipping keeps the previous checkpoint intact)
         epoch = self.trainer.model._epoch
-        if not os.path.exists(self.trainer._ckpt_path(epoch)):
+        if not getattr(self.trainer, "_saving", False) and \
+                not os.path.exists(self.trainer._ckpt_path(epoch)):
             self.trainer._save(epoch)
         if self.on_preempt is not None:
             self.on_preempt(signum)

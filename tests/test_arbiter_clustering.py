@@ -189,3 +189,38 @@ class TestTsne:
         spread = 0.5 * (y[:40].std() + y[40:].std())
         # cluster centroids separated well beyond intra-cluster spread
         assert np.linalg.norm(ca - cb) > 2 * spread
+
+
+class TestRPForest:
+    def test_recall_vs_exact(self):
+        from deeplearning4j_tpu.clustering import RPForest
+        rs = np.random.RandomState(0)
+        data = rs.rand(500, 16).astype(np.float64)
+        forest = RPForest(data, n_trees=12, leaf_size=24, seed=1)
+        hits = 0
+        for qi in range(40):
+            q = data[qi] + rs.randn(16) * 0.01
+            exact = int(np.argmin(np.linalg.norm(data - q, axis=1)))
+            ids, dists = forest.query(q, k=5)
+            assert len(ids) == 5
+            assert dists == sorted(dists)
+            hits += exact in ids
+        assert hits >= 32, f"ANN recall too low: {hits}/40"
+
+    def test_exact_match_is_first(self):
+        from deeplearning4j_tpu.clustering import RPForest
+        rs = np.random.RandomState(1)
+        data = rs.rand(200, 8)
+        forest = RPForest(data, n_trees=8, seed=2)
+        ids, dists = forest.query(data[17], k=1)
+        assert ids == [17]
+        assert dists[0] < 1e-12
+
+    def test_tree_buckets_bounded(self):
+        from deeplearning4j_tpu.clustering import RPTree
+        rs = np.random.RandomState(2)
+        data = rs.rand(1000, 4)
+        tree = RPTree(data, leaf_size=16,
+                      rng=np.random.RandomState(3))
+        bucket = tree.query_bucket(data[0])
+        assert 1 <= len(bucket) <= 16

@@ -124,6 +124,28 @@ class TestCalibrationDepth:
         # ECE still works alongside
         assert 0.0 <= ev.expected_calibration_error() <= 1.0
 
+    def test_mask_excludes_rows_everywhere(self):
+        rs = np.random.RandomState(3)
+        y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 100)]
+        p = np.clip(y * 0.8 + 0.1, 0, 1)
+        mask = np.zeros(100, np.float32)
+        mask[:60] = 1.0
+        ev = EvaluationCalibration()
+        ev.eval(y, p, mask=mask)
+        assert ev.residual_plot().sum() == 120      # 60 rows x 2 classes
+        assert ev.probability_histogram(0).sum() == 60
+        _, _, counts = ev.reliability_curve()
+        assert counts.sum() == 60
+
+    def test_class_count_mismatch_raises(self):
+        ev = EvaluationCalibration()
+        ev.eval(np.eye(3, dtype=np.float32),
+                np.full((3, 3), 1 / 3.0))
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="3 classes"):
+            ev.eval(np.eye(2, dtype=np.float32),
+                    np.full((2, 2), 0.5))
+
     def test_binary_path(self):
         rs = np.random.RandomState(2)
         y = (rs.rand(300) > 0.5).astype(np.float32)
