@@ -424,10 +424,15 @@ def main():
     # an hour of per-model timeouts before falling back to CPU. Two
     # attempts: first contact pays handshake+compile, so a single
     # transient miss must not demote the whole run.
+    def _probe_tpu(p):
+        # correctness AND platform: a silent CPU fallback must not pass
+        return bool(p and p.get("ok")
+                    and p.get("platform") in ("tpu", "axon"))
+
     probe = _run(PROBE_CODE, {}, timeout=150)
-    if not (probe and probe.get("ok")):
+    if not _probe_tpu(probe):
         probe = _run(PROBE_CODE, {}, timeout=240)
-    tpu_alive = bool(probe and probe.get("ok"))
+    tpu_alive = _probe_tpu(probe)
     fallback = False
     res = None
     if tpu_alive:

@@ -433,11 +433,16 @@ class TinyImageNetDataSetIterator(ArrayDataSetIterator):
         d = data_dir or _find_tiny_imagenet()
         imgs = labels = None
         if d is not None:
-            imgs, labels = self._read_disk(d, train, num_classes)
+            # per-class cap BEFORE decoding (class-sorted data: a flat
+            # prefix would hold only the first wnids, and decoding all
+            # 100k JPEGs to keep 100 would waste minutes)
+            per_class = None
+            if num_examples:
+                per_class = -(-num_examples // num_classes)  # ceil
+            imgs, labels = self._read_disk(d, train, num_classes,
+                                           per_class)
         self.synthetic = imgs is None
         if imgs is not None and num_examples:
-            # shuffle before truncating: disk data is class-sorted, so a
-            # prefix would contain only the first few classes
             rng = np.random.RandomState(seed)
             idx = rng.permutation(len(imgs))[:num_examples]
             imgs, labels = imgs[idx], labels[idx]
@@ -450,14 +455,13 @@ class TinyImageNetDataSetIterator(ArrayDataSetIterator):
             imgs = ((protos[labels] * 0.7
                      + rng.rand(n, self.IMG, self.IMG, 3) * 0.3)
                     * 255).astype(np.uint8)
-        if num_examples:
-            imgs, labels = imgs[:num_examples], labels[:num_examples]
         feats = imgs.astype(np.float32) / 255.0
         onehot = np.eye(num_classes, dtype=np.float32)[labels]
         super().__init__(feats, onehot, batch=batch, shuffle=shuffle,
                          seed=seed)
 
-    def _read_disk(self, d: str, train: bool, num_classes: int):
+    def _read_disk(self, d: str, train: bool, num_classes: int,
+                   per_class: Optional[int] = None):
         try:
             from PIL import Image  # optional; not baked in every image
         except ImportError:
@@ -473,7 +477,10 @@ class TinyImageNetDataSetIterator(ArrayDataSetIterator):
         if train:
             for w in wnids:
                 img_dir = os.path.join(d, "train", w, "images")
-                for f in sorted(os.listdir(img_dir)):
+                files = sorted(os.listdir(img_dir))
+                if per_class is not None:
+                    files = files[:per_class]
+                for f in files:
                     im = Image.open(os.path.join(img_dir, f)).convert("RGB")
                     imgs.append(np.asarray(im, np.uint8))
                     labels.append(cls[w])

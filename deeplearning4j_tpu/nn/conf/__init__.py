@@ -73,7 +73,7 @@ class MultiLayerConfiguration:
                  tbptt_fwd_length: int = 0, tbptt_bwd_length: int = 0,
                  max_grad_norm: Optional[float] = None,
                  grad_clip_value: Optional[float] = None,
-                 dtype: str = "float"):
+                 dtype: str = "float", remat: bool = False):
         self.layers = layers
         self.seed = int(seed)
         self.updater = U.get(updater) if updater is not None else U.Sgd(0.1)
@@ -84,6 +84,11 @@ class MultiLayerConfiguration:
         self.max_grad_norm = max_grad_norm      # GradientNormalization.ClipL2PerLayer analog
         self.grad_clip_value = grad_clip_value  # ClipElementWiseAbsoluteValue analog
         self.dtype = dtype
+        # per-layer rematerialization (jax.checkpoint): trade FLOPs for
+        # HBM — activations are recomputed in the backward pass instead
+        # of stored. The TPU-native counterpart of the reference's
+        # CacheMode.NONE workspace economy knob.
+        self.remat = bool(remat)
 
     # -- serde (the JSON round-trip property that powers golden-file tests
     # and Keras import in the reference) ---------------------------------
@@ -109,6 +114,7 @@ class MultiLayerConfiguration:
             "max_grad_norm": self.max_grad_norm,
             "grad_clip_value": self.grad_clip_value,
             "dtype": self.dtype,
+            "remat": self.remat,
             "layers": [l.to_json() for l in self.layers],
         }, indent=2)
 
@@ -129,6 +135,7 @@ class MultiLayerConfiguration:
             max_grad_norm=d.get("max_grad_norm"),
             grad_clip_value=d.get("grad_clip_value"),
             dtype=d.get("dtype", "float"),
+            remat=d.get("remat", False),
         )
 
 
@@ -169,7 +176,7 @@ class ListBuilder:
             defaults=b._defaults(), input_type=self._input_type,
             tbptt_fwd_length=self._tbptt[0], tbptt_bwd_length=self._tbptt[1],
             max_grad_norm=b._max_grad_norm, grad_clip_value=b._grad_clip_value,
-            dtype=b._dtype)
+            dtype=b._dtype, remat=b._remat)
 
 
 class NeuralNetConfiguration:
@@ -187,6 +194,7 @@ class NeuralNetConfiguration:
         self._constraints = []
         self._max_grad_norm = None
         self._grad_clip_value = None
+        self._remat = False
         # global default dtype (ref: ND4JSystemProperties.DTYPE); the
         # builder's .data_type() overrides per configuration
         from ...flags import flags as _flags
@@ -250,6 +258,14 @@ class NeuralNetConfiguration:
 
     def data_type(self, dt: str):
         self._dtype = dt
+        return self
+
+    def remat(self, on: bool = True):
+        """Per-layer activation rematerialization (jax.checkpoint):
+        recompute forward activations during backprop instead of
+        holding them in HBM — the standard TPU memory/FLOPs trade for
+        deep or long-sequence models."""
+        self._remat = bool(on)
         return self
 
     # accepted-for-parity no-ops (XLA owns memory on TPU)

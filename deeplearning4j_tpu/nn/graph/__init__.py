@@ -323,7 +323,8 @@ class ComputationGraphConfiguration:
                  seed: int = 12345, updater=None, defaults: Optional[dict] = None,
                  max_grad_norm: Optional[float] = None,
                  grad_clip_value: Optional[float] = None,
-                 tbptt_fwd_length: int = 0, dtype: str = "float"):
+                 tbptt_fwd_length: int = 0, dtype: str = "float",
+                 remat: bool = False):
         self.nodes = nodes
         self.graph_inputs = graph_inputs
         self.graph_outputs = graph_outputs
@@ -335,6 +336,7 @@ class ComputationGraphConfiguration:
         self.grad_clip_value = grad_clip_value
         self.tbptt_fwd_length = tbptt_fwd_length
         self.dtype = dtype
+        self.remat = bool(remat)
 
     # topological order (ref: ComputationGraph.topologicalSortOrder :463)
     def topo_order(self) -> List[str]:
@@ -366,6 +368,7 @@ class ComputationGraphConfiguration:
             "grad_clip_value": self.grad_clip_value,
             "tbptt_fwd_length": self.tbptt_fwd_length,
             "dtype": self.dtype,
+            "remat": self.remat,
             "nodes": [{
                 "name": n.name, "inputs": n.inputs,
                 **({"layer": n.layer.to_json()} if n.layer is not None else {}),
@@ -393,7 +396,8 @@ class ComputationGraphConfiguration:
             defaults=defaults, max_grad_norm=d.get("max_grad_norm"),
             grad_clip_value=d.get("grad_clip_value"),
             tbptt_fwd_length=d.get("tbptt_fwd_length", 0),
-            dtype=d.get("dtype", "float"))
+            dtype=d.get("dtype", "float"),
+            remat=d.get("remat", False))
 
 
 class GraphBuilder:
@@ -440,7 +444,8 @@ class GraphBuilder:
         if b is not None:
             kw = dict(seed=b._seed, updater=b._updater, defaults=b._defaults(),
                       max_grad_norm=b._max_grad_norm,
-                      grad_clip_value=b._grad_clip_value, dtype=b._dtype)
+                      grad_clip_value=b._grad_clip_value, dtype=b._dtype,
+                      remat=b._remat)
         return ComputationGraphConfiguration(
             nodes=self._nodes, graph_inputs=self._inputs,
             graph_outputs=self._outputs, input_types=self._input_types,
@@ -532,11 +537,24 @@ class ComputationGraph:
                 r = node_rngs[i] if rng is not None else None
                 if layer.weight_noise is not None:
                     p = layer._maybe_weight_noise(p, train, r)
+                remat = getattr(conf, "remat", False) and train
                 if getattr(layer, "is_rnn", False):
                     m = fmask if ins[0].ndim == 3 else None
-                    act, s2, _ = layer.apply_seq(
-                        p, ins[0], s, train, r,
-                        layer.init_carry(ins[0].shape[0], ins[0].dtype), m)
+                    carry = layer.init_carry(ins[0].shape[0],
+                                             ins[0].dtype)
+                    if remat:
+                        act, s2, _ = jax.checkpoint(
+                            lambda p_, a_, s_, r_, c_, m_, _l=layer:
+                            _l.apply_seq(p_, a_, s_, train, r_, c_, m_)
+                        )(p, ins[0], s, r, carry, m)
+                    else:
+                        act, s2, _ = layer.apply_seq(p, ins[0], s, train,
+                                                     r, carry, m)
+                elif remat and layer.has_params:
+                    # conf.remat: recompute activations in backward
+                    act, s2 = jax.checkpoint(
+                        lambda p_, a_, s_, r_, _l=layer:
+                        _l.apply(p_, a_, s_, train, r_))(p, ins[0], s, r)
                 else:
                     act, s2 = layer.apply(p, ins[0], s, train, r)
                 if s:

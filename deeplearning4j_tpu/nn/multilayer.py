@@ -167,11 +167,25 @@ class MultiLayerNetwork:
             r = layer_rngs[i] if rng is not None else None
             if layer.weight_noise is not None:
                 p = layer._maybe_weight_noise(p, train, r)
+            remat = getattr(self.conf, "remat", False) and train
             if getattr(layer, "is_rnn", False):
                 m = fmask if act.ndim == 3 else None
-                act, s2, c2 = layer.apply_seq(p, act, s, train, r,
-                                              new_carries[i], m)
+                fn = layer.apply_seq
+                if remat:
+                    fn = jax.checkpoint(
+                        lambda p_, a_, s_, r_, c_, m_, _l=layer:
+                        _l.apply_seq(p_, a_, s_, train, r_, c_, m_))
+                    act, s2, c2 = fn(p, act, s, r, new_carries[i], m)
+                else:
+                    act, s2, c2 = fn(p, act, s, train, r,
+                                     new_carries[i], m)
                 new_carries[i] = c2
+            elif remat and layer.has_params:
+                # jax.checkpoint: recompute this layer's activations in
+                # the backward pass instead of storing them (conf.remat)
+                act, s2 = jax.checkpoint(
+                    lambda p_, a_, s_, r_, _l=layer:
+                    _l.apply(p_, a_, s_, train, r_))(p, act, s, r)
             else:
                 act, s2 = layer.apply(p, act, s, train, r)
             if s:

@@ -125,7 +125,9 @@ class TransferLearning:
                 input_type=old_conf.input_type,
                 tbptt_fwd_length=old_conf.tbptt_fwd_length,
                 max_grad_norm=old_conf.max_grad_norm,
-                grad_clip_value=old_conf.grad_clip_value)
+                grad_clip_value=old_conf.grad_clip_value,
+                dtype=old_conf.dtype,
+                remat=getattr(old_conf, "remat", False))
             net = MultiLayerNetwork(conf).init()
             # restore trained params/state for retained layers
             for i, (p, s) in enumerate(zip(self._params, self._state)):

@@ -1,0 +1,89 @@
+"""conf.remat (jax.checkpoint rematerialization) — training must be
+numerically identical with and without it; only the memory/FLOPs trade
+changes. TPU-native counterpart of the reference's CacheMode workspace
+economy knob."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn import (ComputationGraph, MultiLayerNetwork,
+                                   MultiLayerConfiguration,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf import InputType
+from deeplearning4j_tpu.nn.layers import (DenseLayer, LSTM, OutputLayer,
+                                          RnnOutputLayer)
+
+
+def _data(seed=0, n=32, f=6, c=3):
+    rs = np.random.RandomState(seed)
+    x = rs.rand(n, f).astype(np.float32)
+    y = np.eye(c, dtype=np.float32)[rs.randint(0, c, n)]
+    return x, y
+
+
+def _mlp(remat):
+    b = (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-2))
+         .weight_init("xavier").remat(remat))
+    return MultiLayerNetwork(
+        b.list()
+        .layer(DenseLayer(n_out=16, activation="relu"))
+        .layer(DenseLayer(n_out=16, activation="tanh"))
+        .layer(OutputLayer(n_out=3, loss="mcxent", activation="softmax"))
+        .input_type_feed_forward(6).build()).init()
+
+
+class TestRemat:
+    def test_mlp_losses_identical(self):
+        x, y = _data()
+        base, rem = _mlp(False), _mlp(True)
+        for _ in range(5):
+            base.fit(x, y)
+            rem.fit(x, y)
+            assert base.score_ == pytest.approx(rem.score_, rel=1e-5)
+        np.testing.assert_allclose(np.asarray(base.output(x)),
+                                   np.asarray(rem.output(x)), rtol=1e-5)
+
+    def test_rnn_remat(self):
+        def net(remat):
+            b = (NeuralNetConfiguration.builder().seed(2)
+                 .updater(Adam(5e-3)).weight_init("xavier").remat(remat))
+            return MultiLayerNetwork(
+                b.list()
+                .layer(LSTM(n_out=8, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=2, loss="mcxent",
+                                      activation="softmax"))
+                .input_type_recurrent(4).build()).init()
+        rs = np.random.RandomState(1)
+        x = rs.rand(8, 5, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[
+            rs.randint(0, 2, (8, 5))].astype(np.float32)
+        base, rem = net(False), net(True)
+        for _ in range(3):
+            base.fit(x, y)
+            rem.fit(x, y)
+            assert base.score_ == pytest.approx(rem.score_, rel=1e-5)
+
+    def test_graph_remat(self):
+        def net(remat):
+            g = (NeuralNetConfiguration.builder().seed(3)
+                 .updater(Adam(1e-2)).weight_init("xavier").remat(remat)
+                 .graph_builder()
+                 .add_inputs("in")
+                 .set_input_types(InputType.feed_forward(6)))
+            g.add_layer("d1", DenseLayer(n_out=12, activation="relu"), "in")
+            g.add_layer("out", OutputLayer(n_out=3, loss="mcxent",
+                                           activation="softmax"), "d1")
+            g.set_outputs("out")
+            return ComputationGraph(g.build()).init()
+        x, y = _data(seed=4)
+        base, rem = net(False), net(True)
+        for _ in range(4):
+            base.fit(x, y)
+            rem.fit(x, y)
+            assert base.score_ == pytest.approx(rem.score_, rel=1e-5)
+
+    def test_remat_json_round_trip(self):
+        m = _mlp(True)
+        conf2 = MultiLayerConfiguration.from_json(m.conf.to_json())
+        assert conf2.remat is True
+        MultiLayerNetwork(conf2).init()
