@@ -485,9 +485,14 @@ class TinyImageNetDataSetIterator(ArrayDataSetIterator):
                     imgs.append(np.asarray(im, np.uint8))
                     labels.append(cls[w])
         else:
+            # cap decodes here too (val order is not class-sorted, so a
+            # simple count bound keeps the sample representative)
+            limit = per_class * num_classes if per_class else None
             ann = os.path.join(d, "val", "val_annotations.txt")
             with open(ann) as fh:
                 for line in fh:
+                    if limit is not None and len(imgs) >= limit:
+                        break
                     parts = line.split("\t")
                     if len(parts) < 2 or parts[1] not in cls:
                         continue

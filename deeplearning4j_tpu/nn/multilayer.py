@@ -170,15 +170,14 @@ class MultiLayerNetwork:
             remat = getattr(self.conf, "remat", False) and train
             if getattr(layer, "is_rnn", False):
                 m = fmask if act.ndim == 3 else None
-                fn = layer.apply_seq
                 if remat:
-                    fn = jax.checkpoint(
+                    act, s2, c2 = jax.checkpoint(
                         lambda p_, a_, s_, r_, c_, m_, _l=layer:
-                        _l.apply_seq(p_, a_, s_, train, r_, c_, m_))
-                    act, s2, c2 = fn(p, act, s, r, new_carries[i], m)
+                        _l.apply_seq(p_, a_, s_, train, r_, c_, m_))(
+                            p, act, s, r, new_carries[i], m)
                 else:
-                    act, s2, c2 = fn(p, act, s, train, r,
-                                     new_carries[i], m)
+                    act, s2, c2 = layer.apply_seq(p, act, s, train, r,
+                                                  new_carries[i], m)
                 new_carries[i] = c2
             elif remat and layer.has_params:
                 # jax.checkpoint: recompute this layer's activations in

@@ -124,6 +124,7 @@ class TransferLearning:
                 defaults=old_conf.defaults,
                 input_type=old_conf.input_type,
                 tbptt_fwd_length=old_conf.tbptt_fwd_length,
+                tbptt_bwd_length=old_conf.tbptt_bwd_length,
                 max_grad_norm=old_conf.max_grad_norm,
                 grad_clip_value=old_conf.grad_clip_value,
                 dtype=old_conf.dtype,

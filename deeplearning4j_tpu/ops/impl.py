@@ -282,12 +282,16 @@ def _scatter_nd(indices, updates, shape):
     return out.at[idx].add(updates)
 
 
-op("scatter_nd_add", "transforms")(lambda ref, indices, updates: ref.at[
-    tuple(jnp.moveaxis(jnp.asarray(indices), -1, 0))].add(updates))
-op("scatter_nd_sub", "transforms")(lambda ref, indices, updates: ref.at[
-    tuple(jnp.moveaxis(jnp.asarray(indices), -1, 0))].add(-updates))
-op("scatter_nd_update", "transforms")(lambda ref, indices, updates: ref.at[
-    tuple(jnp.moveaxis(jnp.asarray(indices), -1, 0))].set(updates))
+op("scatter_nd_add", "transforms")(
+    lambda ref, indices, updates: jnp.asarray(ref).at[
+        tuple(jnp.moveaxis(jnp.asarray(indices), -1, 0))].add(updates))
+op("scatter_nd_sub", "transforms")(
+    lambda ref, indices, updates: jnp.asarray(ref).at[
+        tuple(jnp.moveaxis(jnp.asarray(indices), -1, 0))].add(
+            -jnp.asarray(updates)))
+op("scatter_nd_update", "transforms")(
+    lambda ref, indices, updates: jnp.asarray(ref).at[
+        tuple(jnp.moveaxis(jnp.asarray(indices), -1, 0))].set(updates))
 
 
 @op("reverse_sequence", "transforms")
