@@ -28,7 +28,14 @@ from ..nn.layers import (ActivationLayer, BatchNormalization,
                          EmbeddingLayer, GlobalPoolingLayer, Layer,
                          OutputLayer, SubsamplingLayer, Upsampling2D,
                          ZeroPaddingLayer)
+from ..nn.layers.convolutional import (Convolution1D, Cropping2D,
+                                       Deconvolution2D,
+                                       DepthwiseConvolution2D,
+                                       SeparableConvolution2D,
+                                       Subsampling1DLayer)
 from ..nn.layers.recurrent import LSTM, LastTimeStep, SimpleRnn
+from ..nn.conf.dropout import (AlphaDropout, GaussianDropout, GaussianNoise,
+                               SpatialDropout)
 from ..nn.multilayer import MultiLayerNetwork
 
 _ACTIVATIONS = {
@@ -127,6 +134,70 @@ def _map_layer(class_name: str, cfg: dict) -> Optional[object]:
         if not cfg.get("return_sequences", False):
             return LastTimeStep(rnn, name=name)
         return rnn
+    if class_name in ("Conv1D", "Convolution1D"):
+        k = cfg["kernel_size"]
+        return Convolution1D(
+            n_out=cfg["filters"], kernel=k[0] if isinstance(k, list) else k,
+            stride=(cfg.get("strides", [1]) or [1])[0]
+            if isinstance(cfg.get("strides"), list) else cfg.get("strides", 1),
+            padding=cfg.get("padding", "valid"), activation=_act(cfg),
+            has_bias=cfg.get("use_bias", True), name=name)
+    if class_name in ("SeparableConv2D", "SeparableConvolution2D"):
+        return SeparableConvolution2D(
+            n_out=cfg["filters"], kernel=_pair(cfg["kernel_size"]),
+            stride=_pair(cfg.get("strides", 1)),
+            padding=cfg.get("padding", "valid"),
+            depth_multiplier=cfg.get("depth_multiplier", 1),
+            activation=_act(cfg), has_bias=cfg.get("use_bias", True),
+            name=name)
+    if class_name == "DepthwiseConv2D":
+        return DepthwiseConvolution2D(
+            kernel=_pair(cfg["kernel_size"]),
+            stride=_pair(cfg.get("strides", 1)),
+            padding=cfg.get("padding", "valid"),
+            depth_multiplier=cfg.get("depth_multiplier", 1),
+            activation=_act(cfg), has_bias=cfg.get("use_bias", True),
+            name=name)
+    if class_name in ("Conv2DTranspose", "Deconvolution2D"):
+        return Deconvolution2D(
+            n_out=cfg["filters"], kernel=_pair(cfg["kernel_size"]),
+            stride=_pair(cfg.get("strides", 1)),
+            padding=cfg.get("padding", "valid"), activation=_act(cfg),
+            has_bias=cfg.get("use_bias", True), name=name)
+    if class_name in ("MaxPooling1D", "AveragePooling1D"):
+        pool = cfg.get("pool_size", 2)
+        pool = pool[0] if isinstance(pool, list) else pool
+        stride = cfg.get("strides") or pool
+        stride = stride[0] if isinstance(stride, list) else stride
+        return Subsampling1DLayer(
+            kernel=pool, stride=stride, padding=cfg.get("padding", "valid"),
+            pooling="max" if class_name.startswith("Max") else "avg",
+            name=name)
+    if class_name == "Cropping2D":
+        c = cfg.get("cropping", 0)
+        return Cropping2D(cropping=c, name=name)
+    if class_name == "LeakyReLU":
+        alpha = cfg.get("negative_slope", cfg.get("alpha", 0.3))
+        return ActivationLayer(
+            activation={"@class": "leakyrelu", "alpha": float(alpha)},
+            name=name)
+    if class_name == "ELU":
+        return ActivationLayer(
+            activation={"@class": "elu",
+                        "alpha": float(cfg.get("alpha", 1.0))},
+            name=name)
+    if class_name == "GaussianNoise":
+        return DropoutLayer(dropout=GaussianNoise(cfg.get("stddev", 0.1)),
+                            name=name)
+    if class_name == "GaussianDropout":
+        return DropoutLayer(dropout=GaussianDropout(cfg.get("rate", 0.5)),
+                            name=name)
+    if class_name == "AlphaDropout":
+        return DropoutLayer(dropout=AlphaDropout(cfg.get("rate", 0.05)),
+                            name=name)
+    if class_name == "SpatialDropout2D":
+        return DropoutLayer(dropout=SpatialDropout(cfg.get("rate", 0.5)),
+                            name=name)
     raise ValueError(f"unsupported Keras layer type {class_name!r} "
                      f"(layer {name!r})")
 
@@ -162,15 +233,38 @@ def _layer_weights(f: h5py.File, layer_name: str) -> Dict[str, np.ndarray]:
     return out
 
 
+def _dw_kernel(w):
+    """Keras depthwise kernel (kh, kw, C, mult) -> grouped-conv HWIO
+    (kh, kw, 1, C*mult) with C-major output ordering (matches XLA's
+    feature_group_count channel layout)."""
+    kh, kw_, c, m = w.shape
+    return w.reshape(kh, kw_, 1, c * m)
+
+
 _PARAM_MAP = {
-    # our param name -> keras dataset basename, per layer kind
+    # our param name -> keras dataset basename (optionally with a layout
+    # transform), per layer kind
     "dense": {"W": "kernel", "b": "bias"},
     "output": {"W": "kernel", "b": "bias"},
     "conv2d": {"W": "kernel", "b": "bias"},
+    "conv1d": {"W": "kernel", "b": "bias"},
     "batchnorm": {"gamma": "gamma", "beta": "beta"},
     "embedding": {"W": "embeddings"},
     "lstm": {"W": "kernel", "U": "recurrent_kernel", "b": "bias"},
     "simplernn": {"W": "kernel", "U": "recurrent_kernel", "b": "bias"},
+    # keras Conv2DTranspose kernel is (kh, kw, out, in) applied with
+    # transpose_kernel=True; our deconv2d runs lax.conv_transpose with a
+    # plain HWIO kernel, so convert by flipping the spatial dims and
+    # swapping in/out (verified equivalent vs real Keras)
+    "deconv2d": {"W": ("kernel",
+                       lambda w: np.transpose(w[::-1, ::-1], (0, 1, 3, 2))),
+                 "b": "bias"},
+    # Keras 2 names the depthwise kernel "depthwise_kernel"; Keras 3's
+    # h5 export calls it plain "kernel" — accept either
+    "depthwiseconv2d": {"W": (["depthwise_kernel", "kernel"], _dw_kernel),
+                        "b": "bias"},
+    "sepconv2d": {"dW": ("depthwise_kernel", _dw_kernel),
+                  "pW": "pointwise_kernel", "b": "bias"},
 }
 
 
@@ -184,11 +278,19 @@ def _translate_params(kind: str, ours: dict, keras_w: Dict[str, np.ndarray],
         return ours
     new = {}
     for pname, template in ours.items():
-        kname = mapping.get(pname)
+        spec = mapping.get(pname)
+        if isinstance(spec, tuple):
+            kname, transform = spec
+        else:
+            kname, transform = spec, None
+        if isinstance(kname, list):  # candidate names (Keras 2 vs 3)
+            kname = next((k for k in kname if k in keras_w), None)
         if kname is None or kname not in keras_w:
             new[pname] = template  # keep init (e.g. missing bias)
             continue
         w = keras_w[kname]
+        if transform is not None:
+            w = transform(np.asarray(w))
         if tuple(w.shape) != tuple(np.shape(template)):
             raise ValueError(
                 f"shape mismatch importing {layer_name!r}.{pname}: "

@@ -1,0 +1,58 @@
+"""Generate round-4 Keras import fixtures covering the extended mapper
+surface (separable/depthwise/transpose convs, 1D convs/pools, cropping,
+advanced activations, noise layers) with REAL Keras as the oracle —
+same philosophy as the existing keras_seq_*.h5 fixtures.
+
+Run from repo root: python tests/fixtures/gen_keras_extra.py
+"""
+import os
+
+import numpy as np
+
+os.environ.setdefault("KERAS_BACKEND", "tensorflow")
+import keras  # noqa: E402
+from keras import layers  # noqa: E402
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main():
+    rs = np.random.RandomState(0)
+    keras.utils.set_random_seed(7)
+
+    conv = keras.Sequential([
+        keras.Input((8, 8, 3)),
+        layers.SeparableConv2D(4, 3, padding="same", activation="relu"),
+        layers.DepthwiseConv2D(3, padding="same"),
+        layers.Conv2DTranspose(5, 2, strides=2),
+        layers.Cropping2D(1),
+        layers.LeakyReLU(negative_slope=0.2),
+        layers.GaussianDropout(0.2),
+        layers.GlobalAveragePooling2D(),
+        layers.Dense(3, activation="softmax"),
+    ])
+    x_conv = rs.rand(4, 8, 8, 3).astype(np.float32)
+    y_conv = conv.predict(x_conv, verbose=0)
+    conv.save(os.path.join(HERE, "keras_seq_convs.h5"))
+
+    keras.utils.set_random_seed(11)
+    seq1d = keras.Sequential([
+        keras.Input((10, 6)),
+        layers.Conv1D(8, 3, padding="same", activation="relu"),
+        layers.MaxPooling1D(2),
+        layers.Conv1D(4, 3, padding="same"),
+        layers.ELU(alpha=0.7),
+        layers.GlobalMaxPooling1D(),
+        layers.Dense(2, activation="sigmoid"),
+    ])
+    x_1d = rs.rand(4, 10, 6).astype(np.float32)
+    y_1d = seq1d.predict(x_1d, verbose=0)
+    seq1d.save(os.path.join(HERE, "keras_seq_1d.h5"))
+
+    np.savez(os.path.join(HERE, "keras_extra_expected.npz"),
+             x_conv=x_conv, y_conv=y_conv, x_1d=x_1d, y_1d=y_1d)
+    print("convs:", y_conv.shape, "1d:", y_1d.shape)
+
+
+if __name__ == "__main__":
+    main()

@@ -99,3 +99,24 @@ class TestTFGraphImport:
         nd.name, nd.op = "weird", "SomeExoticOp"
         with pytest.raises(ValueError, match="SomeExoticOp"):
             TFGraphMapper._map_node(SameDiff.create(), nd, {}, lambda i: None)
+
+
+class TestKerasExtendedLayers:
+    """Round-4 mapper surface: separable/depthwise/transpose convs, 1D
+    convs/pools, cropping, advanced activations, noise layers — exact
+    prediction parity vs real Keras (fixtures: gen_keras_extra.py)."""
+
+    def test_conv_variants_match_keras(self):
+        net = KerasModelImport.import_keras_sequential_model_and_weights(
+            os.path.join(FIX, "keras_seq_convs.h5"))
+        exp = np.load(os.path.join(FIX, "keras_extra_expected.npz"))
+        out = np.asarray(net.output(exp["x_conv"]))
+        np.testing.assert_allclose(out, exp["y_conv"], rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_1d_stack_matches_keras(self):
+        net = KerasModelImport.import_keras_sequential_model_and_weights(
+            os.path.join(FIX, "keras_seq_1d.h5"))
+        exp = np.load(os.path.join(FIX, "keras_extra_expected.npz"))
+        out = np.asarray(net.output(exp["x_1d"]))
+        np.testing.assert_allclose(out, exp["y_1d"], rtol=1e-4, atol=1e-5)
