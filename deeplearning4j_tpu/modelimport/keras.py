@@ -33,7 +33,7 @@ from ..nn.layers.convolutional import (Convolution1D, Cropping2D,
                                        DepthwiseConvolution2D,
                                        SeparableConvolution2D,
                                        Subsampling1DLayer)
-from ..nn.layers.recurrent import LSTM, LastTimeStep, SimpleRnn
+from ..nn.layers.recurrent import GRU, LSTM, LastTimeStep, SimpleRnn
 from ..nn.conf.dropout import (AlphaDropout, GaussianDropout, GaussianNoise,
                                SpatialDropout)
 from ..nn.multilayer import MultiLayerNetwork
@@ -48,12 +48,12 @@ _ACTIVATIONS = {
 }
 
 
-def _act(cfg) -> str:
-    a = cfg.get("activation", "linear")
+def _act(cfg, key: str = "activation", default: str = "linear") -> str:
+    a = cfg.get(key, default)
     if isinstance(a, dict):  # serialized activation object
-        a = a.get("class_name", "linear").lower()
+        a = a.get("class_name", default).lower()
     if a not in _ACTIVATIONS:
-        raise ValueError(f"unsupported Keras activation {a!r}")
+        raise ValueError(f"unsupported Keras {key} {a!r}")
     return _ACTIVATIONS[a]
 
 
@@ -122,9 +122,8 @@ def _map_layer(class_name: str, cfg: dict) -> Optional[object]:
                               name=name)
     if class_name == "LSTM":
         lstm = LSTM(n_out=cfg["units"], activation=_act(cfg),
-                    gate_activation=_ACTIVATIONS.get(
-                        cfg.get("recurrent_activation", "sigmoid"),
-                        "sigmoid"),
+                    gate_activation=_act(cfg, "recurrent_activation",
+                                         "sigmoid"),
                     name=name)
         if not cfg.get("return_sequences", False):
             return LastTimeStep(lstm, name=name)
@@ -134,6 +133,14 @@ def _map_layer(class_name: str, cfg: dict) -> Optional[object]:
         if not cfg.get("return_sequences", False):
             return LastTimeStep(rnn, name=name)
         return rnn
+    if class_name == "GRU":
+        gru = GRU(n_out=cfg["units"], activation=_act(cfg),
+                  gate_activation=_act(cfg, "recurrent_activation",
+                                       "sigmoid"),
+                  reset_after=cfg.get("reset_after", True), name=name)
+        if not cfg.get("return_sequences", False):
+            return LastTimeStep(gru, name=name)
+        return gru
     if class_name in ("Conv1D", "Convolution1D"):
         k = cfg["kernel_size"]
         return Convolution1D(
@@ -252,6 +259,12 @@ _PARAM_MAP = {
     "embedding": {"W": "embeddings"},
     "lstm": {"W": "kernel", "U": "recurrent_kernel", "b": "bias"},
     "simplernn": {"W": "kernel", "U": "recurrent_kernel", "b": "bias"},
+    # keras GRU with reset_after stores bias as (2, 3H): row 0 input
+    # bias, row 1 recurrent bias
+    "gru": {"W": "kernel", "U": "recurrent_kernel",
+            "b": ("bias", lambda w: w[0] if w.ndim == 2 else w),
+            "b_rec": ("bias", lambda w: w[1] if w.ndim == 2 else
+                      np.zeros_like(w))},
     # keras Conv2DTranspose kernel is (kh, kw, out, in) applied with
     # transpose_kernel=True; our deconv2d runs lax.conv_transpose with a
     # plain HWIO kernel, so convert by flipping the spatial dims and

@@ -744,10 +744,10 @@ def from_json(d: dict) -> Layer:
 # -- submodule layer catalogs (registered on import) -------------------
 from .recurrent import (BaseRecurrentLayer, Bidirectional,  # noqa: E402
                         EmbeddingSequenceLayer, GravesBidirectionalLSTM,
-                        GravesLSTM, LastTimeStep, LSTM, MaskZeroLayer,
+                        GravesLSTM, GRU, LastTimeStep, LSTM, MaskZeroLayer,
                         RepeatVector, RnnLossLayer, RnnOutputLayer, SimpleRnn)
 
-for _cls in (LSTM, GravesLSTM, SimpleRnn, Bidirectional,
+for _cls in (LSTM, GravesLSTM, GRU, SimpleRnn, Bidirectional,
              GravesBidirectionalLSTM, LastTimeStep, MaskZeroLayer,
              EmbeddingSequenceLayer, RnnOutputLayer, RnnLossLayer,
              RepeatVector):

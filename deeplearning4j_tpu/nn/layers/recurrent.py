@@ -216,6 +216,99 @@ class GravesLSTM(LSTM):
         return jnp.swapaxes(out_t, 0, 1), state, (h, c)
 
 
+class GRU(BaseRecurrentLayer):
+    """Gated recurrent unit (ref: the libnd4j `gru`/`gruCell` declarable
+    ops, `include/ops/declarable/headers/recurrent.h` — the reference's
+    nd4j catalog carries GRU even though dl4j-nn ships no GRU layer
+    conf; here it is a first-class layer so Keras GRU models import).
+
+    Gate layout [z|r|h] over 3H columns (Keras convention, so import is
+    a copy). ``reset_after=True`` reproduces Keras >=2.1 semantics
+    (recurrent bias applied inside the reset gate product, bias shape
+    (2, 3H) split into b / b_rec); False is the classic Cho et al.
+    formulation."""
+
+    kind = "gru"
+
+    def __init__(self, n_out: int = None, gate_activation="sigmoid",
+                 reset_after: bool = False, **kw):
+        kw.setdefault("activation", "tanh")
+        super().__init__(n_out=n_out, **kw)
+        self.gate_activation = A.get(gate_activation)
+        self.reset_after = bool(reset_after)
+
+    def param_shapes(self):
+        sh = {"W": (self.n_in, 3 * self.n_out),
+              "U": (self.n_out, 3 * self.n_out),
+              "b": (3 * self.n_out,)}
+        if self.reset_after:
+            sh["b_rec"] = (3 * self.n_out,)
+        return sh
+
+    def init_params(self, rng, dtype=jnp.float32):
+        kW, kU = jax.random.split(rng)
+        H = self.n_out
+        p = {"W": init_weights(kW, (self.n_in, 3 * H), self.n_in, 3 * H,
+                               self.weight_init, dtype),
+             "U": init_weights(kU, (H, 3 * H), H, 3 * H, self.weight_init,
+                               dtype),
+             "b": jnp.zeros((3 * H,), dtype)}
+        if self.reset_after:
+            p["b_rec"] = jnp.zeros((3 * H,), dtype)
+        return p
+
+    def init_carry(self, batch, dtype=jnp.float32):
+        return jnp.zeros((batch, self.n_out), dtype)
+
+    def _step_fn(self, params):
+        H = self.n_out
+        U = params["U"]
+        ga, act = self.gate_activation, self.activation
+
+        def cell(h_prev, xz):
+            if self.reset_after:
+                rz = h_prev @ U + params["b_rec"]
+                z = ga(xz[:, :H] + rz[:, :H])
+                r = ga(xz[:, H:2 * H] + rz[:, H:2 * H])
+                hh = act(xz[:, 2 * H:] + r * rz[:, 2 * H:])
+            else:
+                zr = h_prev @ U[:, :2 * H]  # one fused recurrent matmul
+                z = ga(xz[:, :H] + zr[:, :H])
+                r = ga(xz[:, H:2 * H] + zr[:, H:])
+                hh = act(xz[:, 2 * H:] + (r * h_prev) @ U[:, 2 * H:])
+            return z * h_prev + (1.0 - z) * hh
+        return cell
+
+    def apply_seq(self, params, x, state, train, rng, carry, mask):
+        x = self._maybe_dropout(x, train, rng)
+        B, T, _ = x.shape
+        xz = (x.reshape(B * T, -1) @ params["W"]).reshape(B, T, -1) \
+            + params["b"]
+        xz_t = jnp.swapaxes(xz, 0, 1)                        # [T, B, 3H]
+        mask_t = None if mask is None else jnp.swapaxes(
+            mask.astype(x.dtype), 0, 1)
+        cell = self._step_fn(params)
+
+        def step(h_prev, inp):
+            if mask is None:
+                h = cell(h_prev, inp)
+                return h, h
+            z_t, m_t = inp
+            h = cell(h_prev, z_t)
+            h = _mask_step(m_t, h, h_prev)
+            return h, h * m_t[:, None]
+
+        xs = xz_t if mask is None else (xz_t, mask_t)
+        h, out_t = lax.scan(step, carry, xs)
+        return jnp.swapaxes(out_t, 0, 1), state, h
+
+    def _extra_json(self):
+        d = super()._extra_json()
+        d["gate_activation"] = self.gate_activation.to_json()
+        d["reset_after"] = self.reset_after
+        return d
+
+
 class SimpleRnn(BaseRecurrentLayer):
     """Vanilla RNN: h_t = act(x_t·W + h_{t-1}·U + b).
     Ref: `nn/conf/layers/recurrent/SimpleRnn.java`."""

@@ -49,9 +49,21 @@ def main():
     y_1d = seq1d.predict(x_1d, verbose=0)
     seq1d.save(os.path.join(HERE, "keras_seq_1d.h5"))
 
+    keras.utils.set_random_seed(13)
+    gru = keras.Sequential([
+        keras.Input((7, 5)),
+        layers.GRU(6, return_sequences=True),
+        layers.GRU(4),
+        layers.Dense(3, activation="softmax"),
+    ])
+    x_gru = rs.rand(4, 7, 5).astype(np.float32)
+    y_gru = gru.predict(x_gru, verbose=0)
+    gru.save(os.path.join(HERE, "keras_seq_gru.h5"))
+
     np.savez(os.path.join(HERE, "keras_extra_expected.npz"),
-             x_conv=x_conv, y_conv=y_conv, x_1d=x_1d, y_1d=y_1d)
-    print("convs:", y_conv.shape, "1d:", y_1d.shape)
+             x_conv=x_conv, y_conv=y_conv, x_1d=x_1d, y_1d=y_1d,
+             x_gru=x_gru, y_gru=y_gru)
+    print("convs:", y_conv.shape, "1d:", y_1d.shape, "gru:", y_gru.shape)
 
 
 if __name__ == "__main__":

@@ -120,3 +120,10 @@ class TestKerasExtendedLayers:
         exp = np.load(os.path.join(FIX, "keras_extra_expected.npz"))
         out = np.asarray(net.output(exp["x_1d"]))
         np.testing.assert_allclose(out, exp["y_1d"], rtol=1e-4, atol=1e-5)
+
+    def test_gru_stack_matches_keras(self):
+        net = KerasModelImport.import_keras_sequential_model_and_weights(
+            os.path.join(FIX, "keras_seq_gru.h5"))
+        exp = np.load(os.path.join(FIX, "keras_extra_expected.npz"))
+        out = np.asarray(net.output(exp["x_gru"]))
+        np.testing.assert_allclose(out, exp["y_gru"], rtol=1e-4, atol=1e-5)

@@ -204,3 +204,82 @@ def test_json_roundtrip_recurrent():
     m = MultiLayerNetwork(c2).init()
     out = m.output(np.zeros((2, 10, 4), np.float32))
     assert out.shape == (2, 10, 3)
+
+
+class TestGRU:
+    """GRU layer (ref: libnd4j gru/gruCell declarable ops — first-class
+    layer here so Keras GRU imports; Cho-style and Keras reset_after
+    variants)."""
+
+    def _net(self, reset_after=False):
+        from deeplearning4j_tpu.nn.layers import GRU, RnnOutputLayer
+        conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(5e-3))
+                .weight_init("xavier").list()
+                .layer(GRU(n_out=10, reset_after=reset_after))
+                .layer(RnnOutputLayer(n_out=2, loss="mcxent",
+                                      activation="softmax"))
+                .input_type_recurrent(4).build())
+        return MultiLayerNetwork(conf).init()
+
+    @pytest.mark.parametrize("reset_after", [False, True])
+    def test_learns_sequence_task(self, reset_after):
+        rs = np.random.RandomState(0)
+        x = rs.rand(32, 6, 4).astype(np.float32)
+        y_idx = (x.sum(-1) > 2.0).astype(int)
+        y = np.eye(2, dtype=np.float32)[y_idx]
+        m = self._net(reset_after)
+        losses = []
+        for _ in range(60):
+            m.fit(x, y)
+            losses.append(m.score_)
+        assert losses[-1] < losses[0] - 0.1, (losses[0], losses[-1])
+
+    def test_masking_holds_state(self):
+        from deeplearning4j_tpu.nn.layers import GRU
+        lay = GRU(n_out=3)
+        lay.build((5, 4), {"weight_init": "xavier"})
+        p = lay.init_params(jax.random.PRNGKey(0))
+        x = jnp.asarray(np.random.RandomState(1).rand(2, 5, 4),
+                        jnp.float32)
+        mask = jnp.asarray([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], jnp.float32)
+        out, _, h = lay.apply_seq(p, x, {}, False, None,
+                                  lay.init_carry(2), mask)
+        out = np.asarray(out)
+        # masked-out steps emit zeros; carry holds the last valid state
+        assert (out[0, 3:] == 0).all()
+        out_short, _, h_short = lay.apply_seq(
+            p, x[:, :3], {}, False, None, lay.init_carry(2), None)
+        np.testing.assert_allclose(np.asarray(h)[0],
+                                   np.asarray(h_short)[0], rtol=1e-5)
+
+    def test_json_round_trip(self):
+        m = self._net(reset_after=True)
+        conf2 = MultiLayerConfiguration.from_json(m.conf.to_json())
+        from deeplearning4j_tpu.nn.layers import GRU
+        assert isinstance(conf2.layers[0], GRU)
+        assert conf2.layers[0].reset_after is True
+        MultiLayerNetwork(conf2).init()
+
+    def test_gradcheck(self):
+        from deeplearning4j_tpu.nn.layers import GRU
+        lay = GRU(n_out=3, reset_after=True)
+        lay.build((4, 2), {"weight_init": "xavier"})
+        p = lay.init_params(jax.random.PRNGKey(1))
+        x = jnp.asarray(np.random.RandomState(2).rand(3, 4, 2), jnp.float32)
+
+        def loss(params):
+            out, _, _ = lay.apply_seq(params, x, {}, False, None,
+                                      lay.init_carry(3), None)
+            return jnp.sum(out ** 2)
+
+        g = jax.grad(loss)(p)
+        eps = 1e-3
+        for name in ("W", "U", "b", "b_rec"):
+            w = p[name]
+            idx = (0,) * w.ndim
+            pp = dict(p); pp[name] = w.at[idx].add(eps)
+            pm = dict(p); pm[name] = w.at[idx].add(-eps)
+            num = (float(loss(pp)) - float(loss(pm))) / (2 * eps)
+            ana = float(g[name][idx])
+            assert abs(ana - num) < 2e-2 * max(1.0, abs(num)), \
+                (name, ana, num)
