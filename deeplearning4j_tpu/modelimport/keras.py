@@ -33,7 +33,8 @@ from ..nn.layers.convolutional import (Convolution1D, Cropping2D,
                                        DepthwiseConvolution2D,
                                        SeparableConvolution2D,
                                        Subsampling1DLayer)
-from ..nn.layers.recurrent import GRU, LSTM, LastTimeStep, SimpleRnn
+from ..nn.layers.recurrent import (GRU, LSTM, Bidirectional, LastTimeStep,
+                                   SimpleRnn)
 from ..nn.conf.dropout import (AlphaDropout, GaussianDropout, GaussianNoise,
                                SpatialDropout)
 from ..nn.multilayer import MultiLayerNetwork
@@ -141,6 +142,22 @@ def _map_layer(class_name: str, cfg: dict) -> Optional[object]:
         if not cfg.get("return_sequences", False):
             return LastTimeStep(gru, name=name)
         return gru
+    if class_name == "Bidirectional":
+        inner_cfg = cfg["layer"]["config"]
+        if not inner_cfg.get("return_sequences", False):
+            raise ValueError(
+                "Bidirectional(return_sequences=False) import is "
+                "unsupported: Keras merges each direction's LAST output, "
+                "which has no LastTimeStep equivalent here — re-export "
+                "with return_sequences=True + a pooling layer")
+        inner = _map_layer(cfg["layer"]["class_name"], inner_cfg)
+        mode = {"concat": "concat", "sum": "add", "mul": "mul",
+                "ave": "average"}.get(cfg.get("merge_mode", "concat"))
+        if mode is None:
+            raise ValueError(
+                f"unsupported Bidirectional merge_mode "
+                f"{cfg.get('merge_mode')!r}")
+        return Bidirectional(layer=inner, mode=mode, name=name)
     if class_name in ("Conv1D", "Convolution1D"):
         k = cfg["kernel_size"]
         return Convolution1D(
@@ -236,6 +253,12 @@ def _layer_weights(f: h5py.File, layer_name: str) -> Dict[str, np.ndarray]:
         if isinstance(obj, h5py.Dataset):
             base = name.split("/")[-1].split(":")[0]
             out[base] = np.asarray(obj)
+            # Bidirectional wrappers nest forward_*/backward_* groups
+            # whose basenames collide; keep direction-prefixed copies
+            if "forward" in name:
+                out[f"forward:{base}"] = out[base]
+            elif "backward" in name:
+                out[f"backward:{base}"] = out[base]
     grp[layer_name].visititems(visit)
     return out
 
@@ -282,7 +305,21 @@ _PARAM_MAP = {
 
 
 def _translate_params(kind: str, ours: dict, keras_w: Dict[str, np.ndarray],
-                      layer_name: str) -> dict:
+                      layer_name: str, layer=None) -> dict:
+    if kind == "bidirectional":
+        # split direction-prefixed datasets, translate each half with the
+        # wrapped layer's own mapping, re-prefix to our f_/b_ params
+        inner_kind = layer.layer.kind if layer is not None else "lstm"
+        fwd = {k.split(":", 1)[1]: v for k, v in keras_w.items()
+               if k.startswith("forward:")}
+        bwd = {k.split(":", 1)[1]: v for k, v in keras_w.items()
+               if k.startswith("backward:")}
+        ours_f = {k[2:]: v for k, v in ours.items() if k.startswith("f_")}
+        ours_b = {k[2:]: v for k, v in ours.items() if k.startswith("b_")}
+        tf_ = _translate_params(inner_kind, ours_f, fwd, layer_name)
+        tb_ = _translate_params(inner_kind, ours_b, bwd, layer_name)
+        return {**{f"f_{k}": v for k, v in tf_.items()},
+                **{f"b_{k}": v for k, v in tb_.items()}}
     mapping = _PARAM_MAP.get(kind)
     if mapping is None:
         if ours:
@@ -386,7 +423,8 @@ class KerasModelImport:
                 kind = _wrapped_kind(layer)
                 if key in net._params:
                     net._params[key] = _translate_params(
-                        kind, net._params[key], keras_w, kname)
+                        kind, net._params[key], keras_w, kname,
+                        layer=layer)
                 if kind == "batchnorm":
                     st = _bn_state(keras_w)
                     if st is not None:
@@ -453,7 +491,8 @@ class KerasModelImport:
                 kind = _wrapped_kind(layer)
                 if nm in graph._params:
                     graph._params[nm] = _translate_params(
-                        kind, graph._params[nm], keras_w, nm)
+                        kind, graph._params[nm], keras_w, nm,
+                        layer=layer)
                 if kind == "batchnorm":
                     st = _bn_state(keras_w)
                     if st is not None:

@@ -60,10 +60,22 @@ def main():
     y_gru = gru.predict(x_gru, verbose=0)
     gru.save(os.path.join(HERE, "keras_seq_gru.h5"))
 
+    keras.utils.set_random_seed(17)
+    bidir = keras.Sequential([
+        keras.Input((6, 4)),
+        layers.Bidirectional(layers.LSTM(5, return_sequences=True)),
+        layers.GlobalAveragePooling1D(),
+        layers.Dense(2, activation="softmax"),
+    ])
+    x_bidir = np.random.RandomState(0).rand(4, 6, 4).astype(np.float32)
+    y_bidir = bidir.predict(x_bidir, verbose=0)
+    bidir.save(os.path.join(HERE, "keras_seq_bidir.h5"))
+
     np.savez(os.path.join(HERE, "keras_extra_expected.npz"),
              x_conv=x_conv, y_conv=y_conv, x_1d=x_1d, y_1d=y_1d,
-             x_gru=x_gru, y_gru=y_gru)
-    print("convs:", y_conv.shape, "1d:", y_1d.shape, "gru:", y_gru.shape)
+             x_gru=x_gru, y_gru=y_gru, x_bidir=x_bidir, y_bidir=y_bidir)
+    print("convs:", y_conv.shape, "1d:", y_1d.shape, "gru:", y_gru.shape,
+          "bidir:", y_bidir.shape)
 
 
 if __name__ == "__main__":

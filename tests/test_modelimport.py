@@ -127,3 +127,11 @@ class TestKerasExtendedLayers:
         exp = np.load(os.path.join(FIX, "keras_extra_expected.npz"))
         out = np.asarray(net.output(exp["x_gru"]))
         np.testing.assert_allclose(out, exp["y_gru"], rtol=1e-4, atol=1e-5)
+
+    def test_bidirectional_matches_keras(self):
+        net = KerasModelImport.import_keras_sequential_model_and_weights(
+            os.path.join(FIX, "keras_seq_bidir.h5"))
+        exp = np.load(os.path.join(FIX, "keras_extra_expected.npz"))
+        out = np.asarray(net.output(exp["x_bidir"]))
+        np.testing.assert_allclose(out, exp["y_bidir"], rtol=1e-4,
+                                   atol=1e-5)
