@@ -92,22 +92,34 @@ def _neg_table(vocab: VocabCache, size: int = 1 << 17,
 def _gen_pairs(sentences_idx: List[np.ndarray], window: int,
                rng: np.random.RandomState):
     """Dynamic-window (center, context) pairs (ref: SkipGram.java uses
-    b ~ U(0, window) shrinkage like word2vec.c)."""
+    b ~ U(0, window) shrinkage like word2vec.c).
+
+    Vectorized: for each offset d in [1, window], one boolean mask picks
+    the centers whose shrunk window covers d — O(window) numpy ops per
+    sentence instead of a per-token python loop (same pair multiset as
+    the naive nested loop; ordering differs but every epoch shuffles)."""
     centers, contexts = [], []
     for s in sentences_idx:
         n = len(s)
         if n < 2:
             continue
         b = rng.randint(1, window + 1, size=n)
-        for i in range(n):
-            lo, hi = max(0, i - b[i]), min(n, i + b[i] + 1)
-            for j in range(lo, hi):
-                if j != i:
-                    centers.append(s[i])
-                    contexts.append(s[j])
+        for d in range(1, window + 1):
+            if d >= n:
+                break
+            sel = b >= d
+            right = sel[:n - d]       # context at i + d
+            if right.any():
+                centers.append(s[:n - d][right])
+                contexts.append(s[d:][right])
+            left = sel[d:]            # context at i - d
+            if left.any():
+                centers.append(s[d:][left])
+                contexts.append(s[:n - d][left])
     if not centers:
         return (np.zeros(0, np.int32),) * 2
-    return (np.asarray(centers, np.int32), np.asarray(contexts, np.int32))
+    return (np.concatenate(centers).astype(np.int32),
+            np.concatenate(contexts).astype(np.int32))
 
 
 def _gen_cbow(sentences_idx: List[np.ndarray], window: int,
