@@ -125,7 +125,13 @@ def _gen_pairs(sentences_idx: List[np.ndarray], window: int,
 def _gen_cbow(sentences_idx: List[np.ndarray], window: int,
               rng: np.random.RandomState):
     """CBOW windows: (center, padded context matrix, mask) — the whole
-    window averages into one prediction (ref: CBOW.java)."""
+    window averages into one prediction (ref: CBOW.java).
+
+    Vectorized like _gen_pairs: column 2(d-1) holds the i-d context,
+    column 2(d-1)+1 the i+d context, masked where the shrunk window or
+    the sentence boundary excludes them (the mean over masked entries is
+    layout-independent, so the packed-vs-fixed column order does not
+    change the model)."""
     W = 2 * window
     centers, ctx, mask = [], [], []
     for s in sentences_idx:
@@ -133,23 +139,28 @@ def _gen_cbow(sentences_idx: List[np.ndarray], window: int,
         if n < 2:
             continue
         b = rng.randint(1, window + 1, size=n)
-        for i in range(n):
-            lo, hi = max(0, i - b[i]), min(n, i + b[i] + 1)
-            c = [s[j] for j in range(lo, hi) if j != i]
-            if not c:
-                continue
-            row = np.zeros(W, np.int32)
-            m = np.zeros(W, np.float32)
-            row[:len(c)] = c
-            m[:len(c)] = 1.0
-            centers.append(s[i])
-            ctx.append(row)
-            mask.append(m)
+        row = np.zeros((n, W), np.int64)
+        m = np.zeros((n, W), np.float32)
+        idx = np.arange(n)
+        for d in range(1, window + 1):
+            covered = b >= d
+            left = covered & (idx >= d)
+            right = covered & (idx < n - d)
+            row[left, 2 * (d - 1)] = s[idx[left] - d]
+            m[left, 2 * (d - 1)] = 1.0
+            row[right, 2 * (d - 1) + 1] = s[idx[right] + d]
+            m[right, 2 * (d - 1) + 1] = 1.0
+        keep = m.any(axis=1)
+        if keep.any():
+            centers.append(s[keep])
+            ctx.append(row[keep])
+            mask.append(m[keep])
     if not centers:
         return (np.zeros(0, np.int32), np.zeros((0, W), np.int32),
                 np.zeros((0, W), np.float32))
-    return (np.asarray(centers, np.int32), np.asarray(ctx),
-            np.asarray(mask))
+    return (np.concatenate(centers).astype(np.int32),
+            np.concatenate(ctx).astype(np.int32),
+            np.concatenate(mask).astype(np.float32))
 
 
 class Word2Vec(_EmbeddingModel):
