@@ -700,6 +700,67 @@ class ComputationGraph:
         return (item.features, item.labels,
                 getattr(item, "labels_mask", None))
 
+    # -- layerwise unsupervised pretraining (ref: ComputationGraph.pretrain
+    # — used by VariationalAutoencoder nodes) ---------------------------
+    def pretrain(self, iterator, epochs: int = 1):
+        """Pretrain every pretrainable (VAE) node in topological order on
+        the frozen activations of its upstream subgraph (ref:
+        ComputationGraph.pretrain(DataSetIterator))."""
+        if self._params is None:
+            self.init()
+        if not hasattr(iterator, "reset") and \
+                not isinstance(iterator, (list, tuple)):
+            iterator = list(iterator)
+        for name in self._order:
+            node = self.conf.nodes[name]
+            if node.layer is not None and \
+                    getattr(node.layer, "is_pretrain_layer", False):
+                self.pretrain_node(name, iterator, epochs=epochs)
+        return self
+
+    def pretrain_node(self, name: str, iterator, epochs: int = 1):
+        """Pretrain one node on its unsupervised loss (ref:
+        ComputationGraph.pretrainLayer). Only that node's params move."""
+        node = self.conf.nodes[name]
+        layer = node.layer
+        if layer is None or not getattr(layer, "is_pretrain_layer", False):
+            raise ValueError(f"node {name!r} is not pretrainable")
+        in_node = node.inputs[0]
+        updater = self._updaters[name]
+
+        @jax.jit
+        def pre_step(p, opt, step, feats, rng):
+            loss, g = jax.value_and_grad(
+                lambda pp: layer.pretrain_loss(pp, feats, rng))(p)
+            st, upd = updater.apply(opt, g, step)
+            new_p = jax.tree_util.tree_map(lambda a, u: a - u, p, upd)
+            return new_p, st, loss
+
+        @jax.jit
+        def features(params, net_state, inputs):
+            acts, _ = self._forward(params, net_state, inputs, False,
+                                    None, stop_at=in_node)
+            return acts[in_node]
+
+        p, opt = self._params[name], self._opt_state[name]
+        step = 0
+        data = iterator if isinstance(iterator, (list, tuple)) \
+            else list(iterator)
+        loss = None
+        for _ in range(epochs):
+            for item in data:
+                x = self._unpack(item)[0]
+                feats = features(self._params, self._net_state,
+                                 self._as_inputs(x))
+                self._rng, sub = jax.random.split(self._rng)
+                p, opt, loss = pre_step(p, opt, jnp.asarray(step), feats,
+                                        sub)
+                step += 1
+        self._params[name] = p
+        self._opt_state[name] = opt
+        self._last_loss = loss
+        return self
+
     def _as_masks(self, m):
         if m is None:
             return None

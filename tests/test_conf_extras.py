@@ -332,3 +332,42 @@ class TestVAE:
         assert v.encoder_layer_sizes == (16,)
         assert v.reconstruction_distribution == "gaussian"
         MultiLayerNetwork(conf2).init()
+
+
+class TestGraphVAEPretrain:
+    """VAE pretraining inside a ComputationGraph (ref:
+    ComputationGraph.pretrain)."""
+
+    def test_pretrain_node_reduces_elbo(self):
+        from deeplearning4j_tpu.nn import (ComputationGraph,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf import InputType
+        from deeplearning4j_tpu.nn.layers import OutputLayer
+
+        g = (NeuralNetConfiguration.builder().seed(9).updater(Adam(1e-2))
+             .weight_init("xavier").graph_builder()
+             .add_inputs("in")
+             .set_input_types(InputType.feed_forward(8)))
+        g.add_layer("vae", VariationalAutoencoder(
+            n_out=3, encoder_layer_sizes=(12,), decoder_layer_sizes=(12,),
+            activation="tanh"), "in")
+        g.add_layer("out", OutputLayer(n_out=2, loss="mcxent",
+                                       activation="softmax"), "vae")
+        g.set_outputs("out")
+        net = ComputationGraph(g.build()).init()
+
+        rs = np.random.RandomState(0)
+        x = np.concatenate([rs.randn(48, 8) * 0.3 + 1.0,
+                            rs.randn(48, 8) * 0.3 - 1.0]).astype(np.float32)
+        vae = net.conf.nodes["vae"].layer
+        l0 = float(vae.pretrain_loss(net._params["vae"], jnp.asarray(x),
+                                     RNG))
+        net.pretrain([(x, None)], epochs=40)
+        l1 = float(vae.pretrain_loss(net._params["vae"], jnp.asarray(x),
+                                     RNG))
+        assert l1 < l0 - 0.5, (l0, l1)
+        # supervised fine-tune through the pretrained encoder still works
+        y = np.eye(2, dtype=np.float32)[
+            np.repeat([0, 1], 48)]
+        net.fit(x, y, epochs=5)
+        assert np.isfinite(net.score_)
