@@ -12,6 +12,8 @@ recompiling, and ETL stays on host threads off the device critical path
 (the reference's AsyncDataSetIterator philosophy, SURVEY.md §2.3 D8).
 """
 from .schema import ColumnType, Schema
+from .analysis import (CategoricalColumnAnalysis, DataAnalysis,
+                       NumericalColumnAnalysis, analyze)
 from .records import (CSVRecordReader, CSVSequenceRecordReader,
                       CollectionRecordReader, ImageRecordReader,
                       LineRecordReader, NumpyRecordReader, RecordReader)

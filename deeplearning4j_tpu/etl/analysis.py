@@ -1,0 +1,180 @@
+"""Data analysis (ref: DataVec `datavec-local/.../AnalyzeLocal.java` +
+`datavec-api/.../transform/analysis/DataAnalysis.java` and the
+per-column `*AnalysisCounter` hierarchy: one pass over a record reader
+producing per-column statistics — min/max/mean/stddev/zero and
+positive/negative counts + histograms for numeric columns, unique value
+counts for categorical/string, used to drive normalizers and data-
+quality checks before training).
+
+TPU-first: the analysis is host-side numpy (it feeds config decisions,
+not the device hot path); accumulation is streaming (Welford), so the
+reader never needs to fit in memory.
+"""
+from __future__ import annotations
+
+import json
+import math
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .schema import ColumnType, Schema
+
+
+class NumericalColumnAnalysis:
+    """Ref: `analysis/columns/DoubleAnalysis.java` (+Integer/Long)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.count_zero = 0
+        self.count_positive = 0
+        self.count_negative = 0
+        self.count_nan = 0
+        self.min: float = math.inf
+        self.max: float = -math.inf
+        self._mean = 0.0
+        self._m2 = 0.0
+        self._values: List[float] = []   # reservoir for the histogram
+
+    _RESERVOIR = 100_000
+
+    def add(self, v: float):
+        v = float(v)
+        if math.isnan(v):
+            self.count_nan += 1
+            return
+        self.count += 1
+        if v == 0:
+            self.count_zero += 1
+        elif v > 0:
+            self.count_positive += 1
+        else:
+            self.count_negative += 1
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+        d = v - self._mean                 # Welford streaming moments
+        self._mean += d / self.count
+        self._m2 += d * (v - self._mean)
+        if len(self._values) < self._RESERVOIR:
+            self._values.append(v)
+
+    @property
+    def mean(self) -> float:
+        return self._mean if self.count else float("nan")
+
+    @property
+    def stddev(self) -> float:
+        if self.count < 2:
+            return 0.0
+        return math.sqrt(self._m2 / (self.count - 1))
+
+    def histogram(self, bins: int = 20):
+        """(counts, bin_edges) over the sampled values (ref: the
+        histogram buckets DataAnalysis renders)."""
+        if not self._values:
+            return np.zeros(bins), np.linspace(0, 1, bins + 1)
+        return np.histogram(np.asarray(self._values), bins=bins)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"type": "numerical", "count": self.count,
+                "min": None if self.count == 0 else self.min,
+                "max": None if self.count == 0 else self.max,
+                "mean": None if self.count == 0 else self.mean,
+                "stddev": self.stddev, "count_zero": self.count_zero,
+                "count_positive": self.count_positive,
+                "count_negative": self.count_negative,
+                "count_nan": self.count_nan}
+
+
+class CategoricalColumnAnalysis:
+    """Ref: `analysis/columns/CategoricalAnalysis.java` — per-category
+    counts (also used for string columns' unique accounting)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.category_counts: Dict[str, int] = {}
+
+    def add(self, v):
+        self.count += 1
+        key = str(v)
+        self.category_counts[key] = self.category_counts.get(key, 0) + 1
+
+    @property
+    def unique_count(self) -> int:
+        return len(self.category_counts)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"type": "categorical", "count": self.count,
+                "unique": self.unique_count,
+                "category_counts": dict(sorted(
+                    self.category_counts.items(),
+                    key=lambda kv: -kv[1])[:50])}
+
+
+class DataAnalysis:
+    """Ref: `transform/analysis/DataAnalysis.java` — schema + per-column
+    analyses, JSON-serializable for reports."""
+
+    def __init__(self, schema: Schema, analyses: Dict[str, Any]):
+        self.schema = schema
+        self.analyses = analyses
+
+    def column_analysis(self, name: str):
+        return self.analyses[name]
+
+    def to_json(self) -> str:
+        return json.dumps({n: a.to_dict() for n, a in self.analyses.items()},
+                          indent=2)
+
+    def __repr__(self):
+        rows = []
+        for n, a in self.analyses.items():
+            d = a.to_dict()
+            if d["type"] == "numerical":
+                rows.append(f"{n}: n={d['count']} min={d['min']} "
+                            f"max={d['max']} mean={d['mean']:.4g} "
+                            f"std={d['stddev']:.4g}")
+            else:
+                rows.append(f"{n}: n={d['count']} unique={d['unique']}")
+        return "DataAnalysis(\n  " + "\n  ".join(rows) + "\n)"
+
+
+_NUMERIC = {ColumnType.INTEGER, ColumnType.LONG, ColumnType.DOUBLE,
+            ColumnType.FLOAT}
+
+
+def analyze(schema: Schema, data) -> DataAnalysis:
+    """One streaming pass over `data` (a RecordReader or iterable of
+    rows) computing per-column statistics (ref:
+    `AnalyzeLocal.analyze(schema, recordReader)`)."""
+    analyses: Dict[str, Any] = {}
+    for meta in schema.columns:
+        if meta.type in _NUMERIC:
+            analyses[meta.name] = NumericalColumnAnalysis(meta.name)
+        else:
+            analyses[meta.name] = CategoricalColumnAnalysis(meta.name)
+    names = schema.column_names()
+
+    rows = data if not hasattr(data, "has_next") else _reader_iter(data)
+    for row in rows:
+        if len(row) != len(names):
+            raise ValueError(
+                f"row width {len(row)} != schema width {len(names)}")
+        for name, v in zip(names, row):
+            a = analyses[name]
+            if isinstance(a, NumericalColumnAnalysis):
+                try:
+                    a.add(float(v))
+                except (TypeError, ValueError):
+                    a.count_nan += 1
+            else:
+                a.add(v)
+    return DataAnalysis(schema, analyses)
+
+
+def _reader_iter(reader):
+    reader.reset()
+    while reader.has_next():
+        yield reader.next()

@@ -307,3 +307,63 @@ class TestNormalizers:
         ds = DataSet(x.copy(), np.zeros((10, 2), np.float32))
         NormalizerStandardize().fit(x).pre_process(ds)
         assert abs(np.asarray(ds.features).mean()) < 0.3
+
+
+class TestAnalyzeLocal:
+    """Ref: AnalyzeLocal.analyze + DataAnalysis — one-pass per-column
+    statistics over a record reader."""
+
+    def _schema(self):
+        from deeplearning4j_tpu.etl import Schema
+        return (Schema.Builder()
+                .add_column_double("x")
+                .add_column_integer("n")
+                .add_column_categorical("cat", "a", "b", "c")
+                .build())
+
+    def test_numeric_stats_match_numpy(self):
+        from deeplearning4j_tpu.etl import analyze
+        rs = np.random.RandomState(0)
+        xs = rs.randn(500) * 2.0 + 1.0
+        ns = rs.randint(-3, 4, 500)
+        cats = rs.choice(["a", "b", "c"], 500, p=[0.6, 0.3, 0.1])
+        rows = [[float(x), int(n), c] for x, n, c in zip(xs, ns, cats)]
+        da = analyze(self._schema(), rows)
+        ax = da.column_analysis("x")
+        assert ax.count == 500
+        np.testing.assert_allclose(ax.mean, xs.mean(), rtol=1e-9)
+        np.testing.assert_allclose(ax.stddev, xs.std(ddof=1), rtol=1e-9)
+        np.testing.assert_allclose(ax.min, xs.min())
+        np.testing.assert_allclose(ax.max, xs.max())
+        an = da.column_analysis("n")
+        assert an.count_zero == int((ns == 0).sum())
+        assert an.count_negative == int((ns < 0).sum())
+        ac = da.column_analysis("cat")
+        assert ac.unique_count == 3
+        assert ac.category_counts["a"] == int((cats == "a").sum())
+        counts, edges = ax.histogram(10)
+        assert counts.sum() == 500
+        # serializes for reports
+        import json as _json
+        blob = _json.loads(da.to_json())
+        assert blob["x"]["type"] == "numerical"
+
+    def test_analyze_record_reader(self):
+        """Streams straight from a CSVRecordReader (the reference's
+        entry point)."""
+        import tempfile
+        from deeplearning4j_tpu.etl import CSVRecordReader, analyze
+        with tempfile.NamedTemporaryFile("w", suffix=".csv",
+                                         delete=False) as f:
+            f.write("1.5,2,a\n-0.5,0,b\n3.25,7,a\n")
+            path = f.name
+        reader = CSVRecordReader(path=path)
+        da = analyze(self._schema(), reader)
+        ax = da.column_analysis("x")
+        assert ax.count == 3 and ax.min == -0.5 and ax.max == 3.25
+        assert da.column_analysis("cat").category_counts["a"] == 2
+
+    def test_row_width_mismatch_raises(self):
+        from deeplearning4j_tpu.etl import analyze
+        with pytest.raises(ValueError, match="width"):
+            analyze(self._schema(), [[1.0, 2]])
