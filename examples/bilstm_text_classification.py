@@ -1,0 +1,38 @@
+"""Bidirectional-LSTM sequence classification over token embeddings
+(ref: dl4j-examples RNN text classification family).
+Run: python examples/bilstm_text_classification.py"""
+import numpy as np
+
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (Bidirectional,
+                                          EmbeddingSequenceLayer,
+                                          LastTimeStep, LSTM, OutputLayer)
+
+
+def main(quick: bool = False):
+    VOCAB, T = 50, 12
+    rs = np.random.RandomState(0)
+    n = 256
+    # task: does the "positive" token bucket (ids < 25) dominate?
+    x = rs.randint(0, VOCAB, (n, T))
+    y_idx = (np.sum(x < VOCAB // 2, axis=1) > T // 2).astype(int)
+    y = np.eye(2, dtype=np.float32)[y_idx]
+
+    conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(5e-3))
+            .weight_init("xavier").list()
+            .layer(EmbeddingSequenceLayer(n_in=VOCAB, n_out=16))
+            .layer(Bidirectional(LSTM(n_out=16)))
+            .layer(LastTimeStep(LSTM(n_out=8)))
+            .layer(OutputLayer(n_out=2, loss="mcxent",
+                               activation="softmax"))
+            .input_type_recurrent(1, timesteps=T).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(x, y, epochs=15 if quick else 60)
+    acc = net.evaluate([(x, y)]).accuracy()
+    print(f"train accuracy: {acc:.3f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""DQN on CartPole (ref: rl4j-examples CartpoleDQN).
+Run: python examples/dqn_cartpole.py"""
+import numpy as np
+
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.rl import (CartPole, QLearningConfiguration,
+                                   QLearningDiscrete)
+
+
+def main(quick: bool = False):
+    env = CartPole(max_steps=200, seed=0)
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=64, activation="relu"))
+            .layer(DenseLayer(n_out=64, activation="relu"))
+            .layer(OutputLayer(n_out=env.n_actions, loss="mse",
+                               activation="identity"))
+            .input_type_feed_forward(env.obs_size).build())
+    net = MultiLayerNetwork(conf).init()
+    agent = QLearningDiscrete(env, net, QLearningConfiguration(
+        batch_size=32, exp_replay_size=5000, target_update_freq=200,
+        eps_anneal_steps=2000, double_dqn=True))
+    rewards = agent.train(episodes=10 if quick else 120)
+    tail = float(np.mean(rewards[-10:]))
+    print(f"mean reward over final 10 episodes: {tail:.1f}")
+    return tail
+
+
+if __name__ == "__main__":
+    main()

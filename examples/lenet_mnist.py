@@ -1,0 +1,44 @@
+"""LeNet on MNIST — BASELINE config 1, the reference's canonical starter
+(ref: dl4j-examples LenetMnistExample). Run: python examples/lenet_mnist.py"""
+import numpy as np
+
+from deeplearning4j_tpu.datasets import MnistDataSetIterator
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (ConvolutionLayer, DenseLayer,
+                                          OutputLayer, SubsamplingLayer)
+from deeplearning4j_tpu.optimize import ScoreIterationListener
+
+
+def main(quick: bool = False):
+    conf = (NeuralNetConfiguration.builder().seed(123).updater(Adam(1e-3))
+            .weight_init("relu").list()
+            .layer(ConvolutionLayer(n_out=20, kernel=(5, 5),
+                                    activation="relu"))
+            .layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+            .layer(ConvolutionLayer(n_out=50, kernel=(5, 5),
+                                    activation="relu"))
+            .layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=500, activation="relu"))
+            .layer(OutputLayer(n_out=10, loss="mcxent",
+                               activation="softmax"))
+            .input_type_convolutional(28, 28, 1).build())
+    net = MultiLayerNetwork(conf).init()
+    net.set_listeners(ScoreIterationListener(50))
+
+    n = 1024 if quick else None
+    train = MnistDataSetIterator(batch=128, train=True, flatten=False,
+                                 num_examples=n)
+    test = MnistDataSetIterator(batch=512, train=False, flatten=False,
+                                num_examples=n)
+    net.fit(train, epochs=1 if quick else 3)
+    ev = net.evaluate(test)
+    print(ev.stats())
+    if train.synthetic:
+        print("(synthetic MNIST fallback — accuracy is vs the synthetic "
+              "task, not the real test set)")
+    return ev.accuracy()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""VAE anomaly detection — unsupervised pretraining, then score samples
+by reconstruction error (ref: dl4j-examples VaeMNISTAnomaly).
+Run: python examples/vae_anomaly.py"""
+import numpy as np
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import OutputLayer
+from deeplearning4j_tpu.nn.layers.variational import VariationalAutoencoder
+
+
+def main(quick: bool = False):
+    rs = np.random.RandomState(0)
+    normal = (rs.randn(512, 16) * 0.4 + 1.0).astype(np.float32)
+    anomalies = (rs.randn(64, 16) * 0.4 - 2.5).astype(np.float32)
+
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-2))
+            .weight_init("xavier").list()
+            .layer(VariationalAutoencoder(
+                n_out=4, encoder_layer_sizes=(32,),
+                decoder_layer_sizes=(32,), activation="tanh"))
+            .layer(OutputLayer(n_out=2, loss="mcxent",
+                               activation="softmax"))
+            .input_type_feed_forward(16).build())
+    net = MultiLayerNetwork(conf).init()
+    net.pretrain([(normal, None)], epochs=15 if quick else 80)
+
+    vae = net.layers[0]
+    p = net._params["layer_0"]
+
+    def recon_error(x):
+        rec = np.asarray(vae.reconstruct(p, jnp.asarray(x)))
+        return np.mean((rec - x) ** 2, axis=1)
+
+    e_norm = recon_error(normal)
+    e_anom = recon_error(anomalies)
+    print(f"reconstruction error: normal {e_norm.mean():.4f}  "
+          f"anomalous {e_anom.mean():.4f}")
+    assert e_anom.mean() > e_norm.mean()
+    return e_anom.mean() / e_norm.mean()
+
+
+if __name__ == "__main__":
+    main()
