@@ -6,7 +6,7 @@ import jax.numpy as jnp
 
 from deeplearning4j_tpu.learning import Adam
 from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
-from deeplearning4j_tpu.nn.layers import OutputLayer
+from deeplearning4j_tpu.nn.layers import LossLayer
 from deeplearning4j_tpu.nn.layers.variational import VariationalAutoencoder
 
 
@@ -15,19 +15,21 @@ def main(quick: bool = False):
     normal = (rs.randn(512, 16) * 0.4 + 1.0).astype(np.float32)
     anomalies = (rs.randn(64, 16) * 0.4 - 2.5).astype(np.float32)
 
+    # VAE-only stack (like the reference's VaeMNISTAnomaly): the
+    # terminal LossLayer is identity plumbing so the net is well-formed;
+    # all the learning happens in unsupervised pretraining
     conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-2))
             .weight_init("xavier").list()
             .layer(VariationalAutoencoder(
                 n_out=4, encoder_layer_sizes=(32,),
                 decoder_layer_sizes=(32,), activation="tanh"))
-            .layer(OutputLayer(n_out=2, loss="mcxent",
-                               activation="softmax"))
+            .layer(LossLayer(loss="mse"))
             .input_type_feed_forward(16).build())
     net = MultiLayerNetwork(conf).init()
     net.pretrain([(normal, None)], epochs=15 if quick else 80)
 
     vae = net.layers[0]
-    p = net._params["layer_0"]
+    p = net.params()[net._layer_keys[0]]
 
     def recon_error(x):
         rec = np.asarray(vae.reconstruct(p, jnp.asarray(x)))

@@ -1,12 +1,22 @@
 """Every example under examples/ must run end to end in quick mode —
 the dl4j-examples role: living, executable documentation."""
+import glob
 import os
 import sys
 
-import pytest
+EX_DIR = os.path.join(os.path.dirname(__file__), "..", "examples")
+sys.path.insert(0, EX_DIR)
 
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
-                                "examples"))
+_COVERED = {"lenet_mnist", "vae_anomaly", "bilstm_text_classification",
+            "data_parallel", "dqn_cartpole", "transfer_learning"}
+
+
+def test_every_example_has_a_test():
+    """The docstring's contract, enforced: adding an example without a
+    matching test here fails the suite."""
+    on_disk = {os.path.splitext(os.path.basename(p))[0]
+               for p in glob.glob(os.path.join(EX_DIR, "*.py"))}
+    assert on_disk == _COVERED, on_disk ^ _COVERED
 
 
 def test_lenet_mnist():
