@@ -11,6 +11,7 @@ forward still runs on TPU; only argmax'd outputs land here.
 """
 from __future__ import annotations
 
+import math
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -19,10 +20,16 @@ import numpy as np
 class Evaluation:
     """Multi-class classification evaluation (ref: Evaluation.java)."""
 
-    def __init__(self, num_classes: Optional[int] = None, labels: Optional[List[str]] = None):
+    def __init__(self, num_classes: Optional[int] = None,
+                 labels: Optional[List[str]] = None, top_n: int = 1):
         self.num_classes = num_classes
         self.label_names = labels
         self._conf: Optional[np.ndarray] = None  # [actual, predicted]
+        # ref: Evaluation(int topN) — count a sample correct when the
+        # true class lands in the N highest-probability predictions
+        self.top_n = int(top_n)
+        self._topn_correct = 0
+        self._topn_total = 0
 
     def _ensure(self, n: int):
         if self._conf is None:
@@ -51,6 +58,12 @@ class Evaluation:
         actual = labels.argmax(-1)
         pred = predictions.argmax(-1)
         np.add.at(self._conf, (actual, pred), 1)
+        if self.top_n > 1:
+            k = min(self.top_n, predictions.shape[-1])
+            topk = np.argpartition(predictions, -k, axis=-1)[..., -k:]
+            self._topn_correct += int((topk == actual[..., None]).any(-1)
+                                      .sum())
+            self._topn_total += int(actual.size)
 
     # -- metrics (names mirror the reference methods) -------------------
     def accuracy(self) -> float:
@@ -85,6 +98,28 @@ class Evaluation:
         tn = c.sum() - c[cls, :].sum() - c[:, cls].sum() + c[cls, cls]
         return float(fp) / (fp + tn) if (fp + tn) else 0.0
 
+    def top_n_accuracy(self) -> float:
+        """Ref: Evaluation.topNAccuracy — fraction of samples whose true
+        class is among the top_n predictions (== accuracy for top_n=1)."""
+        if self.top_n <= 1:
+            return self.accuracy()
+        return self._topn_correct / max(self._topn_total, 1)
+
+    def matthews_correlation(self, cls: int) -> float:
+        """Ref: Evaluation.matthewsCorrelation(int) — binary MCC of
+        one-vs-rest for the class."""
+        c = self._conf
+        tp = float(c[cls, cls])
+        fp = float(c[:, cls].sum() - tp)
+        fn = float(c[cls, :].sum() - tp)
+        tn = float(c.sum() - tp - fp - fn)
+        denom = math.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+        return (tp * tn - fp * fn) / denom if denom else 0.0
+
+    def gmeasure(self, cls: Optional[int] = None) -> float:
+        """Ref: Evaluation.gMeasure — sqrt(precision * recall)."""
+        return math.sqrt(self.precision(cls) * self.recall(cls))
+
     def confusion_matrix(self) -> np.ndarray:
         return self._conf.copy()
 
@@ -96,8 +131,12 @@ class Evaluation:
             f" Precision:       {self.precision():.4f}",
             f" Recall:          {self.recall():.4f}",
             f" F1 Score:        {self.f1():.4f}",
-            "=================================================================",
         ]
+        if self.top_n > 1:
+            lines.append(f" Top-{self.top_n} Accuracy:  "
+                         f"{self.top_n_accuracy():.4f}")
+        lines.append(
+            "=================================================================")
         return "\n".join(lines)
 
 

@@ -7,6 +7,7 @@ import json
 import urllib.request
 
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.eval import EvaluationCalibration
 from deeplearning4j_tpu.learning import Adam
@@ -154,3 +155,40 @@ class TestCalibrationDepth:
         ev.eval(y, p)
         assert ev.residual_plot().sum() == 600  # 2 classes x 300
         assert ev.probability_histogram(1).sum() == 300
+
+
+class TestEvaluationExtras:
+    """top-N accuracy / MCC / G-measure (ref: Evaluation.java topNAccuracy,
+    matthewsCorrelation, gMeasure)."""
+
+    def test_top_n_accuracy(self):
+        from deeplearning4j_tpu.eval import Evaluation
+        rs = np.random.RandomState(0)
+        y = np.eye(5, dtype=np.float32)[rs.randint(0, 5, 400)]
+        # predictions: true class gets rank 2 half the time
+        pred = rs.rand(400, 5).astype(np.float32) * 0.1
+        true_cls = y.argmax(-1)
+        flip = rs.rand(400) < 0.5
+        pred[np.arange(400), true_cls] += np.where(flip, 1.0, 0.45)
+        top_idx = pred.argsort(-1)
+        ev1 = Evaluation(top_n=1)
+        ev3 = Evaluation(top_n=3)
+        ev1.eval(y, pred)
+        ev3.eval(y, pred)
+        assert ev3.top_n_accuracy() >= ev1.accuracy()
+        assert ev3.top_n_accuracy() > 0.9      # rank<=2 nearly always
+        assert ev1.top_n_accuracy() == ev1.accuracy()
+        assert "Top-3" in ev3.stats()
+
+    def test_mcc_and_gmeasure(self):
+        from deeplearning4j_tpu.eval import Evaluation
+        y = np.eye(2, dtype=np.float32)[[0, 0, 1, 1]]
+        perfect = y.copy()
+        ev = Evaluation()
+        ev.eval(y, perfect)
+        assert ev.matthews_correlation(0) == pytest.approx(1.0)
+        assert ev.gmeasure() == pytest.approx(1.0)
+        anti = 1.0 - y
+        ev2 = Evaluation()
+        ev2.eval(y, anti)
+        assert ev2.matthews_correlation(0) == pytest.approx(-1.0)
