@@ -28,11 +28,14 @@ from ..nn.layers import (ActivationLayer, BatchNormalization,
                          EmbeddingLayer, GlobalPoolingLayer, Layer,
                          OutputLayer, SubsamplingLayer, Upsampling2D,
                          ZeroPaddingLayer)
-from ..nn.layers.convolutional import (Convolution1D, Cropping2D,
+from ..nn.layers.convolutional import (Convolution1D, Convolution3D,
+                                       Cropping1D, Cropping2D,
                                        Deconvolution2D,
                                        DepthwiseConvolution2D,
                                        SeparableConvolution2D,
-                                       Subsampling1DLayer)
+                                       Subsampling1DLayer,
+                                       Subsampling3DLayer, Upsampling1D,
+                                       Upsampling3D, ZeroPadding1DLayer)
 from ..nn.layers.recurrent import (GRU, LSTM, Bidirectional, LastTimeStep,
                                    SimpleRnn)
 from ..nn.conf.dropout import (AlphaDropout, GaussianDropout, GaussianNoise,
@@ -200,6 +203,34 @@ def _map_layer(class_name: str, cfg: dict) -> Optional[object]:
     if class_name == "Cropping2D":
         c = cfg.get("cropping", 0)
         return Cropping2D(cropping=c, name=name)
+    if class_name == "Conv3D":
+        return Convolution3D(
+            n_out=cfg["filters"], kernel=tuple(cfg["kernel_size"]),
+            stride=tuple(cfg.get("strides", (1, 1, 1))),
+            padding=cfg.get("padding", "valid"),
+            dilation=tuple(cfg.get("dilation_rate", (1, 1, 1))),
+            activation=_act(cfg), has_bias=cfg.get("use_bias", True),
+            name=name)
+    if class_name in ("MaxPooling3D", "AveragePooling3D"):
+        pool = tuple(cfg.get("pool_size", (2, 2, 2)))
+        return Subsampling3DLayer(
+            kernel=pool, stride=tuple(cfg.get("strides") or pool),
+            padding=cfg.get("padding", "valid"),
+            pooling="max" if class_name.startswith("Max") else "avg",
+            name=name)
+    if class_name == "UpSampling1D":
+        return Upsampling1D(size=int(cfg.get("size", 2)), name=name)
+    if class_name == "UpSampling3D":
+        return Upsampling3D(size=tuple(cfg.get("size", (2, 2, 2))),
+                            name=name)
+    if class_name == "ZeroPadding1D":
+        p = cfg.get("padding", 1)
+        p = (p, p) if isinstance(p, int) else tuple(p)
+        return ZeroPadding1DLayer(padding=p, name=name)
+    if class_name == "Cropping1D":
+        c = cfg.get("cropping", 0)
+        c = (c, c) if isinstance(c, int) else tuple(c)
+        return Cropping1D(cropping=c, name=name)
     if class_name == "LeakyReLU":
         alpha = cfg.get("negative_slope", cfg.get("alpha", 0.3))
         return ActivationLayer(
@@ -278,6 +309,7 @@ _PARAM_MAP = {
     "output": {"W": "kernel", "b": "bias"},
     "conv2d": {"W": "kernel", "b": "bias"},
     "conv1d": {"W": "kernel", "b": "bias"},
+    "conv3d": {"W": "kernel", "b": "bias"},
     "batchnorm": {"gamma": "gamma", "beta": "beta"},
     "embedding": {"W": "embeddings"},
     "lstm": {"W": "kernel", "U": "recurrent_kernel", "b": "bias"},
@@ -363,7 +395,10 @@ def _wrapped_kind(layer) -> str:
 
 
 def _input_type(list_builder, batch_shape):
+    from ..nn.conf import InputType
     dims = [d for d in batch_shape[1:]]
+    if len(dims) == 4:
+        return list_builder.input_type(InputType.convolutional3d(*dims))
     if len(dims) == 3:
         return list_builder.input_type_convolutional(*dims)
     if len(dims) == 2:

@@ -56,6 +56,12 @@ class InputType:
     def convolutional_flat(height: int, width: int, channels: int) -> "InputType":
         return InputType("cnnflat", (height, width, channels))
 
+    @staticmethod
+    def convolutional3d(depth: int, height: int, width: int,
+                        channels: int) -> "InputType":
+        """NDHWC volumes (ref: InputType.convolutional3D)."""
+        return InputType("cnn3d", (depth, height, width, channels))
+
     def to_json(self):
         return {"kind": self.kind, "shape": list(self.shape)}
 

@@ -235,7 +235,9 @@ class DenseLayer(Layer):
         # (ref: nn/conf/preprocessor/CnnToFeedForwardPreProcessor.java).
         # Rank-2 [T, C] sequence input stays unflattened: dense applies
         # per-timestep (ref: RnnToFeedForwardPreProcessor semantics).
-        self._flatten_input = len(input_shape) == 3
+        # rank-3 NHWC and rank-4 NDHWC spatial inputs flatten; rank-2
+        # [T, C] sequences stay per-timestep
+        self._flatten_input = len(input_shape) >= 3
         if self.n_in is None:
             self.n_in = int(math.prod(input_shape)) if self._flatten_input \
                 else int(input_shape[-1])
@@ -270,7 +272,7 @@ class DenseLayer(Layer):
         return self.activation(self.pre_output(params, x, train, rng)), state
 
     def output_shape(self, input_shape):
-        if len(input_shape) == 3:  # flattened CNN input
+        if len(input_shape) >= 3:  # flattened CNN/CNN3D input
             return (self.n_out,)
         return tuple(input_shape[:-1]) + (self.n_out,)
 

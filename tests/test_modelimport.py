@@ -135,3 +135,10 @@ class TestKerasExtendedLayers:
         out = np.asarray(net.output(exp["x_bidir"]))
         np.testing.assert_allclose(out, exp["y_bidir"], rtol=1e-4,
                                    atol=1e-5)
+
+    def test_3d_stack_matches_keras(self):
+        net = KerasModelImport.import_keras_sequential_model_and_weights(
+            os.path.join(FIX, "keras_seq_3d.h5"))
+        exp = np.load(os.path.join(FIX, "keras_extra_expected.npz"))
+        out = np.asarray(net.output(exp["x_3d"]))
+        np.testing.assert_allclose(out, exp["y_3d"], rtol=1e-4, atol=1e-5)
