@@ -142,3 +142,18 @@ class TestKerasExtendedLayers:
         exp = np.load(os.path.join(FIX, "keras_extra_expected.npz"))
         out = np.asarray(net.output(exp["x_3d"]))
         np.testing.assert_allclose(out, exp["y_3d"], rtol=1e-4, atol=1e-5)
+
+    def test_1d_shape_mappers_config_only(self):
+        """ZeroPadding1D / Cropping1D / UpSampling1D map to the right
+        layer types and shapes (config-level; no weights to translate)."""
+        from deeplearning4j_tpu.modelimport.keras import _map_layer
+        from deeplearning4j_tpu.nn.layers.convolutional import (
+            Cropping1D, Upsampling1D, ZeroPadding1DLayer)
+        zp = _map_layer("ZeroPadding1D", {"name": "zp", "padding": [2, 1]})
+        assert isinstance(zp, ZeroPadding1DLayer)
+        assert tuple(zp.padding) == (2, 1)
+        cr = _map_layer("Cropping1D", {"name": "cr", "cropping": 1})
+        assert isinstance(cr, Cropping1D)
+        assert tuple(cr.cropping) == (1, 1)
+        up = _map_layer("UpSampling1D", {"name": "up", "size": 3})
+        assert isinstance(up, Upsampling1D) and up.size == 3
