@@ -410,7 +410,10 @@ import numpy as np
 import jax, jax.numpy as jnp
 v = float(np.asarray((jnp.ones((128, 128)) @ jnp.ones((128, 128))).sum()))
 d = jax.devices()[0]
-print(json.dumps({"ok": v == 128.0 * 128.0, "platform": d.platform}))
+# ones@ones: each element is 128 (a 128-long dot of ones), so the full
+# sum is 128**3 — NOT 128*128 (that bug made every healthy probe read
+# as dead and silently demoted the whole bench to the CPU fallback)
+print(json.dumps({"ok": v == 128.0 ** 3, "platform": d.platform}))
 """
 
 _CPU_ENV = {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"}

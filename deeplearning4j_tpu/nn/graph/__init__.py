@@ -287,17 +287,73 @@ class PreprocessorVertex(GraphVertex):
         return {"op": self.op}
 
 
+class SameDiffLambdaVertex(GraphVertex):
+    """Multi-input vertex whose forward is a SameDiff graph — subclass
+    and override define_vertex(sd, *inputs) -> SDVariable, or pass fn=.
+    Ref: `nn/conf/layers/samediff/SameDiffLambdaVertex.java` (the
+    parameterless SameDiffVertex form). The graph is traced once and
+    inlined into the ComputationGraph's jitted step."""
+
+    kind = "samediff_lambda_vertex"
+
+    def __init__(self, fn=None):
+        self._fn = fn
+        self._cache = {}
+
+    def define_vertex(self, sd, *inputs):
+        if self._fn is not None:
+            return self._fn(sd, *inputs)
+        raise NotImplementedError("pass fn= or override define_vertex")
+
+    def apply(self, inputs):
+        from ...autodiff.samediff import SameDiff
+        key = tuple((tuple(x.shape[1:]), str(x.dtype)) for x in inputs)
+        if key not in self._cache:
+            sd = SameDiff.create()
+            phs = [sd.placeholder(f"in_{i}", (None,) + tuple(x.shape[1:]),
+                                  dtype=x.dtype)
+                   for i, x in enumerate(inputs)]
+            out = self.define_vertex(sd, *phs)
+            self._cache[key] = (sd, out.name)
+        sd, out_name = self._cache[key]
+        feed = {f"in_{i}": x for i, x in enumerate(inputs)}
+        return sd.output(feed, [out_name])[out_name]
+
+    def output_shape(self, input_shapes):
+        import jax
+        import jax.numpy as jnp
+        out = jax.eval_shape(
+            lambda *xs: self.apply(xs),
+            *[jax.ShapeDtypeStruct((2,) + tuple(s), jnp.float32)
+              for s in input_shapes])
+        return tuple(out.shape[1:])
+
+    def _extra_json(self):
+        if type(self) is not SameDiffLambdaVertex:
+            from ..layers.samediff_layer import _class_path
+            return {"cls": _class_path(self)}
+        return {"cls": None}
+
+
 VERTEX_REGISTRY: Dict[str, type] = {
     c.kind: c for c in (MergeVertex, ElementWiseVertex, SubsetVertex,
                         StackVertex, UnstackVertex, ScaleVertex, ShiftVertex,
                         L2NormalizeVertex, L2Vertex, ReshapeVertex,
-                        PreprocessorVertex)
+                        PreprocessorVertex, SameDiffLambdaVertex)
 }
 
 
 def vertex_from_json(d: dict) -> GraphVertex:
     d = dict(d)
     kind = d.pop("@vertex")
+    cls_path = d.pop("cls", None)
+    if cls_path:
+        # custom SameDiff vertex subclass: reconstruct by import path
+        from ..layers.samediff_layer import _load_class
+        return _load_class(cls_path)(**d)
+    if kind == "samediff_lambda_vertex":
+        raise ValueError("anonymous SameDiff lambda vertices (fn=...) are "
+                         "not serializable — subclass SameDiffLambdaVertex")
     return VERTEX_REGISTRY[kind](**d)
 
 
@@ -550,6 +606,12 @@ class ComputationGraph:
                     else:
                         act, s2, _ = layer.apply_seq(p, ins[0], s, train,
                                                      r, carry, m)
+                elif getattr(layer, "wants_mask", False):
+                    # MaskLayer (ref: nn/conf/layers/util/MaskLayer.java):
+                    # consumes the [B,T] feature mask on sequence inputs
+                    m = fmask if ins[0].ndim == 3 else None
+                    act, s2 = layer.apply_with_mask(p, ins[0], s, train,
+                                                    r, m)
                 elif remat and layer.has_params:
                     # conf.remat: recompute activations in backward
                     act, s2 = jax.checkpoint(
@@ -588,7 +650,7 @@ class ComputationGraph:
         inputs_c = {k: cast_input_for_compute(v, cdt)
                     for k, v in inputs.items()} if cdt is not None else inputs
         acts, new_state = self._forward(params_c, net_state, inputs_c, train,
-                                        r_fwd, fmask=None)
+                                        r_fwd, fmask=self._fmask_from(masks))
         total = 0.0
         for out_name in self.conf.graph_outputs:
             node = self.conf.nodes[out_name]
@@ -771,22 +833,43 @@ class ComputationGraph:
                     for n, v in zip(self.conf.graph_outputs, m)}
         return {self.conf.graph_outputs[0]: jnp.asarray(m)}
 
-    def output(self, *data, train: bool = False):
+    def _fmask_from(self, masks):
+        """Feature mask for the forward pass (RNN padding + MaskLayer).
+        A mask keyed by an INPUT name is explicitly a feature mask (ref:
+        ComputationGraph.setLayerMaskArrays featureMaskArrays); on a
+        single-input graph the sole [B, T] mask doubles as feature+label
+        mask, matching MultiLayerNetwork's convention."""
+        if not masks:
+            return None
+        for name in self.conf.graph_inputs:
+            if name in masks:
+                return masks[name]
+        if len(self.conf.graph_inputs) == 1 and len(masks) == 1:
+            m = next(iter(masks.values()))
+            if m.ndim == 2:
+                return m
+        return None
+
+    def output(self, *data, train: bool = False, mask=None):
         """Returns the list of output activations (ref:
-        ComputationGraph.output)."""
+        ComputationGraph.output; `mask` is the [B, T] input feature mask
+        — ref: the featureMaskArrays overload)."""
         if self._params is None:
             self.init()
         if len(data) == 1 and isinstance(data[0], (dict, list, tuple)):
             inputs = self._as_inputs(data[0])
         else:
             inputs = self._as_inputs(list(data))
-        key = ("out", train)
+        key = ("out", train, mask is not None)
         if key not in self._jit_forward:
-            def fwd(params, net_state, inputs):
-                acts, _ = self._forward(params, net_state, inputs, train, None)
+            def fwd(params, net_state, inputs, fmask):
+                acts, _ = self._forward(params, net_state, inputs, train,
+                                        None, fmask=fmask)
                 return [acts[n] for n in self.conf.graph_outputs]
             self._jit_forward[key] = jax.jit(fwd)
-        outs = self._jit_forward[key](self._params, self._net_state, inputs)
+        outs = self._jit_forward[key](
+            self._params, self._net_state, inputs,
+            None if mask is None else jnp.asarray(mask))
         return outs[0] if len(outs) == 1 else outs
 
     def feed_forward(self, data, train: bool = False):

@@ -723,6 +723,12 @@ def register(cls: type) -> type:
 def from_json(d: dict) -> Layer:
     d = dict(d)
     kind = d.pop("@class")
+    if kind.startswith("samediff"):
+        # custom SameDiff layers reconstruct by import path (reference:
+        # reflective JSON subtyping of SameDiffLayer subclasses) — no
+        # registry lookup, so subclasses may use their own kind strings
+        from .samediff_layer import samediff_layer_from_json
+        return samediff_layer_from_json(d)
     cls = REGISTRY[kind]
     if "activation" in d and isinstance(d["activation"], dict):
         d["activation"] = A.get(d["activation"])
@@ -761,3 +767,8 @@ from .attention import (SelfAttentionLayer,  # noqa: E402,F401
 from .variational import VariationalAutoencoder  # noqa: E402,F401
 from .specialized_outputs import (CenterLossOutputLayer,  # noqa: E402,F401
                                   OCNNOutputLayer)
+from .misc import (AutoEncoder, Cnn3DLossLayer,  # noqa: E402,F401
+                   CnnLossLayer, FrozenLayerWithBackprop, MaskLayer)
+from .samediff_layer import (SameDiffLambdaLayer,  # noqa: E402,F401
+                             SameDiffLayer, SameDiffOutputLayer,
+                             SDLayerParams)

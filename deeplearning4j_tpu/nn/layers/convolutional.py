@@ -995,6 +995,13 @@ class FrozenLayer(Layer):
         params = jax.tree_util.tree_map(lax.stop_gradient, params)
         return self.layer.apply_seq(params, x, state, False, rng, carry, mask)
 
+    def compute_loss(self, params, x, labels, mask=None, train: bool = False,
+                     rng=None):
+        # a frozen OUTPUT layer still scores, its params just don't move
+        params = jax.tree_util.tree_map(lax.stop_gradient, params)
+        return self.layer.compute_loss(params, x, labels, mask, train=False,
+                                       rng=rng)
+
     def output_shape(self, input_shape):
         return self.layer.output_shape(input_shape)
 

@@ -1,0 +1,244 @@
+"""Misc layer-conf parity: denoising AutoEncoder (pretrainable),
+MaskLayer, CNN loss layers, FrozenLayerWithBackprop.
+
+Ref: `nn/conf/layers/AutoEncoder.java` (corruptionLevel/sparsity over
+BasePretrainNetwork), `nn/conf/layers/util/MaskLayer.java`,
+`nn/conf/layers/CnnLossLayer.java` / `Cnn3DLossLayer.java`,
+`nn/conf/layers/misc/FrozenLayerWithBackprop.java`.
+
+TPU notes: the autoencoder's encode/decode are two GEMMs sharing one
+weight matrix (decode multiplies by W^T — the tied-weights form the
+reference's runtime uses: `nn/layers/feedforward/autoencoder/
+AutoEncoder.java:59-74`), so both land on the MXU and XLA fuses the
+corruption mask + sigmoid epilogues into them.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ... import losses as L
+from . import DenseLayer, Layer, LossLayer, register
+
+
+@register
+class AutoEncoder(DenseLayer):
+    """Denoising autoencoder with tied weights. Supervised forward is the
+    encoder (a dense layer); unsupervised layerwise pretraining minimizes
+    the reconstruction loss of decode(encode(corrupt(x))).
+
+    Ref: conf `nn/conf/layers/AutoEncoder.java` (corruptionLevel,
+    sparsity); runtime `nn/layers/feedforward/autoencoder/AutoEncoder.java`
+    — getCorruptedInput uses a Bernoulli(1-p) mask, decode is y·W^T + vb.
+    The sparsity term is a KL(ρ ‖ mean activation) penalty on the hidden
+    code (the classic sparse-AE regularizer the reference's sparsity
+    field configures via the loss)."""
+
+    kind = "autoencoder"
+    is_pretrain_layer = True
+
+    def __init__(self, n_out: int = None, corruption_level: float = 0.3,
+                 sparsity: float = 0.0, sparsity_target: float = 0.05,
+                 loss: str = "mse", **kw):
+        kw.setdefault("activation", "sigmoid")
+        super().__init__(n_out=n_out, **kw)
+        self.corruption_level = float(corruption_level)
+        self.sparsity = float(sparsity)
+        self.sparsity_target = float(sparsity_target)
+        self.recon_loss = L.get(loss)
+
+    def param_shapes(self):
+        sh = super().param_shapes()  # W [n_in, n_out], b [n_out]
+        sh["vb"] = (self.n_in,)      # visible bias (decoder)
+        return sh
+
+    def init_params(self, rng, dtype=jnp.float32):
+        p = super().init_params(rng, dtype)
+        p["vb"] = jnp.zeros((self.n_in,), dtype)
+        return p
+
+    def bias_param_names(self):
+        # the decoder's visible bias is a bias param: unregularized by
+        # default and exempt from weight noise, like the reference's
+        # PretrainParamInitializer visible-bias handling
+        return super().bias_param_names() | {"vb"}
+
+    def encode(self, params, x):
+        z = x @ params["W"]
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation(z)
+
+    def decode(self, params, y):
+        return self.activation(y @ params["W"].T + params["vb"])
+
+    # supervised forward = encode (ref: AutoEncoder.activate -> encode)
+    def apply(self, params, x, state, train, rng):
+        if getattr(self, "_flatten_input", False) and x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        x = self._maybe_dropout(x, train, rng)
+        return self.encode(params, x), state
+
+    # -- unsupervised pretraining (MultiLayerNetwork.pretrain protocol) --
+    def pretrain_loss(self, params, x, rng):
+        if x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        xc = x
+        if self.corruption_level > 0.0 and rng is not None:
+            keep = jax.random.bernoulli(
+                rng, 1.0 - self.corruption_level, x.shape)
+            xc = x * keep.astype(x.dtype)
+        y = self.encode(params, xc)
+        z = self.decode(params, y)
+        # reconstruction scored against the CLEAN input (denoising AE)
+        loss = self.recon_loss.score(x, z, lambda a: a, None)
+        if self.sparsity > 0.0:
+            rho, eps = self.sparsity_target, 1e-7
+            rho_hat = jnp.clip(jnp.mean(y, axis=0), eps, 1.0 - eps)
+            kl = rho * jnp.log(rho / rho_hat) + \
+                (1.0 - rho) * jnp.log((1.0 - rho) / (1.0 - rho_hat))
+            loss = loss + self.sparsity * jnp.sum(kl)
+        return loss
+
+    def _extra_json(self):
+        d = super()._extra_json()
+        d.update(corruption_level=self.corruption_level,
+                 sparsity=self.sparsity,
+                 sparsity_target=self.sparsity_target,
+                 loss=self.recon_loss.to_json())
+        return d
+
+
+@register
+class MaskLayer(Layer):
+    """Zeroes activations at masked-out steps — used to stop garbage from
+    padded timesteps flowing through feed-forward layers between RNNs.
+    Ref: `nn/conf/layers/util/MaskLayer.java` (applies the feature mask
+    to activations, identity when no mask is set)."""
+
+    kind = "masklayer"
+    wants_mask = True
+
+    def __init__(self, **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+
+    def apply(self, params, x, state, train, rng):
+        return x, state  # no mask in scope -> identity
+
+    def apply_with_mask(self, params, x, state, train, rng,
+                        mask: Optional[jnp.ndarray]):
+        if mask is None:
+            return x, state
+        m = mask
+        while m.ndim < x.ndim:
+            m = m[..., None]
+        return x * m.astype(x.dtype), state
+
+
+@register
+class CnnLossLayer(LossLayer):
+    """Per-pixel loss on [B, H, W, C] input (segmentation heads etc.) —
+    no params; labels share the input shape; an optional [B, H, W] (or
+    broadcastable) mask weights positions. Ref:
+    `nn/conf/layers/CnnLossLayer.java` (format-aware per-position
+    scoring). NHWC here: positions flatten into the batch axis so the
+    loss sees an ordinary [B*H*W, C] minibatch."""
+
+    kind = "cnnloss"
+
+    def compute_loss(self, params, x, labels, mask=None, train: bool = False,
+                     rng=None):
+        c = x.shape[-1]
+        m2 = None
+        if mask is not None:
+            m = mask
+            # accept [B,H,W], [B,H,W,1], or anything broadcastable over
+            # positions (e.g. a per-example [B,1,1] mask): collapse a
+            # trailing singleton channel, broadcast to the full position
+            # grid, then flatten
+            if m.ndim == x.ndim and m.shape[-1] == 1:
+                m = m[..., 0]
+            while m.ndim < x.ndim - 1:
+                m = m[..., None]
+            m2 = jnp.broadcast_to(m, x.shape[:-1]).reshape(-1)
+        return self.loss.score(labels.reshape(-1, c), x.reshape(-1, c),
+                               self.activation, m2)
+
+
+@register
+class Cnn3DLossLayer(CnnLossLayer):
+    """[B, D, H, W, C] per-voxel loss. Ref:
+    `nn/conf/layers/Cnn3DLossLayer.java`."""
+
+    kind = "cnn3dloss"
+
+
+@register
+class FrozenLayerWithBackprop(Layer):
+    """Freezes the wrapped layer's params but keeps the wrapped layer's
+    TRAINING-mode forward (dropout etc. still active) — unlike
+    FrozenLayer, which also pins the wrapped layer to inference mode.
+    Gradients still flow through to earlier layers in both; the
+    distinction mirrors the reference pair
+    (`nn/conf/layers/misc/FrozenLayer.java` wraps in a layer that uses
+    test-time behaviour; `FrozenLayerWithBackprop.java` only blocks the
+    parameter update)."""
+
+    kind = "frozen_backprop"
+
+    def __init__(self, layer=None, **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        if isinstance(layer, dict):
+            from . import from_json
+            layer = from_json(layer)
+        self.layer = layer
+
+    @property
+    def is_rnn(self):
+        return getattr(self.layer, "is_rnn", False)
+
+    def build(self, input_shape, defaults=None):
+        super().build(input_shape, defaults)
+        self.layer.build(input_shape, defaults)
+        # no weight decay on frozen params (same reasoning as FrozenLayer:
+        # l2*W gradients would bypass the stop_gradient)
+        self.l1 = self.l2 = self.l1_bias = self.l2_bias = 0.0
+
+    def param_shapes(self):
+        return self.layer.param_shapes()
+
+    def init_params(self, rng, dtype=jnp.float32):
+        return self.layer.init_params(rng, dtype)
+
+    def init_state(self):
+        return self.layer.init_state()
+
+    def init_carry(self, batch, dtype=jnp.float32):
+        return self.layer.init_carry(batch, dtype)
+
+    def apply(self, params, x, state, train, rng):
+        params = jax.tree_util.tree_map(lax.stop_gradient, params)
+        return self.layer.apply(params, x, state, train, rng)
+
+    def apply_seq(self, params, x, state, train, rng, carry, mask):
+        params = jax.tree_util.tree_map(lax.stop_gradient, params)
+        return self.layer.apply_seq(params, x, state, train, rng, carry, mask)
+
+    def compute_loss(self, params, x, labels, mask=None, train: bool = False,
+                     rng=None):
+        # frozen OUTPUT layer (transfer learning's canonical head-freeze):
+        # score flows, its params don't move
+        params = jax.tree_util.tree_map(lax.stop_gradient, params)
+        return self.layer.compute_loss(params, x, labels, mask, train=train,
+                                       rng=rng)
+
+    def output_shape(self, input_shape):
+        return self.layer.output_shape(input_shape)
+
+    def _extra_json(self):
+        return {"layer": self.layer.to_json()}

@@ -179,6 +179,14 @@ class MultiLayerNetwork:
                     act, s2, c2 = layer.apply_seq(p, act, s, train, r,
                                                   new_carries[i], m)
                 new_carries[i] = c2
+            elif getattr(layer, "wants_mask", False):
+                # MaskLayer: consumes the current feature mask directly
+                # (ref: nn/conf/layers/util/MaskLayer.java). Only [B,T,C]
+                # sequence activations take the [B,T] mask — 4D CNN
+                # activations don't have a time axis (same rule as the
+                # RNN branch above)
+                m = fmask if act.ndim == 3 else None
+                act, s2 = layer.apply_with_mask(p, act, s, train, r, m)
             elif remat and layer.has_params:
                 # jax.checkpoint: recompute this layer's activations in
                 # the backward pass instead of storing them (conf.remat)
@@ -478,18 +486,23 @@ class MultiLayerNetwork:
         return (item.features, item.labels,
                 getattr(item, "labels_mask", None))
 
-    def output(self, x, train: bool = False):
-        """Inference forward pass (ref: MultiLayerNetwork.output)."""
+    def output(self, x, train: bool = False, mask=None):
+        """Inference forward pass (ref: MultiLayerNetwork.output; `mask`
+        is the [B, T] feature mask — ref: the featuresMask overload /
+        setLayerMaskArrays)."""
         if self._params is None:
             self.init()
         x = self._reshape_input(jnp.asarray(x))
-        key = ("out", train)
+        key = ("out", train, mask is not None)
         if key not in self._jit_forward:
-            def fwd(params, net_state, x):
-                act, _, _ = self._forward(params, net_state, x, train, None)
+            def fwd(params, net_state, x, fmask):
+                act, _, _ = self._forward(params, net_state, x, train, None,
+                                          fmask=fmask)
                 return act
             self._jit_forward[key] = jax.jit(fwd)
-        return self._jit_forward[key](self._params, self._net_state, x)
+        return self._jit_forward[key](
+            self._params, self._net_state, x,
+            None if mask is None else jnp.asarray(mask))
 
     def feed_forward(self, x, train: bool = False):
         """All layer activations (ref: feedForward returns the list)."""

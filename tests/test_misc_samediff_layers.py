@@ -1,0 +1,471 @@
+"""AutoEncoder / MaskLayer / CNN loss layers / FrozenLayerWithBackprop +
+the SameDiff custom-layer family (ref: `nn/conf/layers/AutoEncoder.java`,
+`util/MaskLayer.java`, `CnnLossLayer.java`, `misc/
+FrozenLayerWithBackprop.java`, `samediff/*.java`)."""
+import json
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu.learning import Adam, Sgd
+from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                   MultiLayerConfiguration,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers import (AutoEncoder, Cnn3DLossLayer,
+                                          CnnLossLayer, ConvolutionLayer,
+                                          DenseLayer,
+                                          FrozenLayerWithBackprop,
+                                          MaskLayer, OutputLayer,
+                                          SDLayerParams,
+                                          SameDiffLambdaLayer,
+                                          SameDiffLayer,
+                                          SameDiffOutputLayer,
+                                          from_json)
+from deeplearning4j_tpu.nn.layers.recurrent import LSTM, RnnOutputLayer
+
+RNG = jax.random.PRNGKey(0)
+
+
+def _mlp(*layers, input_size=8, updater=None, seed=123):
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .updater(updater or Adam(1e-2)).list())
+    for l in layers:
+        b = b.layer(l)
+    return MultiLayerNetwork(
+        b.input_type_feed_forward(input_size).build()).init()
+
+
+# ---------------------------------------------------------------------------
+# AutoEncoder
+# ---------------------------------------------------------------------------
+class TestAutoEncoder:
+    def test_forward_is_encoder(self):
+        net = _mlp(AutoEncoder(n_out=4),
+                   OutputLayer(n_out=3, loss="mcxent"))
+        x = np.random.RandomState(0).rand(5, 8).astype(np.float32)
+        out = net.output(x)
+        assert out.shape == (5, 3)
+        # encoder params: W, b plus the decoder's visible bias vb
+        ae = net.layers[0]
+        assert set(ae.param_shapes()) == {"W", "b", "vb"}
+        assert ae.param_shapes()["vb"] == (8,)
+
+    def test_pretrain_reduces_reconstruction_loss(self):
+        rs = np.random.RandomState(1)
+        # structured data (rank-2 factors) an AE can actually compress
+        basis = rs.rand(2, 8).astype(np.float32)
+        x = (rs.rand(256, 2).astype(np.float32) @ basis)
+        net = _mlp(AutoEncoder(n_out=4, corruption_level=0.1,
+                               activation="sigmoid"),
+                   OutputLayer(n_out=2, loss="mcxent"))
+        ae = net.layers[0]
+        key = net._layer_keys[0]
+        r = jax.random.PRNGKey(7)
+        before = float(ae.pretrain_loss(net._params[key], jnp.asarray(x), r))
+        net.pretrain([(x, np.zeros((256, 2), np.float32))], epochs=30)
+        after = float(ae.pretrain_loss(net._params[key], jnp.asarray(x), r))
+        assert after < before * 0.7, (before, after)
+
+    def test_sparsity_penalty_increases_loss(self):
+        x = jnp.asarray(np.random.RandomState(2).rand(32, 8),
+                        jnp.float32)
+        plain = AutoEncoder(n_out=4, corruption_level=0.0)
+        sparse = AutoEncoder(n_out=4, corruption_level=0.0, sparsity=1.0,
+                             sparsity_target=0.01)
+        for l in (plain, sparse):
+            l.build((8,), {})
+        p = plain.init_params(RNG)
+        assert float(sparse.pretrain_loss(p, x, None)) > \
+            float(plain.pretrain_loss(p, x, None))
+
+    def test_json_round_trip(self):
+        l = AutoEncoder(n_out=4, corruption_level=0.25, sparsity=0.5,
+                        loss="mse")
+        l2 = from_json(json.loads(json.dumps(l.to_json())))
+        assert isinstance(l2, AutoEncoder)
+        assert l2.corruption_level == 0.25
+        assert l2.sparsity == 0.5
+
+
+# ---------------------------------------------------------------------------
+# MaskLayer
+# ---------------------------------------------------------------------------
+class TestMaskLayer:
+    def test_zeroes_masked_timesteps(self):
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.1))
+                .list()
+                .layer(LSTM(n_out=6))
+                .layer(MaskLayer())
+                .layer(RnnOutputLayer(n_out=3, loss="mcxent"))
+                .input_type_recurrent(4).build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.RandomState(0).rand(2, 5, 4).astype(np.float32)
+        mask = np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], np.float32)
+        # forward through the masked stack: check the MaskLayer's own
+        # output (run layers 0..2 manually with the mask in scope)
+        act, _, _ = net._forward(net._params, net._net_state,
+                                 jnp.asarray(x), False, None, upto=2,
+                                 fmask=jnp.asarray(mask))
+        act = np.asarray(act)
+        assert np.all(act[0, 3:] == 0.0)       # masked steps zeroed
+        assert np.any(act[0, :3] != 0.0)
+        assert np.any(act[1] != 0.0)
+
+    def test_mask_layer_in_computation_graph(self):
+        from deeplearning4j_tpu.nn.conf import InputType
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.1))
+                .graph_builder()
+                .add_inputs("in")
+                .set_input_types(InputType.recurrent(4))
+                .add_layer("rnn", LSTM(n_out=6), "in")
+                .add_layer("mask", MaskLayer(), "rnn")
+                .add_layer("out", RnnOutputLayer(n_out=3, loss="mcxent"),
+                           "mask")
+                .set_outputs("out")
+                .build())
+        g = ComputationGraph(conf).init()
+        x = np.random.RandomState(0).rand(2, 5, 4).astype(np.float32)
+        mask = np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], np.float32)
+        acts, _ = g._forward(g._params, g._net_state,
+                             g._as_inputs([x]), False, None,
+                             fmask=jnp.asarray(mask))
+        act = np.asarray(acts["mask"])
+        assert np.all(act[0, 3:] == 0.0)
+        assert np.any(act[0, :3] != 0.0)
+
+    def test_graph_mask_reachable_from_public_api(self):
+        # the [B,T] mask passed to fit()/output() must reach MaskLayer
+        # through the public entry points, not just _forward
+        from deeplearning4j_tpu.nn.conf import InputType
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.05))
+                .graph_builder()
+                .add_inputs("in")
+                .set_input_types(InputType.recurrent(4))
+                .add_layer("rnn", LSTM(n_out=6), "in")
+                .add_layer("mask", MaskLayer(), "rnn")
+                .add_layer("out", RnnOutputLayer(n_out=3, loss="mcxent"),
+                           "mask")
+                .set_outputs("out")
+                .build())
+        g = ComputationGraph(conf).init()
+        rs = np.random.RandomState(0)
+        x = rs.rand(4, 5, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[
+            rs.randint(0, 3, (4, 5))].astype(np.float32)
+        mask = np.ones((4, 5), np.float32)
+        mask[:2, 3:] = 0.0
+        # output() with mask: masked timesteps of the MaskLayer feed zeros
+        out_m = np.asarray(g.output([x], mask=mask))
+        out_nm = np.asarray(g.output([x]))
+        assert not np.allclose(out_m[:2, 3:], out_nm[:2, 3:])
+        # fit() with a mask trains without error and the loss moves
+        s0 = g.score([x], [y])
+        g.fit([([x], [y], [mask])], epochs=10)
+        assert g.score([x], [y]) != s0
+
+    def test_identity_without_mask(self):
+        l = MaskLayer()
+        l.build((5, 4), {})
+        x = jnp.asarray(np.random.rand(2, 5, 4), jnp.float32)
+        y, _ = l.apply_with_mask({}, x, {}, False, None, None)
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+    def test_mln_output_mask_kwarg(self):
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.1))
+                .list()
+                .layer(LSTM(n_out=6))
+                .layer(MaskLayer())
+                .layer(RnnOutputLayer(n_out=3, loss="mcxent"))
+                .input_type_recurrent(4).build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.RandomState(0).rand(2, 5, 4).astype(np.float32)
+        mask = np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], np.float32)
+        out_m = np.asarray(net.output(x, mask=mask))
+        out_nm = np.asarray(net.output(x))
+        assert not np.allclose(out_m[0, 3:], out_nm[0, 3:])
+        np.testing.assert_allclose(out_m[1], out_nm[1], atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# CNN loss layers
+# ---------------------------------------------------------------------------
+class TestCnnLossLayers:
+    def _seg_net(self):
+        conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-2))
+                .list()
+                .layer(ConvolutionLayer(n_out=8, kernel=(3, 3),
+                                        padding="same", activation="relu"))
+                .layer(ConvolutionLayer(n_out=2, kernel=(1, 1),
+                                        padding="same",
+                                        activation="identity"))
+                .layer(CnnLossLayer(loss="mcxent", activation="softmax"))
+                .input_type_convolutional(8, 8, 1).build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_per_pixel_training_converges(self):
+        net = self._seg_net()
+        rs = np.random.RandomState(0)
+        x = rs.rand(16, 8, 8, 1).astype(np.float32)
+        # learnable rule: class 1 where the pixel is bright
+        cls = (x[..., 0] > 0.5).astype(np.int32)
+        y = np.eye(2, dtype=np.float32)[cls]          # [B, H, W, 2]
+        first = net.score(x, y)
+        net.fit(x, y, epochs=60)
+        assert net.score(x, y) < first * 0.5
+        out = net.output(x)
+        assert out.shape == (16, 8, 8, 2)
+        acc = np.mean(np.argmax(np.asarray(out), -1) == cls)
+        assert acc > 0.9
+
+    def test_mask_weights_positions(self):
+        l = CnnLossLayer(loss="mse", activation="identity")
+        l.build((4, 4, 1), {})
+        x = jnp.ones((2, 4, 4, 1))
+        y = jnp.zeros((2, 4, 4, 1))
+        full = float(l.compute_loss({}, x, y))
+        m = np.zeros((2, 4, 4), np.float32)
+        m[:, :2] = 1.0                                  # half the pixels
+        half = float(l.compute_loss({}, x, y, jnp.asarray(m)))
+        assert abs(full - half) < 1e-6 or half > 0      # mask-normalized
+        # all-masked-out rows contribute nothing: zero mask on y!=x
+        m0 = jnp.zeros((2, 4, 4))
+        z = float(l.compute_loss({}, x, y, m0))
+        assert z == 0.0
+
+    def test_broadcastable_per_example_mask(self):
+        l = CnnLossLayer(loss="mse", activation="identity")
+        l.build((4, 4, 1), {})
+        x = jnp.ones((2, 4, 4, 1))
+        y = jnp.zeros((2, 4, 4, 1))
+        # per-example [B, 1, 1] mask: first example weighted out entirely
+        m = jnp.asarray([[[0.0]], [[1.0]]])
+        v = float(l.compute_loss({}, x, y, m))
+        assert v > 0.0                         # second example contributes
+        v0 = float(l.compute_loss({}, x, y, jnp.asarray([[[0.0]], [[0.0]]])))
+        assert v0 == 0.0
+
+    def test_3d_loss_shape(self):
+        l = Cnn3DLossLayer(loss="mse", activation="identity")
+        l.build((3, 4, 4, 2), {})
+        x = jnp.asarray(np.random.rand(2, 3, 4, 4, 2), jnp.float32)
+        v = float(l.compute_loss({}, x, x))
+        assert v == pytest.approx(0.0, abs=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# FrozenLayerWithBackprop
+# ---------------------------------------------------------------------------
+class TestFrozenLayerWithBackprop:
+    def test_frozen_params_fixed_earlier_layers_train(self):
+        net = _mlp(DenseLayer(n_out=6, activation="tanh"),
+                   FrozenLayerWithBackprop(
+                       DenseLayer(n_out=6, activation="tanh")),
+                   OutputLayer(n_out=2, loss="mcxent"))
+        rs = np.random.RandomState(0)
+        x = rs.rand(32, 8).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 32)]
+        frozen_before = np.asarray(net._params[net._layer_keys[1]]["W"])
+        first_before = np.asarray(net._params[net._layer_keys[0]]["W"])
+        net.fit(x, y, epochs=5)
+        frozen_after = np.asarray(net._params[net._layer_keys[1]]["W"])
+        first_after = np.asarray(net._params[net._layer_keys[0]]["W"])
+        np.testing.assert_array_equal(frozen_before, frozen_after)
+        assert np.abs(first_after - first_before).max() > 1e-6
+
+    def test_frozen_output_head_scores_but_does_not_move(self):
+        net = _mlp(DenseLayer(n_out=6, activation="tanh"),
+                   FrozenLayerWithBackprop(
+                       OutputLayer(n_out=2, loss="mcxent")))
+        rs = np.random.RandomState(4)
+        x = rs.rand(32, 8).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 32)]
+        head_before = np.asarray(net._params[net._layer_keys[1]]["W"])
+        body_before = np.asarray(net._params[net._layer_keys[0]]["W"])
+        s0 = net.score(x, y)
+        net.fit(x, y, epochs=5)
+        assert np.isfinite(s0)
+        np.testing.assert_array_equal(
+            head_before, np.asarray(net._params[net._layer_keys[1]]["W"]))
+        assert np.abs(np.asarray(net._params[net._layer_keys[0]]["W"])
+                      - body_before).max() > 1e-6
+
+    def test_train_mode_dropout_still_active(self):
+        # FrozenLayerWithBackprop keeps train-mode stochastics (unlike
+        # FrozenLayer, which pins inference mode — ref distinction)
+        inner = DenseLayer(n_out=64, dropout=0.5, activation="identity")
+        l = FrozenLayerWithBackprop(inner)
+        l.build((16,), {"weight_init": "xavier"})
+        p = l.init_params(RNG)
+        x = jnp.ones((4, 16))
+        train_out, _ = l.apply(p, x, {}, True, jax.random.PRNGKey(5))
+        infer_out, _ = l.apply(p, x, {}, False, None)
+        assert not np.allclose(np.asarray(train_out), np.asarray(infer_out))
+
+    def test_json_round_trip(self):
+        l = FrozenLayerWithBackprop(DenseLayer(n_out=3))
+        l2 = from_json(json.loads(json.dumps(l.to_json())))
+        assert isinstance(l2, FrozenLayerWithBackprop)
+        assert isinstance(l2.layer, DenseLayer)
+        assert l2.layer.n_out == 3
+
+
+# ---------------------------------------------------------------------------
+# SameDiff custom layers
+# ---------------------------------------------------------------------------
+class SDDense(SameDiffLayer):
+    """Custom dense layer defined via the SameDiff graph API (the
+    reference's MinimalSameDiffDense test-layer shape)."""
+
+    def __init__(self, n_out=4, **kw):
+        super().__init__(**kw)
+        self.n_out = int(n_out)
+
+    def define_parameters(self, params: SDLayerParams):
+        params.add_weight_param("W", self.n_in, self.n_out)
+        params.add_bias_param("b", self.n_out)
+
+    def define_layer(self, sd, x, p):
+        return (x @ p["W"] + p["b"]).tanh()
+
+    def _extra_json(self):
+        d = super()._extra_json()
+        d["n_out"] = self.n_out
+        return d
+
+
+class SDMseOutput(SameDiffOutputLayer):
+    def __init__(self, n_out=2, **kw):
+        kw.setdefault("n_labels", n_out)
+        super().__init__(**kw)
+        self.n_out = int(n_out)
+
+    def define_parameters(self, params: SDLayerParams):
+        params.add_weight_param("W", self.n_in, self.n_out)
+
+    def define_layer(self, sd, x, labels, p):
+        pred = x @ p["W"]
+        diff = pred - labels
+        score = (diff * diff).reduce_mean()
+        return pred, score
+
+    def _extra_json(self):
+        d = super()._extra_json()
+        d["n_out"] = self.n_out
+        return d
+
+
+class TestSameDiffLayers:
+    def test_matches_plain_dense(self):
+        l = SDDense(n_out=4)
+        l.build((8,), {"weight_init": "xavier"})
+        p = l.init_params(jax.random.PRNGKey(3))
+        x = jnp.asarray(np.random.RandomState(0).rand(5, 8), jnp.float32)
+        got, _ = l.apply(p, x, {}, False, None)
+        want = np.tanh(np.asarray(x) @ np.asarray(p["W"]) +
+                       np.asarray(p["b"]))
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+        assert l.output_shape((8,)) == (4,)
+        # weight param gets a real init; bias starts at bias_init
+        assert np.abs(np.asarray(p["W"])).max() > 0.0
+        assert np.all(np.asarray(p["b"]) == 0.0)
+
+    def test_trains_inside_mln(self):
+        net = _mlp(SDDense(n_out=8),
+                   OutputLayer(n_out=2, loss="mcxent"))
+        rs = np.random.RandomState(1)
+        x = rs.rand(64, 8).astype(np.float32)
+        cls = (x.sum(-1) > 4.0).astype(np.int32)
+        y = np.eye(2, dtype=np.float32)[cls]
+        first = net.score(x, y)
+        net.fit(x, y, epochs=100)
+        assert net.score(x, y) < first * 0.6
+
+    def test_lambda_layer(self):
+        net = _mlp(DenseLayer(n_out=4, activation="identity"),
+                   SameDiffLambdaLayer(fn=lambda sd, x: x * 2.0),
+                   OutputLayer(n_out=2, loss="mcxent"))
+        x = np.random.RandomState(2).rand(3, 8).astype(np.float32)
+        # doubled pre-activation == pre-activation of doubled dense out
+        a1, _, _ = net._forward(net._params, net._net_state,
+                                jnp.asarray(x), False, None, upto=1)
+        a2, _, _ = net._forward(net._params, net._net_state,
+                                jnp.asarray(x), False, None, upto=2)
+        np.testing.assert_allclose(np.asarray(a2), 2 * np.asarray(a1),
+                                   atol=1e-6)
+
+    def test_output_layer_trains(self):
+        net = _mlp(DenseLayer(n_out=8, activation="tanh"),
+                   SDMseOutput(n_out=2), updater=Adam(1e-2))
+        rs = np.random.RandomState(3)
+        x = rs.rand(64, 8).astype(np.float32)
+        y = np.stack([x.sum(-1), x[:, 0]], -1).astype(np.float32)
+        first = net.score(x, y)
+        net.fit(x, y, epochs=60)
+        assert net.score(x, y) < first * 0.3
+        assert net.output(x).shape == (64, 2)
+
+    def test_json_round_trip_by_import_path(self):
+        l = SDDense(n_out=4)
+        d = json.loads(json.dumps(l.to_json()))
+        l2 = from_json(d)
+        assert isinstance(l2, SDDense)
+        assert l2.n_out == 4
+        # rebuilt layer works
+        l2.build((8,), {"weight_init": "xavier"})
+        p = l2.init_params(RNG)
+        out, _ = l2.apply(p, jnp.ones((2, 8)), {}, False, None)
+        assert out.shape == (2, 4)
+
+    def test_activation_survives_round_trip(self):
+        l = SDDense(n_out=4, activation="relu")
+        l2 = from_json(json.loads(json.dumps(l.to_json())))
+        assert l2.activation.to_json() == l.activation.to_json()
+
+    def test_output_layer_rejects_mask(self):
+        l = SDMseOutput(n_out=2)
+        l.build((4,), {"weight_init": "xavier"})
+        p = l.init_params(RNG)
+        with pytest.raises(ValueError, match="mask"):
+            l.compute_loss(p, jnp.ones((2, 4)), jnp.ones((2, 2)),
+                           mask=jnp.ones((2, 1)))
+
+    def test_anonymous_lambda_not_serializable(self):
+        l = SameDiffLambdaLayer(fn=lambda sd, x: x)
+        with pytest.raises(ValueError):
+            from_json(json.loads(json.dumps(l.to_json())))
+
+
+# ---------------------------------------------------------------------------
+# SameDiff lambda vertex in a ComputationGraph
+# ---------------------------------------------------------------------------
+class TestSameDiffVertex:
+    def test_vertex_in_graph(self):
+        from deeplearning4j_tpu.nn.conf import InputType
+        from deeplearning4j_tpu.nn.graph import (ComputationGraph,
+                                                 SameDiffLambdaVertex)
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .updater(Adam(1e-2))
+                .graph_builder()
+                .add_inputs("in")
+                .set_input_types(InputType.feed_forward(8))
+                .add_layer("d1", DenseLayer(n_out=6, activation="tanh"),
+                           "in")
+                .add_vertex("gate",
+                            SameDiffLambdaVertex(
+                                fn=lambda sd, a, b: a * b.sigmoid()),
+                            "d1", "d1")
+                .add_layer("out", OutputLayer(n_out=2, loss="mcxent"),
+                           "gate")
+                .set_outputs("out")
+                .build())
+        g = ComputationGraph(conf).init()
+        rs = np.random.RandomState(0)
+        x = rs.rand(32, 8).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x.sum(-1) > 4).astype(int)]
+        first = g.score([x], [y])
+        g.fit([x], [y], epochs=100)
+        assert g.score([x], [y]) < first * 0.7
