@@ -19,7 +19,7 @@ import numpy as np
 
 from .tokenization import CommonPreprocessor, DefaultTokenizerFactory
 from .vocab import VocabCache
-from .word2vec import _EmbeddingModel, _as_sentences
+from .word2vec import _EmbeddingModel, _as_sentences, _iter_example_chunks
 
 
 class Glove(_EmbeddingModel):
@@ -106,19 +106,27 @@ class Glove(_EmbeddingModel):
             bt = bt.at[j].add(-lr * fd / jnp.sqrt(gbt[j]))
             return (w, wt, b, bt), (gw, gwt, gb, gbt), loss
 
-        jstep = jax.jit(step, donate_argnums=(0, 1))
-        B = min(self.batch_size, len(rows))
+        # one jitted lax.scan per epoch (dispatch elimination — see
+        # word2vec._make_epoch_fn)
+        def epoch_fn(params, state, batches):
+            def body(carry, xs):
+                p, s = carry
+                p, s, _ = step(p, s, *xs)
+                return (p, s), ()
+            (params, state), _ = jax.lax.scan(body, (params, state),
+                                              batches)
+            return params, state
+
+        jepoch = jax.jit(epoch_fn, donate_argnums=(0, 1))
+        params, state = tuple(params), tuple(state)  # match step's carry
         for epoch in range(self.epochs):
             perm = rng.permutation(len(rows))
-            r, c, v = rows[perm], cols[perm], vals[perm]
-            for off in range(0, len(r), B):
-                sl = [a[off:off + B] for a in (r, c, v)]
-                if len(sl[0]) < B:
-                    sl = [np.resize(a, B) for a in sl]
-                params, state, _ = jstep(params, state,
-                                         jnp.asarray(sl[0]),
-                                         jnp.asarray(sl[1]),
-                                         jnp.asarray(sl[2]))
+            colset = tuple(a[perm] for a in (rows, cols, vals))
+            # co-occurrence count is fixed across epochs -> shapes are
+            # already stable, no bucketing needed
+            for batches, _, _ in _iter_example_chunks(
+                    colset, self.batch_size, stable_shapes=False):
+                params, state = jepoch(params, state, batches)
         w, wt, b, bt = [np.asarray(p) for p in params]
         self.syn0 = w + wt  # GloVe paper: sum of both sets
         return self

@@ -22,7 +22,7 @@ import numpy as np
 from .tokenization import CommonPreprocessor, DefaultTokenizerFactory
 from .vocab import VocabCache
 from .word2vec import (Word2Vec, _EmbeddingModel, _as_sentences, _gen_pairs,
-                       _neg_table)
+                       _iter_example_chunks, _neg_table)
 
 
 class ParagraphVectors(Word2Vec):
@@ -67,21 +67,31 @@ class ParagraphVectors(Word2Vec):
         syn1 = jnp.asarray(self.syn1)
         key = jax.random.PRNGKey(self.seed)
         B = self.batch_size
+
+        # one jitted lax.scan per epoch (not one dispatch per batch —
+        # same dispatch-elimination as word2vec._make_epoch_fn)
+        def epoch_fn(dv, syn0, syn1, batches, table, lr, key0):
+            def body(carry, xs):
+                dv, syn0, syn1, k = carry
+                k, sub = jax.random.split(k)
+                dv, syn0, syn1 = step(dv, syn0, syn1, *xs, table, lr, sub)
+                return (dv, syn0, syn1, k), ()
+            (dv, syn0, syn1, _), _ = jax.lax.scan(
+                body, (dv, syn0, syn1, key0), batches)
+            return dv, syn0, syn1
+
+        jepoch = jax.jit(epoch_fn, donate_argnums=(0, 1, 2))
         for epoch in range(self.epochs):
             d_ids, words, ctxs = self._pv_examples(doc_idx, rng)
             perm = rng.permutation(len(d_ids))
-            d_ids, words, ctxs = d_ids[perm], words[perm], ctxs[perm]
-            Bz = min(B, max(1, len(d_ids)))
+            cols = tuple(a[perm] for a in (d_ids, words, ctxs))
             lr = self.learning_rate * (1 - epoch / max(1, self.epochs))
             lr = max(lr, self.min_learning_rate)
-            for off in range(0, len(d_ids), Bz):
-                sl = [a[off:off + Bz] for a in (d_ids, words, ctxs)]
-                if len(sl[0]) < Bz:
-                    sl = [np.resize(a, (Bz,) + a.shape[1:]) for a in sl]
+            for batches, _, _ in _iter_example_chunks(
+                    cols, B, stable_shapes=self.epochs > 1):
                 key, sub = jax.random.split(key)
-                dv, syn0, syn1 = step(dv, syn0, syn1,
-                                      *[jnp.asarray(a) for a in sl],
-                                      table, jnp.float32(lr), sub)
+                dv, syn0, syn1 = jepoch(dv, syn0, syn1, batches, table,
+                                        jnp.float32(lr), sub)
         self.doc_vectors = np.asarray(dv)
         self.syn0 = np.asarray(syn0)
         self.syn1 = np.asarray(syn1)
@@ -139,7 +149,9 @@ class ParagraphVectors(Word2Vec):
                 -lr * gu.reshape(-1, D) / cnt_t[tflat][:, None])
             return dv, syn0, syn1
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        # raw function: only called inside the jitted epoch scan, where a
+        # nested jit wrapper and donation annotations would be inert
+        return step
 
     # -- lookup / inference --------------------------------------------
     def doc_vector(self, label: str) -> Optional[np.ndarray]:

@@ -80,6 +80,81 @@ class _EmbeddingModel:
         return out
 
 
+# examples per device upload: each scanned chunk materializes at most
+# this many rows on device, so epoch memory stays bounded for
+# arbitrarily large corpora (the scan eliminates per-batch dispatch; the
+# chunking keeps its memory profile streaming-like)
+_MEGABATCH = 1 << 20
+
+
+def _batch_geometry(n: int, batch_size: int, stable_shapes: bool):
+    """(B, nb) the padder will use for an n-example chunk — shared by
+    `_pad_to_batches` (materialization) and `_padded_total` (lr-schedule
+    accounting), so the two can never disagree."""
+    p2 = 1 << (n - 1).bit_length()
+    B = min(int(batch_size), p2)
+    nb = -(-n // B)
+    if stable_shapes and nb > 32:  # bucket to the next multiple of ~nb/32
+        q = 1 << max(0, nb.bit_length() - 6)
+        nb = -(-nb // q) * q
+    return B, nb
+
+
+def _padded_total(n: int, batch_size: int, stable_shapes: bool) -> int:
+    """Total example SLOTS (incl. wrap padding) the chunked padder emits
+    for n examples — what the linear lr schedule must count, or the decay
+    finishes early by the duplication factor."""
+    tot = 0
+    for off in range(0, max(n, 1), _MEGABATCH):
+        m = min(_MEGABATCH, n - off)
+        if m <= 0:
+            break
+        B, nb = _batch_geometry(m, batch_size, stable_shapes)
+        tot += nb * B
+    return tot
+
+
+def _iter_example_chunks(cols, batch_size: int, stable_shapes: bool):
+    """Yield `_pad_to_batches` results over fixed-size slices of the
+    (already shuffled) example columns. Full chunks share one compiled
+    shape; only the tail chunk differs (bucketed when stable_shapes)."""
+    n = len(cols[0])
+    for off in range(0, max(n, 1), _MEGABATCH):
+        chunk = tuple(c[off:off + _MEGABATCH] for c in cols)
+        batches, B, tot = _pad_to_batches(chunk, batch_size, stable_shapes)
+        if batches is not None:
+            yield batches, B, tot
+
+
+def _pad_to_batches(cols, batch_size: int, stable_shapes: bool = True):
+    """Shared batching for the scanned-epoch trainers (word2vec / glove /
+    paragraph vectors): wrap-pad the shuffled example columns and reshape
+    to [nb, B, ...] for `lax.scan`.
+
+    Shapes are bucketed so the jitted epoch function compiles for few
+    distinct shapes instead of once per epoch (the dynamic-window pair
+    count varies every epoch): B is the configured batch size (or
+    next_pow2(n) for corpora smaller than one batch), and the batch
+    count is rounded up to a ~1/32-granularity bucket — ~3% wrapped
+    duplicate work for corpora of at least one batch (up to ~2x only for
+    sub-batch corpora, where B pads to next_pow2(n); callers account for
+    the padding in lr schedules via `_padded_total`). Every epoch
+    reshuffles, so the training multiset stays unbiased. Returns
+    (batches, B, total_slots), or (None, 0, 0) for a zero-example epoch
+    (callers skip it)."""
+    n = len(cols[0])
+    if n == 0:
+        return None, 0, 0
+    B, nb = _batch_geometry(n, batch_size, stable_shapes)
+    tot = nb * B
+    reps = -(-tot // n)  # tot >= n, so reps > 1 exactly when padding needed
+    if reps > 1:
+        cols = tuple(np.concatenate([c] * reps, 0)[:tot] for c in cols)
+    batches = tuple(jnp.asarray(c.reshape((nb, B) + c.shape[1:]))
+                    for c in cols)
+    return batches, B, tot
+
+
 def _neg_table(vocab: VocabCache, size: int = 1 << 17,
                power: float = 0.75) -> np.ndarray:
     counts = vocab.counts_array() ** power
@@ -238,7 +313,13 @@ class Word2Vec(_EmbeddingModel):
                 out.append(s2)
         return out
 
-    def _make_step(self):
+    def _make_batch_step(self):
+        """Single-batch update core (un-jitted) — the body of the scanned
+        epoch runner. `batch` is the tuple of per-batch index arrays; `hs`
+        holds the device-resident Huffman path tables (pts, codes, mask)
+        for hierarchical softmax, gathered per batch ON DEVICE (the old
+        path pre-gathered [N_pairs, L] paths on host — O(N·L) extra HBM
+        traffic and host memory)."""
         neg = self.negative
         D = self.layer_size
 
@@ -310,35 +391,67 @@ class Word2Vec(_EmbeddingModel):
 
         if self.use_hs:
             if self.algorithm == "skipgram":
-                def step(syn0, syn1, centers, points, codes, cmask,
-                         table, lr, key):
+                def batch_step(syn0, syn1, batch, table, hs, lr, key):
+                    centers, contexts = batch
+                    pts, cds, cm = hs
                     v = syn0[centers]
-                    return _hs_step(syn0, syn1, v, centers, points, codes,
-                                    cmask, lr)
+                    # context word predicts the center's Huffman path
+                    return _hs_step(syn0, syn1, v, centers, pts[contexts],
+                                    cds[contexts], cm[contexts], lr)
             else:
-                def step(syn0, syn1, ctx, mask, points, codes, cmask,
-                         table, lr, key):
+                def batch_step(syn0, syn1, batch, table, hs, lr, key):
+                    centers, ctx, mask = batch
+                    pts, cds, cm = hs
                     denom = jnp.maximum(mask.sum(1, keepdims=True), 1.0)
                     v = (syn0[ctx] * mask[..., None]).sum(1) / denom
                     # input-side update distributes over the window like
                     # the neg-sampling CBOW path
-                    syn0_, syn1_, loss = _hs_step(
-                        syn0, syn1, v, ctx[:, 0], points, codes, cmask, lr)
-                    return syn0_, syn1_, loss
+                    return _hs_step(syn0, syn1, v, ctx[:, 0], pts[centers],
+                                    cds[centers], cm[centers], lr)
         elif self.algorithm == "skipgram":
-            def step(syn0, syn1, centers, contexts, table, lr, key):
+            def batch_step(syn0, syn1, batch, table, hs, lr, key):
+                centers, contexts = batch
                 v = syn0[centers]
                 return _neg_step(syn0, syn1, v, centers, contexts, table,
                                  lr, key)
         else:  # cbow
-            def step(syn0, syn1, centers, ctx, mask, table, lr, key):
+            def batch_step(syn0, syn1, batch, table, hs, lr, key):
+                centers, ctx, mask = batch
                 denom = jnp.maximum(mask.sum(1, keepdims=True), 1.0)
                 v = (syn0[ctx] * mask[..., None]).sum(1) / denom  # [B, D]
                 w = mask / denom                                   # [B, W]
                 return _neg_step(syn0, syn1, v, ctx, centers, table,
                                  lr, key, in_weights=w)
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        return batch_step
+
+    def _make_epoch_fn(self):
+        """Whole-epoch runner: one jitted `lax.scan` over all batches.
+        The old loop dispatched one jitted step per batch from Python —
+        thousands of dispatches per epoch; through a remote-TPU tunnel
+        each costs a round trip. One scan = one dispatch per epoch, with
+        the lr schedule and RNG folding computed in-graph. `bsz` (the
+        pairs-per-batch for the lr schedule) is a traced argument because
+        the per-epoch batch shape can differ from epoch to epoch."""
+        batch_step = self._make_batch_step()
+        lr0 = float(self.learning_rate)
+        min_lr = float(self.min_learning_rate)
+
+        def epoch_fn(syn0, syn1, batches, table, hs, pairs0, total_est,
+                     bsz, key0):
+            def body(carry, batch):
+                s0, s1, i = carry
+                done = pairs0 + i.astype(jnp.float32) * bsz
+                frac = jnp.minimum(1.0, done / total_est)
+                lr = jnp.maximum(min_lr, lr0 * (1.0 - frac))
+                key = jax.random.fold_in(key0, i)
+                s0, s1, loss = batch_step(s0, s1, batch, table, hs, lr, key)
+                return (s0, s1, i + 1), loss
+            (syn0, syn1, _), losses = jax.lax.scan(
+                body, (syn0, syn1, jnp.int32(0)), batches)
+            return syn0, syn1, losses.mean()
+
+        return jax.jit(epoch_fn, donate_argnums=(0, 1))
 
     def fit(self, data) -> "Word2Vec":
         """`data`: iterable of raw strings (tokenized via the factory) or
@@ -373,47 +486,45 @@ class Word2Vec(_EmbeddingModel):
         counts = self.vocab.counts_array()
         total = counts.sum()
         table = jnp.asarray(_neg_table(self.vocab))
-        step = self._make_step()
+        hs = None
+        if self.use_hs:
+            # Huffman path tables stay device-resident; batches gather
+            # from them on device instead of pre-gathering [N_pairs, L]
+            # paths on host
+            hs = (jnp.asarray(pts), jnp.asarray(cds), jnp.asarray(cm))
         syn0, syn1 = jnp.asarray(self.syn0), jnp.asarray(self.syn1)
         key = jax.random.PRNGKey(self.seed)
-        n_steps_done = 0
+        pairs_done = 0
         total_pairs_est = None
+        epoch_fn = self._make_epoch_fn()
         for epoch in range(self.epochs):
             ss = self._subsample(sent_idx, counts, total, rng)
             if self.algorithm == "skipgram":
-                centers, contexts = _gen_pairs(ss, self.window_size, rng)
-                if self.use_hs:
-                    cols = (centers, pts[contexts], cds[contexts],
-                            cm[contexts])
-                else:
-                    cols = (centers, contexts)
+                cols = _gen_pairs(ss, self.window_size, rng)
             else:
-                centers, ctx, mask = _gen_cbow(ss, self.window_size, rng)
-                if self.use_hs:
-                    cols = (ctx, mask, pts[centers], cds[centers],
-                            cm[centers])
-                else:
-                    cols = (centers, ctx, mask)
-            perm = rng.permutation(len(centers))
+                cols = _gen_cbow(ss, self.window_size, rng)
+            n = len(cols[0])
+            if n == 0:
+                continue  # zero-pair epoch (tiny/fully-subsampled corpus)
+            perm = rng.permutation(n)
             cols = tuple(c[perm] for c in cols)
+            stable = self.epochs * self.iterations > 1
             if total_pairs_est is None:
-                total_pairs_est = max(1, len(centers)) * self.epochs \
-                    * self.iterations
-            B = min(self.batch_size, max(1, len(centers)))
+                # count padded SLOTS, not raw pairs — pairs_done advances
+                # by slots, so a raw-pair total would finish the lr decay
+                # early by the duplication factor
+                total_pairs_est = max(1, _padded_total(
+                    n, self.batch_size, stable)) \
+                    * self.epochs * self.iterations
             for it in range(self.iterations):
-                for off in range(0, len(centers), B):
-                    frac = min(1.0, (n_steps_done * B) / total_pairs_est)
-                    lr = max(self.min_learning_rate,
-                             self.learning_rate * (1 - frac))
+                for batches, B, tot in _iter_example_chunks(
+                        cols, self.batch_size, stable):
                     key, sub = jax.random.split(key)
-                    sl = [c[off:off + B] for c in cols]
-                    if len(sl[0]) < B:  # pad the tail batch (wrap) so the
-                        sl = [np.resize(a, (B,) + a.shape[1:])  # jit shape
-                              for a in sl]                      # is stable
-                    syn0, syn1, _ = step(syn0, syn1,
-                                         *[jnp.asarray(a) for a in sl],
-                                         table, jnp.float32(lr), sub)
-                    n_steps_done += 1
+                    syn0, syn1, _ = epoch_fn(
+                        syn0, syn1, batches, table, hs,
+                        jnp.float32(pairs_done),
+                        jnp.float32(total_pairs_est), jnp.float32(B), sub)
+                    pairs_done += tot
         self.syn0 = np.asarray(syn0)
         self.syn1 = np.asarray(syn1)
         return self

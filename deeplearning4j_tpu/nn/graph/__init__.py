@@ -835,20 +835,27 @@ class ComputationGraph:
 
     def _fmask_from(self, masks):
         """Feature mask for the forward pass (RNN padding + MaskLayer).
-        A mask keyed by an INPUT name is explicitly a feature mask (ref:
-        ComputationGraph.setLayerMaskArrays featureMaskArrays); on a
-        single-input graph the sole [B, T] mask doubles as feature+label
-        mask, matching MultiLayerNetwork's convention."""
+        Only a mask keyed by an INPUT name is a feature mask (ref:
+        ComputationGraph keeps featureMaskArrays and labelMaskArrays
+        distinct — setLayerMaskArrays). A bare/output-keyed mask stays a
+        label mask: silently reusing it as a feature mask would corrupt
+        many-to-one RNN training (a last-step-only label mask would make
+        the RNN treat every earlier timestep as padding)."""
         if not masks:
             return None
-        for name in self.conf.graph_inputs:
-            if name in masks:
-                return masks[name]
-        if len(self.conf.graph_inputs) == 1 and len(masks) == 1:
-            m = next(iter(masks.values()))
-            if m.ndim == 2:
-                return m
-        return None
+        keyed = [n for n in self.conf.graph_inputs if n in masks]
+        if not keyed:
+            return None
+        if len(self.conf.graph_inputs) > 1:
+            # _forward threads ONE fmask globally; applying input A's
+            # padding pattern to input B's branch would silently corrupt
+            # it. Per-branch mask propagation is not implemented — fail
+            # loudly instead.
+            raise NotImplementedError(
+                "per-input feature masks on a multi-input "
+                "ComputationGraph are not supported — only single-input "
+                "graphs can take an input-keyed feature mask")
+        return masks[keyed[0]]
 
     def output(self, *data, train: bool = False, mask=None):
         """Returns the list of output activations (ref:

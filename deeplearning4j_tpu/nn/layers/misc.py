@@ -22,6 +22,7 @@ from jax import lax
 
 from ... import losses as L
 from . import DenseLayer, Layer, LossLayer, register
+from .convolutional import FrozenLayer
 
 
 @register
@@ -75,12 +76,9 @@ class AutoEncoder(DenseLayer):
     def decode(self, params, y):
         return self.activation(y @ params["W"].T + params["vb"])
 
-    # supervised forward = encode (ref: AutoEncoder.activate -> encode)
-    def apply(self, params, x, state, train, rng):
-        if getattr(self, "_flatten_input", False) and x.ndim > 2:
-            x = x.reshape(x.shape[0], -1)
-        x = self._maybe_dropout(x, train, rng)
-        return self.encode(params, x), state
+    # supervised forward = encode = the inherited DenseLayer.apply
+    # (ref: AutoEncoder.activate -> encode); no override needed — the
+    # flatten/dropout/matmul/bias path is shared via pre_output
 
     # -- unsupervised pretraining (MultiLayerNetwork.pretrain protocol) --
     def pretrain_loss(self, params, x, rng):
@@ -152,6 +150,7 @@ class CnnLossLayer(LossLayer):
 
     def compute_loss(self, params, x, labels, mask=None, train: bool = False,
                      rng=None):
+        x = self._maybe_dropout(x, train, rng)  # parity with LossLayer
         c = x.shape[-1]
         m2 = None
         if mask is not None:
@@ -178,7 +177,7 @@ class Cnn3DLossLayer(CnnLossLayer):
 
 
 @register
-class FrozenLayerWithBackprop(Layer):
+class FrozenLayerWithBackprop(FrozenLayer):
     """Freezes the wrapped layer's params but keeps the wrapped layer's
     TRAINING-mode forward (dropout etc. still active) — unlike
     FrozenLayer, which also pins the wrapped layer to inference mode.
@@ -186,40 +185,10 @@ class FrozenLayerWithBackprop(Layer):
     distinction mirrors the reference pair
     (`nn/conf/layers/misc/FrozenLayer.java` wraps in a layer that uses
     test-time behaviour; `FrozenLayerWithBackprop.java` only blocks the
-    parameter update)."""
+    parameter update). Everything except the train-flag handling is
+    inherited from FrozenLayer."""
 
     kind = "frozen_backprop"
-
-    def __init__(self, layer=None, **kw):
-        kw.setdefault("activation", "identity")
-        super().__init__(**kw)
-        if isinstance(layer, dict):
-            from . import from_json
-            layer = from_json(layer)
-        self.layer = layer
-
-    @property
-    def is_rnn(self):
-        return getattr(self.layer, "is_rnn", False)
-
-    def build(self, input_shape, defaults=None):
-        super().build(input_shape, defaults)
-        self.layer.build(input_shape, defaults)
-        # no weight decay on frozen params (same reasoning as FrozenLayer:
-        # l2*W gradients would bypass the stop_gradient)
-        self.l1 = self.l2 = self.l1_bias = self.l2_bias = 0.0
-
-    def param_shapes(self):
-        return self.layer.param_shapes()
-
-    def init_params(self, rng, dtype=jnp.float32):
-        return self.layer.init_params(rng, dtype)
-
-    def init_state(self):
-        return self.layer.init_state()
-
-    def init_carry(self, batch, dtype=jnp.float32):
-        return self.layer.init_carry(batch, dtype)
 
     def apply(self, params, x, state, train, rng):
         params = jax.tree_util.tree_map(lax.stop_gradient, params)
@@ -227,18 +196,11 @@ class FrozenLayerWithBackprop(Layer):
 
     def apply_seq(self, params, x, state, train, rng, carry, mask):
         params = jax.tree_util.tree_map(lax.stop_gradient, params)
-        return self.layer.apply_seq(params, x, state, train, rng, carry, mask)
+        return self.layer.apply_seq(params, x, state, train, rng, carry,
+                                    mask)
 
     def compute_loss(self, params, x, labels, mask=None, train: bool = False,
                      rng=None):
-        # frozen OUTPUT layer (transfer learning's canonical head-freeze):
-        # score flows, its params don't move
         params = jax.tree_util.tree_map(lax.stop_gradient, params)
         return self.layer.compute_loss(params, x, labels, mask, train=train,
                                        rng=rng)
-
-    def output_shape(self, input_shape):
-        return self.layer.output_shape(input_shape)
-
-    def _extra_json(self):
-        return {"layer": self.layer.to_json()}

@@ -162,10 +162,31 @@ class TestMaskLayer:
         out_m = np.asarray(g.output([x], mask=mask))
         out_nm = np.asarray(g.output([x]))
         assert not np.allclose(out_m[:2, 3:], out_nm[:2, 3:])
-        # fit() with a mask trains without error and the loss moves
+        # fit() with an INPUT-keyed mask (a feature mask — label masks
+        # keyed by outputs must NOT leak into the forward pass) trains
+        # without error and the loss moves
         s0 = g.score([x], [y])
-        g.fit([([x], [y], [mask])], epochs=10)
+        g.fit([([x], [y], {"in": mask})], epochs=10)
         assert g.score([x], [y]) != s0
+        # an output-keyed (label) mask must not become a feature mask
+        assert g._fmask_from({"out": jnp.asarray(mask)}) is None
+
+    def test_multi_input_graph_rejects_input_masks(self):
+        from deeplearning4j_tpu.nn.conf import InputType
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.graph import MergeVertex
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.1))
+                .graph_builder()
+                .add_inputs("a", "b")
+                .set_input_types(InputType.feed_forward(4),
+                                 InputType.feed_forward(4))
+                .add_vertex("m", MergeVertex(), "a", "b")
+                .add_layer("out", OutputLayer(n_out=2, loss="mcxent"), "m")
+                .set_outputs("out")
+                .build())
+        g = ComputationGraph(conf).init()
+        with pytest.raises(NotImplementedError, match="multi-input"):
+            g._fmask_from({"a": jnp.ones((2, 5))})
 
     def test_identity_without_mask(self):
         l = MaskLayer()
