@@ -184,6 +184,10 @@ class OnnxGraphMapper:
         nodes, inits, inputs, outputs = parse_model(data)
         sd = SameDiff.create()
         env: Dict[str, Any] = {}
+        # raw numpy side-table: jnp constants truncate int64 to int32,
+        # which destroys ONNX's INT64_MIN/MAX open-slice sentinels —
+        # const_of() prefers these originals
+        env["__raw__"] = dict(inits)
         for name, arr in inits.items():
             env[name] = sd.constant(arr, name=name.replace("/", "_")
                                     .replace(".", "_"))
@@ -220,7 +224,20 @@ class OnnxGraphMapper:
             return first
 
         def const_of(name):
-            return np.asarray(sd.get_variable(env[name].name).get_arr())
+            """Materialize a compile-time-constant input. Prefers the raw
+            int64 numpy original (jnp truncates to int32, destroying
+            sentinel values); torch's exporter also COMPUTES shape/pad/
+            slice arguments through chains of Constant/Cast/Reshape/Add
+            nodes — when no literal exists, fold the (closed,
+            placeholder-free) subgraph."""
+            raw = env.get("__raw__", {})
+            if name in raw:
+                return np.asarray(raw[name])
+            v = sd.get_variable(env[name].name)
+            arr = v.get_arr()
+            if arr is None:
+                arr = sd.output({}, [v.name])[v.name]
+            return np.asarray(arr)
 
         if op == "Constant":
             # value arrives as a TensorProto attribute (value / value_float
@@ -229,6 +246,7 @@ class OnnxGraphMapper:
             if val is None:
                 val = np.asarray(a.get("value_float",
                                        a.get("value_int", 0.0)))
+            env.setdefault("__raw__", {})[n.outputs[0]] = np.asarray(val)
             env[n.outputs[0]] = sd.constant(np.asarray(val), name=safe)
         elif op == "Shape":
             shape = env[ins[0]].shape
@@ -375,6 +393,170 @@ class OnnxGraphMapper:
         elif op == "Gather":
             rec("gather", env[ins[0]], env[ins[1]],
                 axis=a.get("axis", 0))
+        elif op == "Pow":
+            rec("pow", env[ins[0]], env[ins[1]])
+        elif op in ("Min", "Max"):
+            cat = "minimum" if op == "Min" else "maximum"
+            if len(ins) == 1:  # variadic with one input = identity; do
+                env[n.outputs[0]] = env[ins[0]]  # NOT rename upstream
+            else:
+                y = env[ins[0]]
+                for i in ins[1:]:
+                    y = sd._record(cat, (y, env[i]), {})
+                y.rename(safe)
+                env[n.outputs[0]] = y
+        elif op == "Where":
+            rec("select", env[ins[0]], env[ins[1]], env[ins[2]])
+        elif op in ("Equal", "Greater", "Less", "GreaterOrEqual",
+                    "LessOrEqual"):
+            cat = {"Equal": "equals", "Greater": "greater", "Less": "less",
+                   "GreaterOrEqual": "greater_equal",
+                   "LessOrEqual": "less_equal"}[op]
+            rec(cat, env[ins[0]], env[ins[1]])
+        elif op == "Dropout":
+            env[n.outputs[0]] = env[ins[0]]  # inference graph: identity
+        elif op == "Gelu":
+            approx = a.get("approximate", "none")
+            approx = approx.decode() if isinstance(approx, bytes) \
+                else str(approx)
+            if approx == "tanh":
+                rec("legacy.gelu", env[ins[0]])  # jax.nn.gelu tanh form
+            else:
+                # exact erf form (torch's default)
+                x = env[ins[0]]
+                e = sd._record("legacy.erf", (x * 0.7071067811865476,), {})
+                y = x * 0.5 * (e + 1.0)
+                y.rename(safe)
+                env[n.outputs[0]] = y
+        elif op == "PRelu":
+            rec("prelu", env[ins[0]], env[ins[1]])
+        elif op == "Pad":
+            # opset 11+: pads arrive as a constant input in
+            # [begin_0..begin_k, end_0..end_k] layout; mode is an attr
+            if len(ins) > 1 and ins[1]:
+                pads = const_of(ins[1]).ravel()
+            else:
+                pads = np.asarray(a.get("pads", []), np.int64)
+            k = len(pads) // 2
+            paddings = tuple((int(pads[i]), int(pads[i + k]))
+                             for i in range(k))
+            mode = a.get("mode", "constant")
+            mode = mode.decode() if isinstance(mode, bytes) else str(mode)
+            if mode == "edge":
+                raise ValueError("Pad mode 'edge' unsupported")
+            cval = 0.0
+            if len(ins) > 2 and ins[2]:
+                cval = float(const_of(ins[2]).ravel()[0])
+            rec("pad", env[ins[0]], paddings=paddings, mode=mode,
+                constant_values=cval)
+        elif op == "Slice":
+            # opset 10+: starts/ends/axes/steps as constant inputs
+            starts = [int(v) for v in const_of(ins[1]).ravel()]
+            ends = [int(v) for v in const_of(ins[2]).ravel()]
+            x = env[ins[0]]
+            if x.shape is None:
+                raise ValueError("Slice on an input of unknown rank "
+                                 "unsupported")
+            rank = len(x.shape)
+            axes = [int(v) for v in const_of(ins[3]).ravel()] \
+                if len(ins) > 3 and ins[3] else list(range(len(starts)))
+            steps = [int(v) for v in const_of(ins[4]).ravel()] \
+                if len(ins) > 4 and ins[4] else [1] * len(starts)
+            spec = [["s", None, None, 1] for _ in range(rank)]
+            for ax, s, e, st in zip(axes, starts, ends, steps):
+                ax = ax + rank if ax < 0 else ax
+                # ONNX clamps out-of-range bounds to the dim ends;
+                # INT64_MIN/MAX-magnitude bounds are open-slice sentinels
+                # (INT64_MIN with step -1 = "reverse through index 0")
+                begin = None if (s == 0 and st > 0) else int(s)
+                dim = x.shape[ax] if x.shape else None
+                end = None if (abs(e) >= (1 << 31) - 1 or
+                               (st > 0 and dim and e >= dim)) else int(e)
+                spec[ax] = ["s", begin, end, int(st)]
+            rec("numpy_slice", x, spec=tuple(tuple(s) for s in spec))
+        elif op == "Split":
+            axis = a.get("axis", 0)
+            if len(ins) > 1 and ins[1]:
+                sizes = tuple(int(v) for v in const_of(ins[1]).ravel())
+                v = sd._record("split_v", (env[ins[0]], sizes),
+                               {"axis": axis})
+            elif "split" in a:
+                sizes = tuple(int(s) for s in a["split"])
+                v = sd._record("split_v", (env[ins[0]], sizes),
+                               {"axis": axis})
+            else:
+                num = a.get("num_outputs", len(n.outputs))
+                v = sd._record("split", (env[ins[0]], int(num)),
+                               {"axis": axis})
+            for i, out_name in enumerate(n.outputs):
+                env[out_name] = v[i]
+        elif op == "Expand":
+            shape = tuple(int(s) for s in const_of(ins[1]).ravel())
+            rec("tile_to_shape", env[ins[0]], shape=shape)
+        elif op == "ConstantOfShape":
+            shape = tuple(int(s) for s in const_of(ins[0]).ravel())
+            val = a.get("value", np.zeros(1, np.float32))
+            arr = np.full(shape, np.asarray(val).ravel()[0])
+            env[n.outputs[0]] = sd.constant(arr, name=safe)
+        elif op == "ConvTranspose":
+            strides = tuple(a.get("strides", [1, 1]))
+            pads = a.get("pads", [0, 0, 0, 0])
+            unsupported = []
+            if a.get("group", 1) != 1:
+                unsupported.append("group != 1")
+            if any(d != 1 for d in a.get("dilations", [1, 1])):
+                unsupported.append("dilations != 1")
+            if any(a.get("output_padding", [0, 0])):
+                unsupported.append("output_padding != 0")
+            ap = a.get("auto_pad", "NOTSET")
+            ap = ap.decode() if isinstance(ap, bytes) else str(ap)
+            if ap not in ("NOTSET", ""):
+                unsupported.append(f"auto_pad={ap}")
+            if unsupported:
+                raise ValueError("ConvTranspose with "
+                                 f"{', '.join(unsupported)} unsupported")
+            x_nhwc = sd._record("permute", (env[ins[0]],),
+                                {"axes": (0, 2, 3, 1)})
+            # ONNX [I, O, kH, kW] -> [kH, kW, I, O] with the spatial taps
+            # flipped: torch's ConvTranspose is the conv GRADIENT, while
+            # deconv2d lowers to lax.conv_transpose without kernel
+            # mirroring (same conversion as the Keras Conv2DTranspose
+            # mapper — modelimport/keras.py)
+            w = const_of(ins[1])
+            w_hwio = sd.constant(
+                np.transpose(w, (2, 3, 0, 1))[::-1, ::-1])
+            if any(pads):
+                padding = ((pads[0], pads[2]), (pads[1], pads[3]))
+                raise ValueError("ConvTranspose with explicit pads "
+                                 f"{padding} unsupported (use pads=0)")
+            y = sd._record("deconv2d", (x_nhwc, w_hwio),
+                           {"stride": strides, "padding": "valid"})
+            if len(ins) > 2:
+                y = y + env[ins[2]]
+            y = sd._record("permute", (y,), {"axes": (0, 3, 1, 2)})
+            y.rename(safe)
+            env[n.outputs[0]] = y
+        elif op == "LayerNormalization":
+            axis = a.get("axis", -1)
+            eps = a.get("epsilon", 1e-5)
+            x, g = env[ins[0]], env[ins[1]]
+            if x.shape is None:
+                raise ValueError("LayerNormalization on an input of "
+                                 "unknown rank unsupported")
+            rank = len(x.shape)
+            # ONNX normalizes over ALL trailing axes [axis, rank)
+            axes = tuple(range(axis + rank if axis < 0 else axis, rank))
+            mean = sd._record("reduce_mean", (x,), {"axes": axes,
+                                                    "keep_dims": True})
+            d = x - mean
+            var = sd._record("reduce_mean", (d * d,),
+                             {"axes": axes, "keep_dims": True})
+            yn = d / ((var + float(eps)) ** 0.5)
+            y = yn * g
+            if len(ins) > 2 and ins[2]:
+                y = y + env[ins[2]]
+            y.rename(safe)
+            env[n.outputs[0]] = y
         else:
             raise ValueError(f"unsupported ONNX op {op!r} (node "
                              f"{n.name!r}); extend OnnxGraphMapper")

@@ -111,6 +111,55 @@ class _MLPDeep(torch.nn.Module):
         return self.ls[2](x)
 
 
+class _FFNBlock(torch.nn.Module):
+    """Transformer FFN: LayerNorm + GELU + residual (exercises
+    LayerNormalization — or its ReduceMean/Pow/Sqrt decomposition on
+    older opsets — plus Gelu/Erf)."""
+
+    def __init__(self):
+        super().__init__()
+        self.ln = torch.nn.LayerNorm(16)
+        self.fc1 = torch.nn.Linear(16, 32)
+        self.fc2 = torch.nn.Linear(32, 16)
+
+    def forward(self, x):
+        h = self.ln(x)
+        h = torch.nn.functional.gelu(self.fc1(h))
+        return x + self.fc2(h)
+
+
+class _PadSliceSplit(torch.nn.Module):
+    def forward(self, x):
+        y = torch.nn.functional.pad(x, (1, 2), value=0.5)
+        a, b = torch.split(y, [4, y.shape[-1] - 4], dim=-1)
+        c = a[:, 1:3]
+        m = torch.where(c > 0, c, -c)
+        return torch.cat([m, b[:, :2] ** 2.0, torch.maximum(c, m)],
+                         dim=-1)
+
+
+class _Deconv(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.d = torch.nn.ConvTranspose2d(3, 5, 2, stride=2)
+        self.p = torch.nn.PReLU(5)
+
+    def forward(self, x):
+        return self.p(self.d(x))
+
+
+class _LNMultiAxis(torch.nn.Module):
+    """LayerNorm over the last TWO axes (exports axis=-2 — the ONNX
+    multi-axis normalization case)."""
+
+    def __init__(self):
+        super().__init__()
+        self.ln = torch.nn.LayerNorm((4, 6))
+
+    def forward(self, x):
+        return torch.relu(self.ln(x)) + 0.5
+
+
 FIXTURES = [
     ("mlp_softmax", _GemmChain(), [(3, 6)]),
     ("mlp_deep", _MLPDeep(), [(4, 8)]),
@@ -120,11 +169,17 @@ FIXTURES = [
     ("activations", _Acts(), [(3, 7)]),
     ("shapes", _Shapes(), [(2, 3, 4)]),
     ("clip_reduce", _ClipReduce(), [(5, 6)]),
+    ("ffn_block", _FFNBlock(), [(3, 4, 16)]),
+    ("pad_slice_split", _PadSliceSplit(), [(4, 6)]),
+    ("deconv_prelu", _Deconv(), [(2, 3, 5, 5)]),
+    ("ln_multiaxis", _LNMultiAxis(), [(2, 4, 6)]),
 ]
 
 
-def main():
+def main(only=None):
     for name, model, shapes in FIXTURES:
+        if only and name not in only:
+            continue
         torch.manual_seed(hash(name) % (2 ** 31))
         model.eval()
         rs = np.random.RandomState(abs(hash(name)) % (2 ** 31))
@@ -145,4 +200,5 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+    main(only=set(sys.argv[1:]) or None)
