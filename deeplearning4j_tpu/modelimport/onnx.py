@@ -442,8 +442,11 @@ class OnnxGraphMapper:
                              for i in range(k))
             mode = a.get("mode", "constant")
             mode = mode.decode() if isinstance(mode, bytes) else str(mode)
-            if mode == "edge":
-                raise ValueError("Pad mode 'edge' unsupported")
+            if mode not in ("constant", "reflect", "symmetric"):
+                raise ValueError(f"Pad mode {mode!r} unsupported")
+            if len(ins) > 3 and ins[3]:
+                raise ValueError("Pad with an `axes` input (opset 18 "
+                                 "subset-axes form) unsupported")
             cval = 0.0
             if len(ins) > 2 and ins[2]:
                 cval = float(const_of(ins[2]).ravel()[0])
@@ -491,8 +494,24 @@ class OnnxGraphMapper:
             for i, out_name in enumerate(n.outputs):
                 env[out_name] = v[i]
         elif op == "Expand":
-            shape = tuple(int(s) for s in const_of(ins[1]).ravel())
-            rec("tile_to_shape", env[ins[0]], shape=shape)
+            # ONNX Expand is BIDIRECTIONAL broadcast: a target entry of 1
+            # keeps the input dim, and the input may have more dims than
+            # the target — resolve the final shape statically
+            shape = [int(s) for s in const_of(ins[1]).ravel()]
+            x = env[ins[0]]
+            if x.shape is None or any(s is None for s in x.shape):
+                raise ValueError("Expand on dynamic input unsupported")
+            xs = list(x.shape)
+            rank = max(len(xs), len(shape))
+            xs = [1] * (rank - len(xs)) + xs
+            shape = [1] * (rank - len(shape)) + shape
+            out = []
+            for xd, td in zip(xs, shape):
+                if xd != td and 1 not in (xd, td):
+                    raise ValueError(f"Expand: cannot broadcast {xs} "
+                                     f"to {shape}")
+                out.append(max(xd, td))
+            rec("tile_to_shape", x, shape=tuple(out))
         elif op == "ConstantOfShape":
             shape = tuple(int(s) for s in const_of(ins[0]).ravel())
             val = a.get("value", np.zeros(1, np.float32))
@@ -531,7 +550,7 @@ class OnnxGraphMapper:
                                  f"{padding} unsupported (use pads=0)")
             y = sd._record("deconv2d", (x_nhwc, w_hwio),
                            {"stride": strides, "padding": "valid"})
-            if len(ins) > 2:
+            if len(ins) > 2 and ins[2]:
                 y = y + env[ins[2]]
             y = sd._record("permute", (y,), {"axes": (0, 3, 1, 2)})
             y.rename(safe)

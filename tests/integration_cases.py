@@ -97,8 +97,72 @@ def case_transfer():
     return net, _batches((16, 10), 2, seed=4), probe
 
 
+def case_attention():
+    """Pre-LN transformer encoder block over a padded-free sequence
+    (ref role: the round-4 attention stack; deterministic — all dropout
+    zero, plain implementation so the case is backend-stable)."""
+    from deeplearning4j_tpu.nn.layers.attention import (
+        TransformerEncoderLayer)
+    conf = (NeuralNetConfiguration.builder().seed(21).updater(Adam(2e-3))
+            .weight_init("xavier").list()
+            .layer(TransformerEncoderLayer(n_heads=2, d_ff=32,
+                                           implementation="plain"))
+            .layer(RnnOutputLayer(n_out=3, loss="mcxent",
+                                  activation="softmax"))
+            .input_type_recurrent(8).build())
+    model = MultiLayerNetwork(conf).init()
+    return model, _batches((4, 6, 8), 3, seed=5, seq=True), \
+        np.random.RandomState(96).rand(2, 6, 8).astype(np.float32)
+
+
+def case_autoencoder():
+    """Denoising-AE pretrain (fixed rng via the model's seeded stream)
+    then supervised fine-tune — covers the round-4 AutoEncoder layer +
+    the layerwise pretraining protocol end to end."""
+    from deeplearning4j_tpu.nn.layers import AutoEncoder
+    conf = (NeuralNetConfiguration.builder().seed(31).updater(Adam(2e-3))
+            .weight_init("xavier").list()
+            .layer(AutoEncoder(n_out=8, corruption_level=0.2,
+                               activation="sigmoid"))
+            .layer(OutputLayer(n_out=3, loss="mcxent",
+                               activation="softmax"))
+            .input_type_feed_forward(12).build())
+    model = MultiLayerNetwork(conf).init()
+    batches = _batches((16, 12), 3, seed=6)
+    model.pretrain(batches, epochs=2)
+    return model, batches, \
+        np.random.RandomState(95).rand(4, 12).astype(np.float32)
+
+
+def case_conv_deep():
+    """Separable/depthwise/transpose conv + upsampling/cropping family
+    in one stack (the conv-breadth layers have had no golden coverage)."""
+    from deeplearning4j_tpu.nn.layers import Upsampling2D
+    from deeplearning4j_tpu.nn.layers.convolutional import (
+        Cropping2D, Deconvolution2D, DepthwiseConvolution2D,
+        SeparableConvolution2D)
+    conf = (NeuralNetConfiguration.builder().seed(17).updater(Sgd(0.02))
+            .weight_init("relu").list()
+            .layer(SeparableConvolution2D(n_out=6, kernel=(3, 3),
+                                          activation="relu"))
+            .layer(DepthwiseConvolution2D(depth_multiplier=2,
+                                          kernel=(3, 3),
+                                          activation="relu"))
+            .layer(Deconvolution2D(n_out=4, kernel=(2, 2), stride=(2, 2)))
+            .layer(Upsampling2D(size=(2, 2)))
+            .layer(Cropping2D(cropping=((1, 1), (1, 1))))
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=3, loss="mcxent",
+                               activation="softmax"))
+            .input_type_convolutional(10, 10, 2).build())
+    model = MultiLayerNetwork(conf).init()
+    return model, _batches((4, 10, 10, 2), 3, seed=7), \
+        np.random.RandomState(94).rand(2, 10, 10, 2).astype(np.float32)
+
+
 CASES = {"mlp": case_mlp, "cnn2d": case_cnn2d, "rnn": case_rnn,
-         "transfer": case_transfer}
+         "transfer": case_transfer, "attention": case_attention,
+         "autoencoder": case_autoencoder, "conv_deep": case_conv_deep}
 
 
 def run_case(name):
