@@ -304,6 +304,34 @@ print(json.dumps({"model": "attention fwd+bwd ms/step (B4 H8 D64)",
                   "results": results}))
 """
 
+ETL_CODE = _COMMON + r"""
+# ETL pipeline throughput, reported SEPARATELY from model benches per the
+# reference's own methodology (benchmark.md: 'ETL measured separately via
+# PerformanceListener'): CSV -> schema transform -> batched DataSets.
+import os, tempfile, time
+from deeplearning4j_tpu.etl import CSVRecordReader
+from deeplearning4j_tpu.etl.iterators import RecordReaderDataSetIterator
+
+N_ROWS, N_FEAT = 200_000, 20
+rs = np.random.RandomState(0)
+data = rs.rand(N_ROWS, N_FEAT).astype(np.float32)
+labels = rs.randint(0, 5, (N_ROWS, 1))
+with tempfile.TemporaryDirectory() as td:
+    path = os.path.join(td, "data.csv")
+    np.savetxt(path, np.hstack([data, labels]), delimiter=",", fmt="%.6f")
+    t0 = time.perf_counter()
+    reader = CSVRecordReader(path)
+    it = RecordReaderDataSetIterator(reader, batch_size=512,
+                                     label_index=N_FEAT, num_classes=5)
+    n = 0
+    for feats, _labels in it:
+        n += np.asarray(feats).shape[0]
+    dt = time.perf_counter() - t0
+print(json.dumps({"model": "ETL CSV->DataSet pipeline",
+                  "rows_per_sec": round(n / dt, 1), "rows": n,
+                  "wall_seconds": round(dt, 2)}))
+"""
+
 WORD2VEC_CODE = _COMMON + r"""
 # BASELINE config 4: Word2Vec throughput at benchmark scale. text8 is
 # 100MB of wiki text; no egress here, so a labeled synthetic corpus with
@@ -483,6 +511,14 @@ def main():
                                    "synthetic_data", "wall_seconds",
                                    "platform")
                                   if k in w2v}
+        # ETL throughput, reported separately per the reference's own
+        # benchmark methodology (host-side; CPU env keeps it off the
+        # tunnel entirely)
+        etl = _run(ETL_CODE, _CPU_ENV, timeout=600)
+        if etl:
+            extras["etl_pipeline"] = {k: etl[k] for k in
+                                      ("rows_per_sec", "rows",
+                                       "wall_seconds") if k in etl}
     # physics gates — hard-fail rather than publish impossible numbers
     measured = [("headline", res if not fallback else None),
                 ("resnet50_b128", r128)]

@@ -39,10 +39,17 @@ class RecordReaderDataSetIterator(DataSetIterator):
         self.num_classes = num_classes
         self.regression = regression
         self._records: Optional[List[list]] = None
+        self._matrix = None
         self._pos = 0
 
     def _load(self):
-        if self._records is None:
+        if self._records is not None or self._matrix is not None:
+            return
+        # all-numeric fast path: slice batches out of one [rows, cols]
+        # float32 matrix (native CSV parser) instead of per-row python
+        m = getattr(self.reader, "matrix", None)
+        self._matrix = m() if callable(m) else None
+        if self._matrix is None:
             self._records = list(self.reader)
 
     def reset(self):
@@ -51,12 +58,30 @@ class RecordReaderDataSetIterator(DataSetIterator):
     def batch_size(self):
         return self._batch
 
-    def has_next(self):
+    def _n_rows(self):
         self._load()
-        return self._pos < len(self._records)
+        return len(self._records if self._matrix is None else self._matrix)
+
+    def has_next(self):
+        return self._pos < self._n_rows()
 
     def next(self):
         self._load()
+        if self._matrix is not None:
+            chunk = self._matrix[self._pos:self._pos + self._batch]
+            self._pos += len(chunk)
+            if self.label_index is None:
+                return np.ascontiguousarray(chunk, np.float32), None
+            li = self.label_index % chunk.shape[1]  # negative idx parity
+                                                    # with the row path
+            feats = np.ascontiguousarray(
+                np.delete(chunk, li, axis=1), np.float32)
+            if self.regression:
+                labels = chunk[:, li:li + 1].astype(np.float32)
+            else:
+                labels = np.eye(self.num_classes, dtype=np.float32)[
+                    chunk[:, li].astype(np.int64)]
+            return feats, labels
         chunk = self._records[self._pos:self._pos + self._batch]
         self._pos += len(chunk)
         if self.label_index is None:
@@ -66,7 +91,9 @@ class RecordReaderDataSetIterator(DataSetIterator):
         li = self.label_index
         feats, labels = [], []
         for r in chunk:
-            f = [float(v) for i, v in enumerate(r) if i != li]
+            nli = li % len(r)  # normalize negatives so the label column
+            f = [float(v) for i, v in enumerate(r) if i != nli]  # is
+            # excluded from features on both the row and matrix paths
             feats.append(f)
             if self.regression:
                 labels.append([float(r[li])])

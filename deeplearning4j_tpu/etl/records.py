@@ -67,15 +67,39 @@ class CSVRecordReader(RecordReader):
         self._rows: Optional[List[list]] = None
         self._pos = 0
 
+    def _raw_text(self) -> str:
+        if not hasattr(self, "_text_cache"):
+            if self.path is not None:
+                with open(self.path, newline="") as f:
+                    self._text_cache = f.read()
+            else:
+                self._text_cache = self.text
+        return self._text_cache
+
+    def matrix(self):
+        """All-numeric fast path: the whole file parsed to one
+        [rows, cols] float32 matrix via the native C parser (ref role:
+        the reference's off-heap CSV vectorization). None when any cell
+        is non-numeric — callers fall back to the row-wise reader, which
+        keeps exact _parse_cell int/double semantics. skip_lines drops
+        PHYSICAL lines here; a header whose quoted fields span lines
+        leaves a non-numeric residue, so such files fall back (where
+        record-wise skipping applies)."""
+        if not self.parse:
+            return None
+        if not hasattr(self, "_matrix"):
+            from ..runtime import csv_parse_floats
+            src = self._raw_text()
+            if self.skip_lines:
+                src = "\n".join(src.splitlines()[self.skip_lines:])
+            self._matrix = csv_parse_floats(src, self.delimiter)
+        return self._matrix
+
     def _load(self):
         if self._rows is not None:
             return
-        if self.path is not None:
-            with open(self.path, newline="") as f:
-                raw = list(csv.reader(f, delimiter=self.delimiter))
-        else:
-            raw = list(csv.reader(io.StringIO(self.text),
-                                  delimiter=self.delimiter))
+        raw = list(csv.reader(io.StringIO(self._raw_text()),
+                              delimiter=self.delimiter))
         raw = [r for r in raw[self.skip_lines:] if r]
         self._rows = [[_parse_cell(c) for c in r] if self.parse else r
                       for r in raw]

@@ -367,3 +367,61 @@ class TestAnalyzeLocal:
         from deeplearning4j_tpu.etl import analyze
         with pytest.raises(ValueError, match="width"):
             analyze(self._schema(), [[1.0, 2]])
+
+
+class TestCsvFastPath:
+    """The native all-numeric matrix fast path must be invisible:
+    identical results to the row-wise python reader, with exact
+    _parse_cell semantics preserved where rows are observed directly."""
+
+    def test_matrix_path_engages_on_numeric_csv(self):
+        from deeplearning4j_tpu.etl import CSVRecordReader
+        r = CSVRecordReader(text="1,2,0\n4,5,1\n7,8,0\n")
+        m = r.matrix()
+        assert m is not None and m.shape == (3, 3)
+
+    def test_matrix_path_declines_on_strings(self):
+        from deeplearning4j_tpu.etl import CSVRecordReader
+        assert CSVRecordReader(text="1,2,cat\n").matrix() is None
+
+    def test_row_reader_preserves_int_double_types(self):
+        from deeplearning4j_tpu.etl import CSVRecordReader
+        row = CSVRecordReader(text="16777217,0.1\n").next()
+        assert row == [16777217, 0.1]
+        assert isinstance(row[0], int)  # not float32-rounded
+
+    def test_batches_identical_on_both_paths(self):
+        from deeplearning4j_tpu.etl import CSVRecordReader
+        from deeplearning4j_tpu.etl.iterators import (
+            RecordReaderDataSetIterator)
+        text = "\n".join(f"{i},{i*0.5},{i%3}" for i in range(100)) + "\n"
+        fast = RecordReaderDataSetIterator(
+            CSVRecordReader(text=text), 16, label_index=2, num_classes=3)
+        slow = RecordReaderDataSetIterator(
+            CSVRecordReader(text=text, parse=False), 16, label_index=2,
+            num_classes=3)
+        while fast.has_next():
+            f1, l1 = fast.next()
+            f2, l2 = slow.next()
+            np.testing.assert_allclose(f1, f2, rtol=1e-6)
+            np.testing.assert_array_equal(l1, l2)
+        assert not slow.has_next()
+
+    def test_negative_label_index_parity(self):
+        from deeplearning4j_tpu.etl import CSVRecordReader
+        from deeplearning4j_tpu.etl.iterators import (
+            RecordReaderDataSetIterator)
+        for parse in (True, False):  # matrix path vs row path
+            it = RecordReaderDataSetIterator(
+                CSVRecordReader(text="1,2,0\n4,5,1\n", parse=parse), 8,
+                label_index=-1, num_classes=2)
+            f, l = it.next()
+            assert f.shape == (2, 2)   # label column excluded
+            np.testing.assert_array_equal(
+                f, np.asarray([[1, 2], [4, 5]], np.float32))
+            np.testing.assert_array_equal(np.argmax(l, -1), [0, 1])
+
+    def test_quoted_newline_header_skip_falls_back(self):
+        from deeplearning4j_tpu.etl import CSVRecordReader
+        r = CSVRecordReader(text='"h\npart2",h2\n1,2\n', skip_lines=1)
+        assert r.next() == [1, 2]

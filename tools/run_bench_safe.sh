@@ -1,0 +1,10 @@
+#!/bin/sh
+# Tunnel-safe bench launcher: the axon TPU tunnel is single-client and a
+# KILLED client wedges the far end for hours (see
+# .claude/skills/verify/SKILL.md). So the bench must never be run under
+# a timeout that SIGKILLs it mid-execution — this wrapper detaches it
+# with nohup and the caller polls bench_out.json instead.
+cd "$(dirname "$0")/.." || exit 1
+rm -f bench_out.json bench_err.log
+nohup python bench.py > bench_out.json 2> bench_err.log &
+echo "bench pid: $!"
