@@ -71,7 +71,10 @@ class RecordReaderDataSetIterator(DataSetIterator):
             chunk = self._matrix[self._pos:self._pos + self._batch]
             self._pos += len(chunk)
             if self.label_index is None:
-                return np.ascontiguousarray(chunk, np.float32), None
+                # copy, not a view: in-place mutation of a returned batch
+                # (normalization, augmentation) must not corrupt the
+                # cached matrix for later epochs
+                return np.array(chunk, np.float32, copy=True), None
             li = self.label_index % chunk.shape[1]  # negative idx parity
                                                     # with the row path
             feats = np.ascontiguousarray(

@@ -68,13 +68,13 @@ class CSVRecordReader(RecordReader):
         self._pos = 0
 
     def _raw_text(self) -> str:
-        if not hasattr(self, "_text_cache"):
-            if self.path is not None:
-                with open(self.path, newline="") as f:
-                    self._text_cache = f.read()
-            else:
-                self._text_cache = self.text
-        return self._text_cache
+        # no caching: matrix() and _load() each memoize their own parsed
+        # product and run at most once, so holding the raw text for the
+        # reader's lifetime would only triple steady-state memory
+        if self.path is not None:
+            with open(self.path, newline="") as f:
+                return f.read()
+        return self.text
 
     def matrix(self):
         """All-numeric fast path: the whole file parsed to one

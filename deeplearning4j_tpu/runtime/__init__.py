@@ -300,12 +300,24 @@ def npy_load(path: str) -> np.ndarray:
 # ---------------------------------------------------------------------------
 # CSV fast path
 # ---------------------------------------------------------------------------
+_CSV_NUMERIC_BYTES = frozenset(b"0123456789.+-eE \t\r\n")
+
+
 def csv_parse_floats(text: str, delimiter: str = ","
                      ) -> Optional[np.ndarray]:
     """Parse a numeric CSV blob to a [rows, cols] float32 array; None on
-    malformed input (caller falls back to the python reader)."""
+    malformed input (caller falls back to the python reader).
+
+    Gate: only plain decimal/scientific tokens are accepted — strtof
+    (native path) and python float() both take forms the row-wise
+    reader's _parse_cell rejects (hex '0x10', 'nan', 'inf', '1_0'), and
+    the fast path must never reinterpret a file the slow path would
+    treat as strings."""
     lib = _load()
     raw = text.encode()
+    if not _CSV_NUMERIC_BYTES.issuperset(raw.translate(
+            None, delimiter.encode())):
+        return None
     if lib is not None:
         cap = max(16, raw.count(delimiter.encode())
                   + raw.count(b"\n") + 2)

@@ -425,3 +425,24 @@ class TestCsvFastPath:
         from deeplearning4j_tpu.etl import CSVRecordReader
         r = CSVRecordReader(text='"h\npart2",h2\n1,2\n', skip_lines=1)
         assert r.next() == [1, 2]
+
+    def test_fast_path_rejects_nonstandard_numeric_tokens(self):
+        # forms strtof/float() accept but _parse_cell treats as strings
+        # must NOT take the fast path (environment-independent semantics)
+        from deeplearning4j_tpu.runtime import csv_parse_floats
+        for t in ("0x10,2\n", "nan,2\n", "inf,3\n", "1_0,2\n"):
+            assert csv_parse_floats(t) is None, t
+        assert csv_parse_floats("1e3,-2.5E-2\n") is not None
+
+    def test_batches_are_copies_not_views(self):
+        from deeplearning4j_tpu.etl import CSVRecordReader
+        from deeplearning4j_tpu.etl.iterators import (
+            RecordReaderDataSetIterator)
+        it = RecordReaderDataSetIterator(
+            CSVRecordReader(text="1,2\n3,4\n"), 2)
+        f, _ = it.next()
+        f[:] = 0.0      # in-place mutation (normalization etc.)
+        it.reset()
+        f2, _ = it.next()
+        np.testing.assert_array_equal(
+            f2, np.asarray([[1, 2], [3, 4]], np.float32))

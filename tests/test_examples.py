@@ -8,7 +8,8 @@ EX_DIR = os.path.join(os.path.dirname(__file__), "..", "examples")
 sys.path.insert(0, EX_DIR)
 
 _COVERED = {"lenet_mnist", "vae_anomaly", "bilstm_text_classification",
-            "data_parallel", "dqn_cartpole", "transfer_learning"}
+            "data_parallel", "dqn_cartpole", "transfer_learning",
+            "custom_samediff_layer", "csv_classifier_etl"}
 
 
 def test_every_example_has_a_test():
@@ -53,3 +54,15 @@ def test_transfer_learning():
     import transfer_learning
     acc = transfer_learning.main(quick=True)
     assert acc > 0.7
+
+
+def test_custom_samediff_layer():
+    import custom_samediff_layer
+    acc = custom_samediff_layer.main(quick=True)
+    assert acc > 0.7
+
+
+def test_csv_classifier_etl():
+    import csv_classifier_etl
+    acc = csv_classifier_etl.main(quick=True)
+    assert acc > 0.8
