@@ -108,7 +108,8 @@ def _map_layer(class_name: str, cfg: dict) -> Optional[object]:
     if class_name in ("GlobalMaxPooling2D", "GlobalAveragePooling2D",
                       "GlobalMaxPooling1D", "GlobalAveragePooling1D"):
         return GlobalPoolingLayer(
-            pooling="max" if "Max" in class_name else "avg", name=name)
+            pooling="max" if "Max" in class_name else "avg",
+            keep_dims=bool(cfg.get("keepdims", False)), name=name)
     if class_name == "BatchNormalization":
         return BatchNormalization(decay=cfg.get("momentum", 0.99),
                                   eps=cfg.get("epsilon", 1e-3), name=name)
@@ -231,6 +232,25 @@ def _map_layer(class_name: str, cfg: dict) -> Optional[object]:
         c = cfg.get("cropping", 0)
         c = (c, c) if isinstance(c, int) else tuple(c)
         return Cropping1D(cropping=c, name=name)
+    if class_name == "Reshape":
+        from ..nn.layers.misc import ReshapeLayer
+        return ReshapeLayer(target_shape=tuple(cfg["target_shape"]),
+                            name=name)
+    if class_name == "ReLU":
+        # keras.layers.ReLU(max_value, negative_slope, threshold) — the
+        # max_value=6 form is MobileNet's ReLU6
+        mv = cfg.get("max_value")
+        ns = float(cfg.get("negative_slope", 0.0) or 0.0)
+        th = float(cfg.get("threshold", 0.0) or 0.0)
+        if ns == 0.0 and th == 0.0 and mv is None:
+            return ActivationLayer(activation="relu", name=name)
+        if ns == 0.0 and th == 0.0 and float(mv) == 6.0:
+            return ActivationLayer(activation="relu6", name=name)
+        if mv is None and th == 0.0:
+            return ActivationLayer(
+                activation={"@class": "leakyrelu", "alpha": ns},
+                name=name)
+        raise ValueError(f"unsupported ReLU config {cfg!r}")
     if class_name == "LeakyReLU":
         alpha = cfg.get("negative_slope", cfg.get("alpha", 0.3))
         return ActivationLayer(

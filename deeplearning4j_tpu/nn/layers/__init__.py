@@ -599,36 +599,44 @@ class EmbeddingLayer(Layer):
 class GlobalPoolingLayer(Layer):
     """Pool over all spatial/time dims. Ref:
     `nn/conf/layers/GlobalPoolingLayer.java` (MAX/AVG/SUM/PNORM,
-    collapseDimensions)."""
+    collapseDimensions — `keep_dims=True` is collapseDimensions(false):
+    pooled dims stay as size-1 axes)."""
 
     kind = "globalpool"
 
-    def __init__(self, pooling: str = "avg", pnorm: int = 2, **kw):
+    def __init__(self, pooling: str = "avg", pnorm: int = 2,
+                 keep_dims: bool = False, **kw):
         kw.setdefault("activation", "identity")
         super().__init__(**kw)
         self.pooling = pooling
         self.pnorm = int(pnorm)
+        self.keep_dims = bool(keep_dims)
 
     def apply(self, params, x, state, train, rng):
         axes = tuple(range(1, x.ndim - 1))  # all but batch & channel
+        kd = self.keep_dims
         if self.pooling == "max":
-            z = jnp.max(x, axis=axes)
+            z = jnp.max(x, axis=axes, keepdims=kd)
         elif self.pooling == "avg":
-            z = jnp.mean(x, axis=axes)
+            z = jnp.mean(x, axis=axes, keepdims=kd)
         elif self.pooling == "sum":
-            z = jnp.sum(x, axis=axes)
+            z = jnp.sum(x, axis=axes, keepdims=kd)
         elif self.pooling == "pnorm":
             p = float(self.pnorm)
-            z = jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1.0 / p)
+            z = jnp.sum(jnp.abs(x) ** p, axis=axes,
+                        keepdims=kd) ** (1.0 / p)
         else:
             raise ValueError(self.pooling)
         return z, state
 
     def output_shape(self, input_shape):
+        if self.keep_dims:
+            return (1,) * (len(input_shape) - 1) + (input_shape[-1],)
         return (input_shape[-1],)
 
     def _extra_json(self):
-        return {"pooling": self.pooling, "pnorm": self.pnorm}
+        return {"pooling": self.pooling, "pnorm": self.pnorm,
+                "keep_dims": self.keep_dims}
 
 
 class LocalResponseNormalization(Layer):

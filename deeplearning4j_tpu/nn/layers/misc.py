@@ -18,6 +18,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ... import losses as L
@@ -108,6 +109,39 @@ class AutoEncoder(DenseLayer):
                  sparsity_target=self.sparsity_target,
                  loss=self.recon_loss.to_json())
         return d
+
+
+@register
+class ReshapeLayer(Layer):
+    """Static reshape of the per-example dims (batch preserved) — the
+    Keras `Reshape` role; the reference reaches the same effect with
+    preprocessors (`nn/conf/preprocessor/ReshapePreprocessor.java` in
+    keras-import). A -1 entry infers that dim."""
+
+    kind = "reshapelayer"
+
+    def __init__(self, target_shape=(), **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        self.target_shape = tuple(int(s) for s in target_shape)
+
+    def apply(self, params, x, state, train, rng):
+        return x.reshape((x.shape[0],) + self.target_shape), state
+
+    def output_shape(self, input_shape):
+        if -1 in self.target_shape:
+            known = -int(np.prod(self.target_shape))
+            total = int(np.prod(input_shape))
+            if total % known:
+                raise ValueError(
+                    f"cannot reshape {input_shape} ({total} elements) "
+                    f"into {self.target_shape}")
+            return tuple(total // known if s == -1 else s
+                         for s in self.target_shape)
+        return self.target_shape
+
+    def _extra_json(self):
+        return {"target_shape": list(self.target_shape)}
 
 
 @register

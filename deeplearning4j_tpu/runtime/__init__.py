@@ -308,11 +308,13 @@ def csv_parse_floats(text: str, delimiter: str = ","
     """Parse a numeric CSV blob to a [rows, cols] float32 array; None on
     malformed input (caller falls back to the python reader).
 
-    Gate: only plain decimal/scientific tokens are accepted — strtof
-    (native path) and python float() both take forms the row-wise
-    reader's _parse_cell rejects (hex '0x10', 'nan', 'inf', '1_0'), and
-    the fast path must never reinterpret a file the slow path would
-    treat as strings."""
+    Gate: only plain decimal/scientific tokens pass — on anything else
+    the parsers DISAGREE with each other or with _parse_cell ('0x10':
+    16.0 to strtof, string to _parse_cell; '1_0': int 10 to python,
+    junk to strtof; 'nan'/'inf': accepted by both engines but worth
+    keeping off the fast path so a file's path choice never depends on
+    which engine is installed). The gate makes the value semantics a
+    function of the FILE alone, not the environment."""
     lib = _load()
     raw = text.encode()
     if not _CSV_NUMERIC_BYTES.issuperset(raw.translate(

@@ -427,8 +427,10 @@ class TestCsvFastPath:
         assert r.next() == [1, 2]
 
     def test_fast_path_rejects_nonstandard_numeric_tokens(self):
-        # forms strtof/float() accept but _parse_cell treats as strings
-        # must NOT take the fast path (environment-independent semantics)
+        # non-plain numeric forms must NOT take the fast path: the two
+        # engines (strtof vs python float) disagree on them ('0x10',
+        # '1_0') or their path choice would depend on which engine is
+        # installed ('nan', 'inf') — file-determined semantics only
         from deeplearning4j_tpu.runtime import csv_parse_floats
         for t in ("0x10,2\n", "nan,2\n", "inf,3\n", "1_0,2\n"):
             assert csv_parse_floats(t) is None, t

@@ -157,3 +157,53 @@ class TestKerasExtendedLayers:
         assert tuple(cr.cropping) == (1, 1)
         up = _map_layer("UpSampling1D", {"name": "up", "size": 3})
         assert isinstance(up, Upsampling1D) and up.size == 3
+
+
+class TestKerasFullArchitectures:
+    """Whole keras.applications architectures (built locally with random
+    weights — no egress) must import with exact prediction parity: the
+    strongest D13 evidence available in-image. Ref:
+    KerasModelImport.java + the reference zoo's keras-trained models."""
+
+    @pytest.fixture(scope="class")
+    def keras_mod(self):
+        keras = pytest.importorskip("keras")
+        return keras
+
+    def _round_trip(self, model, x):
+        import tempfile
+        with tempfile.TemporaryDirectory() as td:
+            import os as _os
+            p = _os.path.join(td, "m.h5")
+            want = model.predict(x, verbose=0)
+            model.save(p)
+            from deeplearning4j_tpu.modelimport.keras import (
+                KerasModelImport)
+            net = KerasModelImport.import_keras_model_and_weights(p)
+            got = np.asarray(net.output(x))
+        return got, want
+
+    def test_mobilenet_v1_exact(self, keras_mod):
+        m = keras_mod.applications.MobileNet(
+            alpha=0.25, input_shape=(64, 64, 3), weights=None, classes=10)
+        x = np.random.RandomState(0).rand(2, 64, 64, 3).astype(np.float32)
+        got, want = self._round_trip(m, x)
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_mobilenet_v2_exact(self, keras_mod):
+        # inverted residuals + linear bottlenecks: functional graph with
+        # add vertices, ReLU6, keepdims pooling
+        m = keras_mod.applications.MobileNetV2(
+            alpha=0.35, input_shape=(64, 64, 3), weights=None, classes=7)
+        x = np.random.RandomState(1).rand(2, 64, 64, 3).astype(np.float32)
+        got, want = self._round_trip(m, x)
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_resnet50_near_exact(self, keras_mod):
+        # full functional ResNet50: bottleneck residual blocks, strided
+        # convs, BN everywhere (largest architecture in the suite)
+        m = keras_mod.applications.ResNet50(
+            input_shape=(64, 64, 3), weights=None, classes=7)
+        x = np.random.RandomState(2).rand(2, 64, 64, 3).astype(np.float32)
+        got, want = self._round_trip(m, x)
+        np.testing.assert_allclose(got, want, atol=1e-5)
