@@ -154,9 +154,11 @@ probs = sd.get_variable(out)
 lp = probs.clipbyvalue(1e-7, 1.0).log()
 loss = (labels * lp).reduce_sum(axes=(-1,)).reduce_mean().neg()
 sd.set_loss_variables(loss.name)
+DTYPE = sys.argv[1] if len(sys.argv) > 1 else "bfloat16"
 sd.set_training_config(TrainingConfig(
     updater=Adam(2e-5), data_set_feature_mapping=["ids", "mask"],
-    data_set_label_mapping=["labels"]))
+    data_set_label_mapping=["labels"],
+    compute_dtype=None if DTYPE == "float32" else DTYPE))
 sd.initialize_training()
 step = sd._train_step_fn()
 tnames = tuple(sd._trainable())
@@ -192,8 +194,8 @@ def run_step(i):
 from deeplearning4j_tpu.flags import flags as _flags
 N = _flags.bench_iters or 15
 dt, final_loss = timed_steps(run_step, 3, N)
-emit(f"BERT-base-s{SEQ} TF-import fine-tune (batch {BATCH}, float32)",
-     BATCH, N, dt, final_loss, flops, dtype="float32",
+emit(f"BERT-base-s{SEQ} TF-import fine-tune (batch {BATCH}, {DTYPE})",
+     BATCH, N, dt, final_loss, flops, dtype=DTYPE,
      synthetic_data=True)
 """
 
@@ -491,7 +493,7 @@ def main():
         f32 = _run(RESNET_CODE, {}, timeout=1500, argv=[32, "float32", 10])
         if f32:
             extras["resnet50_b32_f32"] = _sub(f32)
-        bert = _run(BERT_CODE, {}, timeout=1800, argv=["float32"])
+        bert = _run(BERT_CODE, {}, timeout=1800, argv=["bfloat16"])
         if bert:
             extras["bert_base_finetune"] = _sub(bert)
         lenet = _run(LENET_CODE, {}, timeout=900)

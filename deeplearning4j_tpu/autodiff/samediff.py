@@ -311,7 +311,8 @@ class TrainingConfig:
     def __init__(self, updater=None, l1: float = 0.0, l2: float = 0.0,
                  data_set_feature_mapping: Sequence[str] = (),
                  data_set_label_mapping: Sequence[str] = (),
-                 minimize: bool = True):
+                 minimize: bool = True,
+                 compute_dtype: Optional[str] = None):
         self.updater = learning.get(updater) if updater is not None \
             else learning.Adam(1e-3)
         self.l1 = float(l1)
@@ -319,13 +320,18 @@ class TrainingConfig:
         self.data_set_feature_mapping = list(data_set_feature_mapping)
         self.data_set_label_mapping = list(data_set_label_mapping)
         self.minimize = minimize
+        # mixed precision: forward/backward in this dtype (bf16 on the
+        # MXU), master params + updater state + loss stay f32 — the
+        # graph-autodiff analogue of MultiLayerConfiguration.data_type
+        self.compute_dtype = compute_dtype
 
     def to_json(self) -> dict:
         return {"updater": self.updater.to_json(), "l1": self.l1,
                 "l2": self.l2,
                 "dataSetFeatureMapping": self.data_set_feature_mapping,
                 "dataSetLabelMapping": self.data_set_label_mapping,
-                "minimize": self.minimize}
+                "minimize": self.minimize,
+                "computeDtype": self.compute_dtype}
 
     @staticmethod
     def from_json(d: dict) -> "TrainingConfig":
@@ -333,7 +339,8 @@ class TrainingConfig:
                               l1=d["l1"], l2=d["l2"],
                               data_set_feature_mapping=d["dataSetFeatureMapping"],
                               data_set_label_mapping=d["dataSetLabelMapping"],
-                              minimize=d.get("minimize", True))
+                              minimize=d.get("minimize", True),
+                              compute_dtype=d.get("computeDtype"))
 
     class Builder:
         def __init__(self):
@@ -350,6 +357,10 @@ class TrainingConfig:
             self._kw["data_set_label_mapping"] = list(names); return self
 
         def minimize(self, v=True): self._kw["minimize"] = v; return self
+
+        def compute_dtype(self, v):
+            self._kw["compute_dtype"] = v; return self
+
         def build(self): return TrainingConfig(**self._kw)
 
     @staticmethod
@@ -954,8 +965,32 @@ class SameDiff:
         updater = cfg.updater
         l1, l2 = cfg.l1, cfg.l2
 
+        # normalize through the shared policy: 'half'/'bf16'/'fp16' all
+        # mean bfloat16 on TPU (fp16-without-loss-scaling is never
+        # selected — see nn/precision.py)
+        from ..nn.precision import compute_dtype as _policy_dtype
+        cdt = _policy_dtype(cfg.compute_dtype)
+        label_names = frozenset(cfg.data_set_label_mapping)
+
+        def _cast(tree, skip=frozenset()):
+            return {k: (v if k in skip or not hasattr(v, "dtype")
+                        or v.dtype != jnp.float32 else v.astype(cdt))
+                    for k, v in tree.items()}
+
         def step(tvars, upd_state, step_no, feed, rng):
-            loss, grads = jax.value_and_grad(loss_fn)(tvars, feed, rng)
+            if cdt is not None:
+                # cast-through mixed precision: params enter f32 (so
+                # grads come back f32 — the master-weight pattern) and
+                # the traced graph computes in cdt. LABELS stay f32, so
+                # the ops that combine predictions with labels — the
+                # loss head — promote to f32 (the graph analogue of the
+                # network policy's cast_feats_to_f32-before-loss).
+                loss, grads = jax.value_and_grad(
+                    lambda tv: loss_fn(_cast(tv),
+                                       _cast(feed, skip=label_names),
+                                       rng).astype(jnp.float32))(tvars)
+            else:
+                loss, grads = jax.value_and_grad(loss_fn)(tvars, feed, rng)
             if not cfg.minimize:
                 grads = jax.tree_util.tree_map(lambda g: -g, grads)
             if l1 or l2:

@@ -407,3 +407,69 @@ class TestRandom:
         v3 = np.asarray(sd.output({}, ["r"],
                                   rng=jax.random.PRNGKey(7))["r"])
         assert np.abs(v - v3).max() > 0
+
+
+class TestMixedPrecision:
+    """TrainingConfig(compute_dtype='bfloat16'): forward/backward in
+    bf16, master params + updater state + reported loss f32 (the
+    graph-autodiff analogue of conf.data_type on networks)."""
+
+    def _fit(self, compute_dtype, epochs=150):
+        import jax
+        from deeplearning4j_tpu.autodiff.samediff import (SameDiff,
+                                                          TrainingConfig)
+        from deeplearning4j_tpu.learning import Adam
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (None, 6))
+        y = sd.placeholder("y", (None, 1))
+        w = sd.var("w", value=np.zeros((6, 1), np.float32))
+        b = sd.var("b", value=np.zeros((1,), np.float32))
+        pred = (x @ w) + b
+        loss = ((pred - y) * (pred - y)).reduce_mean()
+        sd.set_loss_variables(loss.name)
+        sd.set_training_config(TrainingConfig(
+            updater=Adam(0.03), data_set_feature_mapping=["x"],
+            data_set_label_mapping=["y"], compute_dtype=compute_dtype))
+        rs = np.random.RandomState(0)
+        X = rs.rand(64, 6).astype(np.float32)
+        true_w = np.asarray([[1.], [2.], [-1.], [.5], [0.], [3.]],
+                            np.float32)
+        Y = X @ true_w + 0.25
+        h = sd.fit([(X, Y)], epochs=epochs)
+        return sd, h
+
+    def test_bf16_trains_with_f32_master_params(self):
+        sd, h = self._fit("bfloat16")
+        assert h.loss_curve[-1] < h.loss_curve[0] * 0.05
+        w = sd.get_variable("w").get_arr()
+        assert str(np.asarray(w).dtype) == "float32"   # master stays f32
+        assert all(np.isfinite(h.loss_curve))
+
+    def test_bf16_tracks_f32_solution(self):
+        _, h32 = self._fit(None)
+        _, h16 = self._fit("bfloat16")
+        # same task, same steps: bf16 lands in the same loss basin
+        assert abs(h16.loss_curve[-1] - h32.loss_curve[-1]) < 0.05
+
+    def test_config_round_trips(self):
+        from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
+        c = TrainingConfig(compute_dtype="bfloat16")
+        c2 = TrainingConfig.from_json(c.to_json())
+        assert c2.compute_dtype == "bfloat16"
+
+    def test_dtype_names_normalize_and_labels_stay_f32(self):
+        # 'half'/'bf16' route through the shared precision policy (never
+        # raw fp16), and the loss head promotes to f32 because labels
+        # are exempt from the compute cast
+        from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
+        from deeplearning4j_tpu.nn.precision import compute_dtype as cd
+        import jax.numpy as jnp
+        for name in ("half", "bf16", "fp16", "bfloat16"):
+            assert cd(name) == jnp.bfloat16
+        _, h = self._fit("half")           # would NaN if raw fp16 + no
+        assert all(np.isfinite(h.loss_curve))  # loss scaling
+
+    def test_builder_sets_compute_dtype(self):
+        from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
+        c = (TrainingConfig.builder().compute_dtype("bfloat16").build())
+        assert c.compute_dtype == "bfloat16"
