@@ -323,6 +323,13 @@ class TrainingConfig:
         # mixed precision: forward/backward in this dtype (bf16 on the
         # MXU), master params + updater state + loss stay f32 — the
         # graph-autodiff analogue of MultiLayerConfiguration.data_type
+        if compute_dtype is not None:
+            from ..nn.precision import compute_dtype as _pol
+            if _pol(compute_dtype) is None:
+                raise ValueError(
+                    f"unknown compute_dtype {compute_dtype!r} — use "
+                    "'bfloat16' (or None for pure f32); a typo here "
+                    "must not silently disable mixed precision")
         self.compute_dtype = compute_dtype
 
     def to_json(self) -> dict:

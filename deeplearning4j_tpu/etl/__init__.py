@@ -23,8 +23,10 @@ from .iterators import (RecordReaderDataSetIterator,
                         SequenceRecordReaderDataSetIterator)
 from .normalize import (ImagePreProcessingScaler, NormalizerMinMaxScaler,
                         NormalizerStandardize)
+from .relational import Join, Reducer, convert_to_sequence
 
 __all__ = [
+    "Join", "Reducer", "convert_to_sequence",
     "Schema", "ColumnType", "RecordReader", "CSVRecordReader",
     "CSVSequenceRecordReader", "CollectionRecordReader", "LineRecordReader",
     "ImageRecordReader", "NumpyRecordReader", "TransformProcess",

@@ -1,0 +1,242 @@
+"""Relational ETL operations: Join, reduce-by-key, convert-to-sequence.
+
+Ref: `datavec-api/src/main/java/org/datavec/api/transform/join/Join.java`
+(Inner/LeftOuter/RightOuter/FullOuter on key columns),
+`.../transform/reduce/Reducer.java` (per-column ReduceOp aggregation
+grouped by key), and `TransformProcess.convertToSequence` +
+`.../transform/sequence/comparator/NumericalColumnComparator.java`
+(group records by key into time-sorted sequences).
+
+These run on the host (records are python lists, like the rest of the
+DataVec-role layer); the output feeds the same iterators/normalizers as
+any reader.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence
+
+from .schema import ColumnMetaData, ColumnType, Schema
+
+
+class Join:
+    """Schema-checked join of two record collections on key columns.
+
+    Ref: `transform/join/Join.java` — joinType Inner/LeftOuter/
+    RightOuter/FullOuter, keyColumns, and the joined schema = left
+    columns + right columns minus the (shared) keys."""
+
+    TYPES = ("inner", "left_outer", "right_outer", "full_outer")
+
+    def __init__(self, join_type: str, left_schema: Schema,
+                 right_schema: Schema, *key_columns: str):
+        jt = join_type.lower()
+        if jt not in Join.TYPES:
+            raise ValueError(f"join_type must be one of {Join.TYPES}, "
+                             f"got {join_type!r}")
+        if not key_columns:
+            raise ValueError("at least one key column required")
+        for k in key_columns:
+            left_schema.index_of(k)
+            right_schema.index_of(k)
+        self.join_type = jt
+        self.left_schema = left_schema
+        self.right_schema = right_schema
+        self.key_columns = list(key_columns)
+        # precomputed key positions (index_of is an O(cols) scan — keep
+        # it out of the per-record loops)
+        self._lkey = [left_schema.index_of(k) for k in key_columns]
+        self._rkey = [right_schema.index_of(k) for k in key_columns]
+        # fail at construction, like the reference's Join.setSchemas —
+        # execute() must not emit rows output_schema() would reject
+        self.output_schema()
+
+    def output_schema(self) -> Schema:
+        cols = list(self.left_schema.columns)
+        names = set(self.left_schema.column_names())
+        for c in self.right_schema.columns:
+            if c.name in self.key_columns:
+                continue
+            if c.name in names:
+                raise ValueError(
+                    f"non-key column {c.name!r} exists on both sides — "
+                    "rename before joining")
+            cols.append(c)
+        return Schema(cols)
+
+    def execute(self, left: Sequence[list],
+                right: Sequence[list]) -> List[list]:
+        r_idx: "OrderedDict[tuple, List[list]]" = OrderedDict()
+        for r in right:
+            r_idx.setdefault(tuple(r[i] for i in self._rkey),
+                             []).append(r)
+        r_keep = [i for i, c in enumerate(self.right_schema.columns)
+                  if c.name not in self.key_columns]
+        r_nulls = [None] * len(r_keep)
+        l_width = self.left_schema.num_columns()
+        key_pos_l = self._lkey
+        out: List[list] = []
+        matched_r = set()
+        for l in left:
+            key = tuple(l[i] for i in self._lkey)
+            matches = r_idx.get(key)
+            if matches:
+                matched_r.add(key)
+                for r in matches:
+                    out.append(list(l) + [r[i] for i in r_keep])
+            elif self.join_type in ("left_outer", "full_outer"):
+                out.append(list(l) + list(r_nulls))
+        if self.join_type in ("right_outer", "full_outer"):
+            for key, matches in r_idx.items():
+                if key in matched_r:
+                    continue
+                for r in matches:
+                    row: List = [None] * l_width
+                    for pos, k in zip(key_pos_l, key):
+                        row[pos] = k
+                    out.append(row + [r[i] for i in r_keep])
+        return out
+
+
+def _stdev(vs):
+    m = sum(vs) / len(vs)  # mean computed ONCE, not per element
+    return (sum((v - m) ** 2 for v in vs) / max(1, len(vs) - 1)) ** 0.5
+
+
+_REDUCE_OPS = {
+    "sum": lambda vs: sum(vs),
+    "mean": lambda vs: sum(vs) / len(vs),
+    "min": lambda vs: min(vs),
+    "max": lambda vs: max(vs),
+    "range": lambda vs: max(vs) - min(vs),
+    "count": lambda vs: len(vs),
+    "count_unique": lambda vs: len(set(vs)),
+    "first": lambda vs: vs[0],
+    "last": lambda vs: vs[-1],
+    "stdev": _stdev,
+}
+_NUMERIC_OUT = {"sum", "mean", "range", "stdev"}
+_INT_OUT = {"count", "count_unique"}
+
+
+class Reducer:
+    """Group records by key column(s) and aggregate every other column
+    with a per-column ReduceOp. Ref: `transform/reduce/Reducer.java`
+    (Builder: keyColumns + sumColumns/meanColumns/.../countColumns;
+    default op applies to unlisted columns)."""
+
+    def __init__(self, schema: Schema, key_columns: Sequence[str],
+                 ops: Dict[str, str], default_op: str = "first"):
+        for k in key_columns:
+            schema.index_of(k)
+        for col, op in ops.items():
+            schema.index_of(col)
+            if op not in _REDUCE_OPS:
+                raise ValueError(f"unknown reduce op {op!r} for {col!r}; "
+                                 f"have {sorted(_REDUCE_OPS)}")
+        if default_op not in _REDUCE_OPS:
+            raise ValueError(f"unknown default op {default_op!r}")
+        self.schema = schema
+        self.key_columns = list(key_columns)
+        self.ops = dict(ops)
+        self.default_op = default_op
+
+    class Builder:
+        def __init__(self, schema: Schema):
+            self._schema = schema
+            self._keys: List[str] = []
+            self._ops: Dict[str, str] = {}
+            self._default = "first"
+
+        def key_columns(self, *names):
+            self._keys = list(names); return self
+
+        def default_op(self, op):
+            self._default = op; return self
+
+        def __getattr__(self, name):
+            # sum_columns / mean_columns / ... builder parity
+            if name.endswith("_columns") and \
+                    name[:-len("_columns")] in _REDUCE_OPS:
+                op = name[:-len("_columns")]
+
+                def setter(*cols):
+                    for c in cols:
+                        self._ops[c] = op
+                    return self
+                return setter
+            raise AttributeError(name)
+
+        def build(self) -> "Reducer":
+            return Reducer(self._schema, self._keys, self._ops,
+                           self._default)
+
+    @staticmethod
+    def builder(schema: Schema) -> "Reducer.Builder":
+        return Reducer.Builder(schema)
+
+    def output_schema(self) -> Schema:
+        cols = []
+        for c in self.schema.columns:
+            if c.name in self.key_columns:
+                cols.append(c)
+                continue
+            op = self.ops.get(c.name, self.default_op)
+            if op in _INT_OUT:
+                cols.append(ColumnMetaData(f"{op}({c.name})",
+                                           ColumnType.LONG))
+            elif op in _NUMERIC_OUT:
+                cols.append(ColumnMetaData(f"{op}({c.name})",
+                                           ColumnType.DOUBLE))
+            else:
+                cols.append(ColumnMetaData(f"{op}({c.name})", c.type,
+                                           dict(c.state)))
+        return Schema(cols)
+
+    def execute(self, records: Sequence[list]) -> List[list]:
+        key_pos = [self.schema.index_of(k) for k in self.key_columns]
+        # per-column plan resolved once: either ("key", position-in-key)
+        # or ("agg", reduce-fn) — no name scans inside the group loop
+        plan = []
+        for i, c in enumerate(self.schema.columns):
+            if c.name in self.key_columns:
+                plan.append(("key", self.key_columns.index(c.name)))
+            else:
+                plan.append(
+                    ("agg", _REDUCE_OPS[self.ops.get(c.name,
+                                                     self.default_op)]))
+        groups: "OrderedDict[tuple, List[list]]" = OrderedDict()
+        for r in records:
+            groups.setdefault(tuple(r[i] for i in key_pos),
+                              []).append(r)
+        out = []
+        for key, rows in groups.items():
+            agg = []
+            for i, (kind, v) in enumerate(plan):
+                if kind == "key":
+                    agg.append(key[v])
+                else:
+                    agg.append(v([r[i] for r in rows]))
+            out.append(agg)
+        return out
+
+
+def convert_to_sequence(records: Sequence[list], schema: Schema,
+                        key_column: str,
+                        sort_column: Optional[str] = None
+                        ) -> List[List[list]]:
+    """Group flat records into per-key sequences, each sorted by
+    `sort_column` (ascending; stable input order when None). Ref:
+    `TransformProcess.convertToSequence(keyColumn, comparator)` with
+    NumericalColumnComparator semantics."""
+    ki = schema.index_of(key_column)
+    si = None if sort_column is None else schema.index_of(sort_column)
+    groups: "OrderedDict[object, List[list]]" = OrderedDict()
+    for r in records:
+        groups.setdefault(r[ki], []).append(list(r))
+    out = []
+    for _, rows in groups.items():
+        if si is not None:
+            rows = sorted(rows, key=lambda r: r[si])
+        out.append(rows)
+    return out

@@ -448,3 +448,79 @@ class TestCsvFastPath:
         f2, _ = it.next()
         np.testing.assert_array_equal(
             f2, np.asarray([[1, 2], [3, 4]], np.float32))
+
+
+class TestRelational:
+    """Join / reduce-by-key / convert-to-sequence (ref:
+    transform/join/Join.java, transform/reduce/Reducer.java,
+    TransformProcess.convertToSequence)."""
+
+    def _schemas(self):
+        from deeplearning4j_tpu.etl import Schema
+        people = (Schema.builder().add_column_integer("id")
+                  .add_column_string("name").build())
+        purchases = (Schema.builder().add_column_integer("id")
+                     .add_column_double("amount").build())
+        return people, purchases
+
+    def test_inner_join(self):
+        from deeplearning4j_tpu.etl import Join
+        people, purchases = self._schemas()
+        j = Join("inner", people, purchases, "id")
+        out = j.execute([[1, "ann"], [2, "bob"], [3, "cy"]],
+                        [[1, 9.5], [1, 1.5], [3, 4.0], [7, 2.0]])
+        assert out == [[1, "ann", 9.5], [1, "ann", 1.5], [3, "cy", 4.0]]
+        assert j.output_schema().column_names() == ["id", "name",
+                                                    "amount"]
+
+    def test_outer_joins(self):
+        from deeplearning4j_tpu.etl import Join
+        people, purchases = self._schemas()
+        left = [[1, "ann"], [2, "bob"]]
+        right = [[1, 9.5], [7, 2.0]]
+        lo = Join("left_outer", people, purchases, "id").execute(left, right)
+        assert [1, "ann", 9.5] in lo and [2, "bob", None] in lo
+        ro = Join("right_outer", people, purchases, "id").execute(left, right)
+        assert [1, "ann", 9.5] in ro and [7, None, 2.0] in ro
+        fo = Join("full_outer", people, purchases, "id").execute(left, right)
+        assert len(fo) == 3
+
+    def test_join_rejects_colliding_columns(self):
+        from deeplearning4j_tpu.etl import Join, Schema
+        a = (Schema.builder().add_column_integer("id")
+             .add_column_double("v").build())
+        b = (Schema.builder().add_column_integer("id")
+             .add_column_double("v").build())
+        with pytest.raises(ValueError, match="both sides"):
+            Join("inner", a, b, "id").output_schema()
+
+    def test_reducer_by_key(self):
+        from deeplearning4j_tpu.etl import Reducer, Schema
+        schema = (Schema.builder().add_column_string("user")
+                  .add_column_double("amount")
+                  .add_column_integer("qty").build())
+        red = (Reducer.builder(schema).key_columns("user")
+               .sum_columns("amount").count_columns("qty").build())
+        out = red.execute([["a", 2.0, 1], ["b", 5.0, 2], ["a", 3.0, 9]])
+        assert out == [["a", 5.0, 2], ["b", 5.0, 1]]
+        names = red.output_schema().column_names()
+        assert names == ["user", "sum(amount)", "count(qty)"]
+
+    def test_reducer_stats_ops(self):
+        from deeplearning4j_tpu.etl import Reducer, Schema
+        schema = (Schema.builder().add_column_string("k")
+                  .add_column_double("v").build())
+        red = (Reducer.builder(schema).key_columns("k")
+               .stdev_columns("v").build())
+        out = red.execute([["a", 1.0], ["a", 3.0]])
+        assert out[0][1] == pytest.approx(np.std([1.0, 3.0], ddof=1))
+
+    def test_convert_to_sequence(self):
+        from deeplearning4j_tpu.etl import Schema, convert_to_sequence
+        schema = (Schema.builder().add_column_integer("dev")
+                  .add_column_integer("t")
+                  .add_column_double("v").build())
+        recs = [[1, 2, 0.2], [2, 1, 9.1], [1, 1, 0.1], [2, 2, 9.2]]
+        seqs = convert_to_sequence(recs, schema, "dev", sort_column="t")
+        assert seqs == [[[1, 1, 0.1], [1, 2, 0.2]],
+                        [[2, 1, 9.1], [2, 2, 9.2]]]
