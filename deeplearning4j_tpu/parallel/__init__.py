@@ -83,19 +83,27 @@ class GradientSharingAccumulator:
     (ref: AdaptiveThresholdAlgorithm), carried as jitted state so no
     retrace occurs.
 
-    State (residuals, current threshold, last sparsity) lives on device
-    between steps; `residuals` is sharded over the data axis — each
-    worker keeps its OWN residual, exactly like the reference.
+    Like the reference, quantization is in the UPDATE domain: each
+    worker runs its OWN updater on its local gradients first, then
+    encodes the resulting update (`StochasticGradientDescent.java:52-93`
+    — the updater runs before the accumulator). This ordering is load-
+    bearing for stateful updaters: Adam fed quantized gradients
+    normalizes every sparse sign*threshold firing into a full-size step
+    (noisy signSGD) and limit-cycles near convergence; quantizing the
+    updater's OUTPUT preserves its scaling.
 
-    Documented divergence: the reference quantizes the post-updater
-    UPDATE and lets worker replicas drift (async, per-worker updater
-    state — `EncodingHandler.java:51`); here the quantization runs on
-    the pre-updater GRADIENT (error feedback a la Deep Gradient
-    Compression) so the updater consumes one identical psum'd tensor
-    everywhere and params/updater state stay exactly replicated — the
-    invariant SPMD needs. For SGD the two differ only by lr-scaling of
-    the threshold; for stateful updaters this variant is the one with
-    a convergence guarantee."""
+    State (per-worker residuals, per-worker updater state `opt_state`,
+    current threshold, last sparsity) lives on device between steps,
+    sharded over the data axis — each worker keeps its own residual and
+    updater moments, exactly like the reference's workers. Params remain
+    replicated: every worker applies the same psum-averaged decoded
+    update.
+
+    Documented divergence from the reference: transport is the compiled
+    synchronous ICI collective instead of async Aeron UDP (no staleness),
+    and worker updater states drift only through seeing local gradients
+    (they are re-synced into the model's checkpointable opt_state from
+    worker 0 after each fit — see ParallelWrapper.fit)."""
 
     def __init__(self, threshold: float = 1e-3, adaptive: bool = True,
                  min_sparsity: float = 1e-4, max_sparsity: float = 1e-2,
@@ -109,6 +117,8 @@ class GradientSharingAccumulator:
         self.residuals = None
         self.threshold = None
         self.last_sparsity = None
+        self.opt_state = None  # per-worker updater state (update-domain
+        # quantization runs the updater BEFORE encoding, per worker)
 
 
 class ParallelWrapper:
@@ -161,12 +171,24 @@ class ParallelWrapper:
         )
 
     def _build_compressed_step(self):
-        """Compile the gradient-sharing step: per-worker local grads ->
-        (+ residual) -> threshold quantize -> psum(decoded)/n -> updater.
+        """Compile the gradient-sharing step with the reference's
+        UPDATE-domain pipeline (`StochasticGradientDescent.java:52-93`):
+        per-worker local grads -> LOCAL updater (per-worker state) ->
+        update -> (+ residual) -> threshold quantize -> pmean(decoded)
+        -> apply to params. Quantizing post-updater matters: an adaptive
+        updater fed quantized gradients normalizes every sparse
+        sign*threshold firing into a full-size step (noisy signSGD) and
+        limit-cycles; quantizing the updater's OUTPUT keeps Adam's own
+        scaling intact, exactly as the reference encodes updates, not
+        gradients.
+
         Returns a callable with the SAME signature as the dense step
         (params, opt, net, step, x, y, mask, rng) -> (params, opt, net,
-        loss); accumulator state (residuals/threshold) is threaded
-        through `self.accumulator` between calls."""
+        loss). Accumulator state (residuals/threshold/per-worker updater
+        state) is threaded through `self.accumulator` between calls; the
+        model's own opt_state is left untouched while compressed
+        training is active (the reference likewise keeps per-worker
+        updater state inside the workers)."""
         from functools import partial
         from .compression import adapt_threshold, strom_encode_decode
         m = self.model
@@ -179,9 +201,10 @@ class ParallelWrapper:
         max_norm = m.conf.max_grad_norm
         clip_value = m.conf.grad_clip_value
 
-        # per-worker residual state: one leading device axis, sharded
-        # over "data" (each worker owns its residual — ref:
-        # EncodingHandler per-worker residual carry)
+        # per-worker state: one leading device axis, sharded over "data"
+        # (each worker owns its residual AND its updater state — ref:
+        # EncodingHandler per-worker residual carry; the reference's
+        # workers likewise run their own updaters before encoding)
         if acc.residuals is None:
             zeros = jax.tree_util.tree_map(
                 lambda p: jnp.zeros((ndev,) + p.shape, p.dtype), m._params)
@@ -189,19 +212,34 @@ class ParallelWrapper:
                 zeros, NamedSharding(mesh, P("data")))
             acc.threshold = jnp.asarray(acc.initial_threshold, jnp.float32)
             acc.last_sparsity = jnp.asarray(0.0, jnp.float32)
+            acc.opt_state = jax.device_put(
+                jax.tree_util.tree_map(
+                    lambda s: jnp.broadcast_to(s, (ndev,) + s.shape),
+                    m._opt_state),
+                NamedSharding(mesh, P("data")))
 
         def worker_step(params, opt_state, net_state, residual, threshold,
                         step, x, y, mask, rng):
             # local block: x/y are this worker's batch shard; residual
-            # leaves carry a leading length-1 device axis
+            # and opt_state leaves carry a leading length-1 device axis
             (loss, (new_net_state, _)), grads = jax.value_and_grad(
                 lambda p: m._loss_fn(p, net_state, x, y, mask, True, rng),
                 has_aux=True)(params)
             grads = _clip_grads(grads, max_norm, clip_value)
-            flat_g, treedef = jax.tree_util.tree_flatten(grads)
+            # LOCAL updater first (update-domain quantization)
+            local_opt = jax.tree_util.tree_map(lambda a: a[0], opt_state)
+            new_opt, updates = {}, {}
+            for i, key in enumerate(layer_keys):
+                if key not in params:
+                    continue
+                st, upd = updaters[i].apply(local_opt[key], grads[key],
+                                            step)
+                new_opt[key] = st
+                updates[key] = upd
+            flat_u, treedef = jax.tree_util.tree_flatten(updates)
             flat_r = treedef.flatten_up_to(residual)
-            enc = [strom_encode_decode(g, r[0], threshold)
-                   for g, r in zip(flat_g, flat_r)]
+            enc = [strom_encode_decode(u, r[0], threshold)
+                   for u, r in zip(flat_u, flat_r)]
             decoded = treedef.unflatten([d for d, _ in enc])
             new_residual = treedef.unflatten([r[None] for _, r in enc])
             # measured sparsity (fraction of fired entries), mesh-wide
@@ -211,27 +249,25 @@ class ParallelWrapper:
             new_threshold = adapt_threshold(
                 threshold, sparsity, acc.min_sparsity, acc.max_sparsity,
                 acc.adapt_factor) if acc.adaptive else threshold
-            # the "bus": average the decoded updates over the data axis
+            # the "bus": average the decoded UPDATES over the data axis
             shared = lax.pmean(decoded, "data")
             loss = lax.pmean(loss, "data")
             # BN running stats etc. are updated from LOCAL shards here
             # (unlike the dense path's global-batch jit); average them so
             # every worker carries identical state
             new_net_state = lax.pmean(new_net_state, "data")
-            new_opt, new_params = {}, {}
+            new_params = {}
             for i, key in enumerate(layer_keys):
                 if key not in params:
                     continue
-                st, upd = updaters[i].apply(opt_state[key], shared[key],
-                                            step)
-                new_opt[key] = st
                 new_p = jax.tree_util.tree_map(lambda a, u: a - u,
-                                               params[key], upd)
+                                               params[key], shared[key])
                 if layers[i].constraints:
                     from ..nn.conf.constraint import apply_constraints
                     new_p = apply_constraints(layers[i].constraints, new_p,
                                               layers[i].bias_param_names())
                 new_params[key] = new_p
+            new_opt = jax.tree_util.tree_map(lambda a: a[None], new_opt)
             return (new_params, new_opt, new_net_state, new_residual,
                     new_threshold, sparsity, loss)
 
@@ -240,18 +276,21 @@ class ParallelWrapper:
         sharded = jax.jit(
             jax.shard_map(
                 worker_step, mesh=mesh,
-                in_specs=(repl, repl, repl, data, repl, repl, data, data,
+                in_specs=(repl, data, repl, data, repl, repl, data, data,
                           data, repl),
-                out_specs=(repl, repl, repl, data, repl, repl, repl),
+                out_specs=(repl, data, repl, data, repl, repl, repl),
                 check_vma=False),
             donate_argnums=(0, 1, 2, 3))
 
         def step_like(params, opt_state, net_state, step, x, y, mask, rng):
-            (new_params, new_opt, new_net, acc.residuals, acc.threshold,
-             acc.last_sparsity, loss) = sharded(
-                params, opt_state, net_state, acc.residuals, acc.threshold,
-                step, x, y, mask, rng)
-            return new_params, new_opt, new_net, loss
+            # per-worker updater state lives in the accumulator; the
+            # model's own (replicated) opt_state is passed through
+            # untouched so dense-path checkpoints stay valid
+            (new_params, acc.opt_state, new_net, acc.residuals,
+             acc.threshold, acc.last_sparsity, loss) = sharded(
+                params, acc.opt_state, net_state, acc.residuals,
+                acc.threshold, step, x, y, mask, rng)
+            return new_params, opt_state, new_net, loss
 
         return step_like
 
@@ -277,6 +316,14 @@ class ParallelWrapper:
                 m.fit(iterator, epochs=epochs)
         finally:
             m._jit_step = prev_step
+            if self.accumulator is not None and \
+                    self.accumulator.opt_state is not None:
+                # sync worker 0's live updater moments back into the
+                # model's checkpointable opt_state — otherwise a
+                # preemption checkpoint would pair advanced params/_step
+                # with init-valued Adam moments and spike on resume
+                m._opt_state = jax.tree_util.tree_map(
+                    lambda a: a[0], self.accumulator.opt_state)
         return m
 
 

@@ -243,6 +243,24 @@ def _system_info() -> dict:
         import jax
         info["jax"] = jax.__version__
         info["devices"] = [str(d) for d in jax.devices()]
+        # per-device HBM stats where the PJRT backend exposes them (the
+        # reference system tab's off-heap/device memory columns)
+        mem = {}
+        for d in jax.local_devices():
+            try:
+                s = d.memory_stats()
+            except Exception:
+                s = None
+            if s:
+                mem[str(d)] = {
+                    "bytes_in_use_mb": round(
+                        s.get("bytes_in_use", 0) / 1e6, 1),
+                    "peak_bytes_in_use_mb": round(
+                        s.get("peak_bytes_in_use", 0) / 1e6, 1),
+                    "bytes_limit_mb": round(
+                        s.get("bytes_limit", 0) / 1e6, 1)}
+        if mem:
+            info["device_memory"] = mem
     except Exception as e:
         info["jax"] = f"unavailable: {type(e).__name__}"
     return info
