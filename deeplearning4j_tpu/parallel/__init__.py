@@ -102,8 +102,9 @@ class GradientSharingAccumulator:
     Documented divergence from the reference: transport is the compiled
     synchronous ICI collective instead of async Aeron UDP (no staleness),
     and worker updater states drift only through seeing local gradients
-    (they are re-synced into the model's checkpointable opt_state from
-    worker 0 after each fit — see ParallelWrapper.fit)."""
+    (worker 0's live moments are mirrored into the model's
+    checkpointable opt_state EVERY step, so mid-fit preemption
+    checkpoints resume correctly)."""
 
     def __init__(self, threshold: float = 1e-3, adaptive: bool = True,
                  min_sparsity: float = 1e-4, max_sparsity: float = 1e-2,
@@ -189,7 +190,6 @@ class ParallelWrapper:
         model's own opt_state is left untouched while compressed
         training is active (the reference likewise keeps per-worker
         updater state inside the workers)."""
-        from functools import partial
         from .compression import adapt_threshold, strom_encode_decode
         m = self.model
         acc = self.accumulator
@@ -284,13 +284,18 @@ class ParallelWrapper:
 
         def step_like(params, opt_state, net_state, step, x, y, mask, rng):
             # per-worker updater state lives in the accumulator; the
-            # model's own (replicated) opt_state is passed through
-            # untouched so dense-path checkpoints stay valid
+            # model's checkpointable opt_state is refreshed EVERY step
+            # from worker 0's live moments (cheap device slices) so a
+            # preemption checkpoint taken mid-fit — PreemptionHandler
+            # fires between steps, before fit() returns — never pairs
+            # advanced params/_step with stale Adam moments
             (new_params, acc.opt_state, new_net, acc.residuals,
              acc.threshold, acc.last_sparsity, loss) = sharded(
                 params, acc.opt_state, net_state, acc.residuals,
                 acc.threshold, step, x, y, mask, rng)
-            return new_params, opt_state, new_net, loss
+            ckpt_opt = jax.tree_util.tree_map(lambda a: a[0],
+                                              acc.opt_state)
+            return new_params, ckpt_opt, new_net, loss
 
         return step_like
 
@@ -316,14 +321,6 @@ class ParallelWrapper:
                 m.fit(iterator, epochs=epochs)
         finally:
             m._jit_step = prev_step
-            if self.accumulator is not None and \
-                    self.accumulator.opt_state is not None:
-                # sync worker 0's live updater moments back into the
-                # model's checkpointable opt_state — otherwise a
-                # preemption checkpoint would pair advanced params/_step
-                # with init-valued Adam moments and spike on resume
-                m._opt_state = jax.tree_util.tree_map(
-                    lambda a: a[0], self.accumulator.opt_state)
         return m
 
 

@@ -9,7 +9,8 @@ sys.path.insert(0, EX_DIR)
 
 _COVERED = {"lenet_mnist", "vae_anomaly", "bilstm_text_classification",
             "data_parallel", "dqn_cartpole", "transfer_learning",
-            "custom_samediff_layer", "csv_classifier_etl"}
+            "custom_samediff_layer", "csv_classifier_etl",
+            "distributed_transformer_4d"}
 
 
 def test_every_example_has_a_test():
@@ -66,3 +67,9 @@ def test_csv_classifier_etl():
     import csv_classifier_etl
     acc = csv_classifier_etl.main(quick=True)
     assert acc > 0.8
+
+
+def test_distributed_transformer_4d():
+    import distributed_transformer_4d
+    drop = distributed_transformer_4d.main(quick=True)
+    assert drop > 0.1   # quick mode: loss moves on the 4D mesh

@@ -202,7 +202,6 @@ class TestUpdateDomainQuantization:
                 .input_type_feed_forward(4).build())
         x, y = _data(n=128)
         model = MultiLayerNetwork(conf).init()
-        import copy
         init_leaves = [np.asarray(l) for l in
                        jax.tree_util.tree_leaves(model._opt_state)]
         pw = ParallelWrapper(model,
