@@ -23,10 +23,12 @@ from .iterators import (RecordReaderDataSetIterator,
                         SequenceRecordReaderDataSetIterator)
 from .normalize import (ImagePreProcessingScaler, NormalizerMinMaxScaler,
                         NormalizerStandardize)
-from .relational import Join, Reducer, convert_to_sequence
+from .relational import (Join, Reducer, convert_to_sequence,
+                         sequence_moving_window, sequence_offset)
 
 __all__ = [
-    "Join", "Reducer", "convert_to_sequence",
+    "Join", "Reducer", "convert_to_sequence", "sequence_offset",
+    "sequence_moving_window",
     "Schema", "ColumnType", "RecordReader", "CSVRecordReader",
     "CSVSequenceRecordReader", "CollectionRecordReader", "LineRecordReader",
     "ImageRecordReader", "NumpyRecordReader", "TransformProcess",

@@ -240,3 +240,44 @@ def convert_to_sequence(records: Sequence[list], schema: Schema,
             rows = sorted(rows, key=lambda r: r[si])
         out.append(rows)
     return out
+
+
+def sequence_offset(sequences: Sequence[List[list]], schema: Schema,
+                    columns: Sequence[str], offset: int
+                    ) -> List[List[list]]:
+    """Shift the named columns by `offset` steps within each sequence,
+    trimming steps whose shifted values fall outside (ref:
+    `transform/sequence/SequenceOffsetTransform.java`, InBuilt trim
+    mode). A positive offset pairs step t's other columns with the named
+    columns' values from step t-offset (past values)."""
+    idx = [schema.index_of(c) for c in columns]
+    out = []
+    for seq in sequences:
+        n = len(seq)
+        if n <= abs(offset):
+            continue
+        rows = []
+        rng = range(offset, n) if offset >= 0 else range(0, n + offset)
+        for t in rng:
+            row = list(seq[t])
+            for i in idx:
+                row[i] = seq[t - offset][i]
+            rows.append(row)
+        out.append(rows)
+    return out
+
+
+def sequence_moving_window(sequences: Sequence[List[list]],
+                           window: int, step: int = 1
+                           ) -> List[List[list]]:
+    """Split each sequence into overlapping windows of `window` steps
+    taken every `step` steps (ref:
+    `transform/sequence/window/OverlappingTimeWindowFunction.java`
+    role, count-based). Sequences shorter than the window are dropped."""
+    if window < 1 or step < 1:
+        raise ValueError("window and step must be >= 1")
+    out = []
+    for seq in sequences:
+        for start in range(0, len(seq) - window + 1, step):
+            out.append([list(r) for r in seq[start:start + window]])
+    return out

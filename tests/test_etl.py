@@ -524,3 +524,26 @@ class TestRelational:
         seqs = convert_to_sequence(recs, schema, "dev", sort_column="t")
         assert seqs == [[[1, 1, 0.1], [1, 2, 0.2]],
                         [[2, 1, 9.1], [2, 2, 9.2]]]
+
+    def test_sequence_offset(self):
+        from deeplearning4j_tpu.etl import Schema
+        from deeplearning4j_tpu.etl.relational import sequence_offset
+        schema = (Schema.builder().add_column_integer("t")
+                  .add_column_double("v").build())
+        seqs = [[[0, 10.0], [1, 11.0], [2, 12.0], [3, 13.0]]]
+        out = sequence_offset(seqs, schema, ["v"], 1)
+        # step t carries v from t-1; first step trimmed
+        assert out == [[[1, 10.0], [2, 11.0], [3, 12.0]]]
+        short = sequence_offset([[[0, 1.0]]], schema, ["v"], 1)
+        assert short == []
+
+    def test_sequence_moving_window(self):
+        from deeplearning4j_tpu.etl.relational import (
+            sequence_moving_window)
+        seq = [[i] for i in range(5)]
+        wins = sequence_moving_window([seq], window=3, step=1)
+        assert wins == [[[0], [1], [2]], [[1], [2], [3]],
+                        [[2], [3], [4]]]
+        assert sequence_moving_window([seq], window=3, step=2) == \
+            [[[0], [1], [2]], [[2], [3], [4]]]
+        assert sequence_moving_window([[[1]]], window=2) == []
