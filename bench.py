@@ -103,9 +103,12 @@ step = model._make_step()
 rng = jax.random.PRNGKey(0)
 state = [model._params, model._opt_state, model._net_state]
 flops = None
+compile_s = None
 try:
+    _t0 = time.perf_counter()
     compiled = step.lower(state[0], state[1], state[2], jnp.asarray(0),
                           inputs, labels, masks, rng).compile()
+    compile_s = round(time.perf_counter() - _t0, 1)
     cost = compiled.cost_analysis()
     c = cost[0] if isinstance(cost, (list, tuple)) else cost
     if c:
@@ -122,7 +125,8 @@ def run_step(i):
 
 dt, final_loss = timed_steps(run_step, 3, N)
 emit(f"ResNet50-224 train (batch {BATCH}, {DTYPE})", BATCH, N, dt,
-     final_loss, flops, dtype=DTYPE, synthetic_data=True)
+     final_loss, flops, dtype=DTYPE, synthetic_data=True,
+     compile_seconds=compile_s)
 """
 
 BERT_CODE = _COMMON + r"""
@@ -175,8 +179,11 @@ feed["labels"] = jnp.asarray(
 rng = jax.random.PRNGKey(0)
 state = [tvars, sd._updater_state]
 flops = None
+compile_s = None
 try:
+    _t0 = time.perf_counter()
     compiled = step.lower(state[0], state[1], 0, feed, rng).compile()
+    compile_s = round(time.perf_counter() - _t0, 1)
     cost = compiled.cost_analysis()
     c = cost[0] if isinstance(cost, (list, tuple)) else cost
     if c:
@@ -196,7 +203,7 @@ N = _flags.bench_iters or 15
 dt, final_loss = timed_steps(run_step, 3, N)
 emit(f"BERT-base-s{SEQ} TF-import fine-tune (batch {BATCH}, {DTYPE})",
      BATCH, N, dt, final_loss, flops, dtype=DTYPE,
-     synthetic_data=True)
+     synthetic_data=True, compile_seconds=compile_s)
 """
 
 LENET_CODE = _COMMON + r"""
@@ -400,7 +407,8 @@ def _sub(res):
            "flops_per_step": res.get("flops_per_step"),
            "final_loss": res.get("final_loss"),
            "mfu": _mfu(res)}
-    for k in ("test_accuracy", "synthetic_data", "dtype"):
+    for k in ("test_accuracy", "synthetic_data", "dtype",
+              "compile_seconds"):
         if k in res:
             out[k] = res[k]
     return out
@@ -550,7 +558,8 @@ def main():
         "tpu_alive": tpu_alive,
         "extra": extras,
     }
-    for k in ("test_accuracy", "synthetic_data", "dtype"):
+    for k in ("test_accuracy", "synthetic_data", "dtype",
+              "compile_seconds"):
         if k in res:
             out[k] = res[k]
     if violations:
