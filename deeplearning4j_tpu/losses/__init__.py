@@ -347,7 +347,8 @@ for _cls in list(globals().values()):
     if isinstance(_cls, type) and issubclass(_cls, LossFunction) and _cls is not LossFunction:
         _REGISTRY[_cls.name] = _cls
 
-# Reference `LossFunctions.LossFunction` enum aliases
+# Reference `LossFunctions.LossFunction` enum aliases + the Keras loss
+# identifiers the h5 training_config stores (ref: KerasLossUtils)
 _ALIASES = {
     "squared_loss": "l2",
     "reconstruction_crossentropy": "binaryxent",
@@ -356,6 +357,12 @@ _ALIASES = {
     "mean_squared_logarithmic_error": "msle",
     "mean_absolute_percentage_error": "mape",
     "kl_divergence": "kld",
+    "mean_squared_error": "mse",
+    "categorical_crossentropy": "mcxent",
+    # NOTE: sparse_categorical_crossentropy is deliberately NOT aliased:
+    # mcxent assumes one-hot labels; silently accepting integer-label
+    # sparse CE would optimize a wrong objective
+    "binary_crossentropy": "xent",
 }
 
 

@@ -426,6 +426,73 @@ def _input_type(list_builder, batch_shape):
     return list_builder.input_type_feed_forward(dims[0])
 
 
+def _map_training_config(f, enforce: bool):
+    """Map the h5 `training_config` attr (model.compile state) to
+    (updater, loss_name). Ref: KerasModelImport's enforceTrainingConfig
+    + KerasOptimizerUtils/KerasLossUtils — when `enforce` is False,
+    unmappable pieces are skipped; when True they raise."""
+    from .. import learning as U
+    raw = f.attrs.get("training_config")
+    if raw is None:
+        if enforce:
+            raise ValueError("model was saved without training_config "
+                             "(not compiled) but enforce_training_config"
+                             "=True")
+        return None, None
+    tc = json.loads(raw if isinstance(raw, str) else raw.decode())
+    upd = None
+    oc = tc.get("optimizer_config") or {}
+    name = str(oc.get("class_name") or "").lower()
+    ocfg = (oc.get("config") or {})
+    # Keras 3 stores 'learning_rate'; Keras 2 h5 files store 'lr'
+    lr = ocfg.get("learning_rate", ocfg.get("lr", 1e-3))
+    if isinstance(lr, dict):  # lr schedule object
+        if enforce:
+            raise ValueError("keras learning-rate schedules are not "
+                             "mapped; resolve to a constant lr first")
+        lr = (lr.get("config") or {}).get("initial_learning_rate", 1e-3)
+    lr = float(lr)
+    if name == "adam":
+        upd = U.Adam(lr, ocfg.get("beta_1", 0.9),
+                     ocfg.get("beta_2", 0.999),
+                     ocfg.get("epsilon", 1e-7))
+    elif name == "sgd":
+        mom = float(ocfg.get("momentum", 0.0) or 0.0)
+        upd = U.Nesterovs(lr, mom) if mom else U.Sgd(lr)
+    elif name == "rmsprop":
+        upd = U.RmsProp(lr, ocfg.get("rho", 0.9),
+                        ocfg.get("epsilon", 1e-7))
+    elif name == "adagrad":
+        upd = U.AdaGrad(lr)
+    elif name == "adamax":
+        upd = U.AdaMax(lr)
+    elif name == "nadam":
+        upd = U.Nadam(lr)
+    elif name and enforce:
+        raise ValueError(f"unsupported keras optimizer {name!r}")
+    raw_loss = tc.get("loss")
+    loss = raw_loss
+    if isinstance(loss, dict):
+        loss = (loss.get("config") or {}).get("name") or \
+            loss.get("class_name")
+    if loss is not None and not isinstance(loss, str):
+        loss = None
+    if loss is None and raw_loss is not None and enforce:
+        # e.g. the per-output dict form {'out_name': 'mse'} or a custom
+        # loss object — unmappable, and enforce means unmappable raises
+        raise ValueError(f"unsupported keras loss spec {raw_loss!r}")
+    if loss == "sparse_categorical_crossentropy":
+        if enforce:
+            raise ValueError(
+                "sparse_categorical_crossentropy is not mapped (the "
+                "mcxent loss expects one-hot labels; integer-label "
+                "sparse CE would silently optimize a wrong objective) "
+                "— one-hot the labels and recompile, or import with "
+                "enforce_training_config=False and set the loss")
+        loss = None
+    return upd, loss
+
+
 class KerasModelImport:
     """Ref: KerasModelImport.java:50 (functional) / :88 (sequential)."""
 
@@ -465,9 +532,31 @@ class KerasModelImport:
                 nm, d = mapped[-1]
                 mapped[-1] = (nm, _as_output_layer(d))
 
-            lb = NeuralNetConfiguration.builder().list()
+            # restore the compile-time training config (optimizer + loss)
+            # so an imported model fine-tunes with the same settings
+            upd, loss_name = _map_training_config(
+                f, enforce_training_config)
+            b = NeuralNetConfiguration.builder()
+            if upd is not None:
+                b = b.updater(upd)
+            lb = b.list()
             for _, layer in mapped:
                 lb = lb.layer(layer)
+            if loss_name is not None and mapped:
+                if not hasattr(mapped[-1][1], "loss"):
+                    if enforce_training_config:
+                        raise ValueError(
+                            "compiled loss cannot be attached: the "
+                            "final imported layer "
+                            f"({type(mapped[-1][1]).__name__}) is not "
+                            "an output layer")
+                else:
+                    from .. import losses as _L
+                    try:
+                        mapped[-1][1].loss = _L.get(loss_name)
+                    except Exception:
+                        if enforce_training_config:
+                            raise
             lb = _input_type(lb, batch_shape)
             net = MultiLayerNetwork(lb.build()).init()
 
@@ -488,7 +577,9 @@ class KerasModelImport:
 
     # -- functional -> ComputationGraph --------------------------------
     @staticmethod
-    def import_keras_model_and_weights(path: str) -> ComputationGraph:
+    def import_keras_model_and_weights(
+            path: str, enforce_training_config: bool = False
+    ) -> ComputationGraph:
         with h5py.File(path, "r") as f:
             cfg = json.loads(f.attrs["model_config"])
             if cfg["class_name"] == "Sequential":
@@ -496,7 +587,13 @@ class KerasModelImport:
                     f"{path} is Sequential; use "
                     "import_keras_sequential_model_and_weights")
             gcfg = cfg["config"]
-            builder = GraphBuilder()
+            # restore compile-time optimizer (+ loss, attached below)
+            upd, loss_name = _map_training_config(
+                f, enforce_training_config)
+            base = NeuralNetConfiguration.builder()
+            if upd is not None:
+                base = base.updater(upd)
+            builder = GraphBuilder(base)
             input_names = []
             mapped: Dict[str, object] = {}
             shapes: Dict[str, list] = {}
@@ -528,6 +625,15 @@ class KerasModelImport:
                 outs = [outs]  # single output stored flat: [name, 0, 0]
             out_names = [_node_name(o) for o in outs]
             builder.set_outputs(*out_names)
+            # make output nodes trainable: final Dense -> OutputLayer
+            # (same conversion the sequential path applies; without it
+            # the imported graph has no loss head and cannot fit)
+            for onm in out_names:
+                ol = mapped.get(onm)
+                if type(ol) is DenseLayer:
+                    new = _as_output_layer(ol)
+                    mapped[onm] = new
+                    builder._nodes[onm].layer = new
             from ..nn.conf import InputType
             types = []
             for nm in input_names:
@@ -539,6 +645,22 @@ class KerasModelImport:
                 else:
                     types.append(InputType.feed_forward(dims[0]))
             builder.set_input_types(*types)
+            if loss_name is not None:
+                from .. import losses as _L
+                for onm in out_names:
+                    ol = mapped.get(onm)
+                    if ol is None or not hasattr(ol, "loss"):
+                        if enforce_training_config:
+                            raise ValueError(
+                                "compiled loss cannot be attached: "
+                                f"output node {onm!r} is not an output "
+                                "layer")
+                        continue
+                    try:
+                        ol.loss = _L.get(loss_name)
+                    except Exception:
+                        if enforce_training_config:
+                            raise
             graph = ComputationGraph(builder.build()).init()
 
             for nm, layer in mapped.items():

@@ -207,3 +207,107 @@ class TestKerasFullArchitectures:
         x = np.random.RandomState(2).rand(2, 64, 64, 3).astype(np.float32)
         got, want = self._round_trip(m, x)
         np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+class TestKerasTrainingConfigImport:
+    """The h5 training_config (model.compile state) maps onto the
+    imported network: optimizer class + lr and the loss (ref:
+    KerasModelImport enforceTrainingConfig / KerasOptimizerUtils)."""
+
+    def _save_compiled(self, tmp_path, optimizer):
+        keras = pytest.importorskip("keras")
+        m = keras.Sequential([
+            keras.layers.Input((4,)),
+            keras.layers.Dense(8, activation="relu"),
+            keras.layers.Dense(3, activation="softmax")])
+        m.compile(optimizer=optimizer, loss="categorical_crossentropy")
+        p = str(tmp_path / "m.h5")
+        m.save(p)
+        return p
+
+    def test_adam_lr_and_loss_restored(self, tmp_path):
+        keras = pytest.importorskip("keras")
+        p = self._save_compiled(tmp_path,
+                                keras.optimizers.Adam(0.003))
+        from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+        net = KerasModelImport.import_keras_sequential_model_and_weights(
+            p, enforce_training_config=True)
+        out = net.layers[-1]
+        assert out.loss.name == "mcxent"
+        # the compiled Adam(0.003) is the resolved updater
+        upd = net._updaters[-1]
+        assert type(upd).__name__ == "Adam"
+        assert upd.learning_rate == pytest.approx(0.003)
+        # imported net trains out of the box with the compiled settings
+        rs = np.random.RandomState(0)
+        x = rs.rand(64, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[(x.sum(-1) * 2).astype(int) % 3]
+        s0 = net.score(x, y)
+        net.fit(x, y, epochs=30)
+        assert net.score(x, y) < s0
+
+    def test_sgd_momentum_maps_to_nesterovs(self, tmp_path):
+        keras = pytest.importorskip("keras")
+        from deeplearning4j_tpu.modelimport.keras import (
+            _map_training_config)
+        import h5py
+        p = self._save_compiled(
+            tmp_path, keras.optimizers.SGD(0.05, momentum=0.9))
+        with h5py.File(p) as f:
+            upd, loss = _map_training_config(f, enforce=True)
+        assert type(upd).__name__ == "Nesterovs"
+        assert upd.momentum == pytest.approx(0.9)
+        assert loss == "categorical_crossentropy"
+
+    def test_uncompiled_with_enforce_raises(self, tmp_path):
+        keras = pytest.importorskip("keras")
+        m = keras.Sequential([keras.layers.Input((4,)),
+                              keras.layers.Dense(2)])
+        p = str(tmp_path / "u.h5")
+        m.save(p)
+        from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+        with pytest.raises(ValueError, match="training_config"):
+            KerasModelImport.import_keras_sequential_model_and_weights(
+                p, enforce_training_config=True)
+
+    def test_functional_model_restores_compile_state(self, tmp_path):
+        keras = pytest.importorskip("keras")
+        inp = keras.Input((6,))
+        h = keras.layers.Dense(8, activation="relu")(inp)
+        out = keras.layers.Dense(2, activation="softmax")(h)
+        m = keras.Model(inp, out)
+        m.compile(optimizer=keras.optimizers.RMSprop(0.002),
+                  loss="categorical_crossentropy")
+        p = str(tmp_path / "f.h5")
+        m.save(p)
+        from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+        g = KerasModelImport.import_keras_model_and_weights(
+            p, enforce_training_config=True)
+        # compiled RMSprop(0.002) resolved on every node's updater
+        upd = next(iter(g._updaters.values()))
+        assert type(upd).__name__ == "RmsProp"
+        assert upd.learning_rate == pytest.approx(0.002)
+        # loss attached to the output node; the graph trains
+        rs = np.random.RandomState(0)
+        x = rs.rand(32, 6).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x.sum(-1) > 3).astype(int)]
+        s0 = g.score([x], [y])
+        g.fit([x], [y], epochs=20)
+        assert g.score([x], [y]) < s0
+
+    def test_sparse_ce_rejected_under_enforce(self, tmp_path):
+        keras = pytest.importorskip("keras")
+        m = keras.Sequential([keras.layers.Input((4,)),
+                              keras.layers.Dense(3,
+                                                 activation="softmax")])
+        m.compile(optimizer="adam",
+                  loss="sparse_categorical_crossentropy")
+        p = str(tmp_path / "s.h5")
+        m.save(p)
+        from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+        with pytest.raises(ValueError, match="sparse"):
+            KerasModelImport.import_keras_sequential_model_and_weights(
+                p, enforce_training_config=True)
+        # without enforce: imports, loss left at the activation default
+        net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+        assert net is not None
