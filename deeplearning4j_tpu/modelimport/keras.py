@@ -470,26 +470,41 @@ def _map_training_config(f, enforce: bool):
         upd = U.Nadam(lr)
     elif name and enforce:
         raise ValueError(f"unsupported keras optimizer {name!r}")
+    def _loss_str(sp):
+        # a loss-object dict carries class_name/config.name; anything
+        # else string-like passes through
+        if isinstance(sp, dict):
+            sp = (sp.get("config") or {}).get("name") or sp.get("class_name")
+        return sp if isinstance(sp, str) else None
+
+    def _check_sparse(l):
+        if l == "sparse_categorical_crossentropy":
+            if enforce:
+                raise ValueError(
+                    "sparse_categorical_crossentropy is not mapped (the "
+                    "mcxent loss expects one-hot labels; integer-label "
+                    "sparse CE would silently optimize a wrong objective) "
+                    "— one-hot the labels and recompile, or import with "
+                    "enforce_training_config=False and set the loss")
+            return None
+        return l
+
     raw_loss = tc.get("loss")
-    loss = raw_loss
-    if isinstance(loss, dict):
-        loss = (loss.get("config") or {}).get("name") or \
-            loss.get("class_name")
-    if loss is not None and not isinstance(loss, str):
-        loss = None
+    if (isinstance(raw_loss, dict) and not raw_loss.get("class_name")
+            and not (raw_loss.get("config") or {}).get("name")):
+        # keras multi-output per-output dict form {'out_name': spec}:
+        # map each entry; the whole dict is unmappable only if some
+        # ENTRY is (advisor r4: dropping a fully-mappable dict left
+        # compiled functional models without restored losses)
+        loss = {k: _check_sparse(_loss_str(v))
+                for k, v in raw_loss.items()}
+        if not loss or any(v is None for v in loss.values()):
+            loss = None
+    else:
+        loss = _check_sparse(_loss_str(raw_loss)) \
+            if raw_loss is not None else None
     if loss is None and raw_loss is not None and enforce:
-        # e.g. the per-output dict form {'out_name': 'mse'} or a custom
-        # loss object — unmappable, and enforce means unmappable raises
         raise ValueError(f"unsupported keras loss spec {raw_loss!r}")
-    if loss == "sparse_categorical_crossentropy":
-        if enforce:
-            raise ValueError(
-                "sparse_categorical_crossentropy is not mapped (the "
-                "mcxent loss expects one-hot labels; integer-label "
-                "sparse CE would silently optimize a wrong objective) "
-                "— one-hot the labels and recompile, or import with "
-                "enforce_training_config=False and set the loss")
-        loss = None
     return upd, loss
 
 
@@ -542,6 +557,10 @@ class KerasModelImport:
             lb = b.list()
             for _, layer in mapped:
                 lb = lb.layer(layer)
+            if isinstance(loss_name, dict):
+                # per-output dict on a Sequential = one output
+                loss_name = (next(iter(loss_name.values()))
+                             if len(loss_name) == 1 else None)
             if loss_name is not None and mapped:
                 if not hasattr(mapped[-1][1], "loss"):
                     if enforce_training_config:
@@ -649,6 +668,16 @@ class KerasModelImport:
                 from .. import losses as _L
                 for onm in out_names:
                     ol = mapped.get(onm)
+                    # per-output dict form: each output gets ITS entry
+                    this_loss = (loss_name.get(onm)
+                                 if isinstance(loss_name, dict)
+                                 else loss_name)
+                    if this_loss is None:
+                        if enforce_training_config:
+                            raise ValueError(
+                                "compiled per-output loss dict has no "
+                                f"entry for output {onm!r}")
+                        continue
                     if ol is None or not hasattr(ol, "loss"):
                         if enforce_training_config:
                             raise ValueError(
@@ -657,7 +686,7 @@ class KerasModelImport:
                                 "layer")
                         continue
                     try:
-                        ol.loss = _L.get(loss_name)
+                        ol.loss = _L.get(this_loss)
                     except Exception:
                         if enforce_training_config:
                             raise

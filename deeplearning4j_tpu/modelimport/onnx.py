@@ -223,13 +223,18 @@ class OnnxGraphMapper:
                     env[n.outputs[i]] = v[i]
             return first
 
-        def const_of(name):
+        def const_of(name, int_exact=False):
             """Materialize a compile-time-constant input. Prefers the raw
             int64 numpy original (jnp truncates to int32, destroying
             sentinel values); torch's exporter also COMPUTES shape/pad/
             slice arguments through chains of Constant/Cast/Reshape/Add
-            nodes — when no literal exists, fold the (closed,
-            placeholder-free) subgraph."""
+            nodes — those chains are folded in the raw numpy int64
+            domain by _fold_raw, so they land here too. When no raw
+            entry exists, fold the (closed, placeholder-free) subgraph
+            in jnp — but with ``int_exact=True`` (Slice/Pad bounds,
+            where an already-int32-truncated INT64 sentinel would slip
+            past the sentinel guard and slice wrongly) an integer result
+            from that lossy path is refused instead of trusted."""
             raw = env.get("__raw__", {})
             if name in raw:
                 return np.asarray(raw[name])
@@ -237,7 +242,15 @@ class OnnxGraphMapper:
             arr = v.get_arr()
             if arr is None:
                 arr = sd.output({}, [v.name])[v.name]
-            return np.asarray(arr)
+            arr = np.asarray(arr)
+            if int_exact and np.issubdtype(arr.dtype, np.integer):
+                raise ValueError(
+                    f"constant input {name!r} resolves through the jnp "
+                    "fold path, which truncates int64 to int32 — an "
+                    "ONNX INT64 open-slice sentinel would be silently "
+                    "corrupted. The producing op chain is not raw-"
+                    "foldable; extend _fold_raw to cover it.")
+            return arr
 
         if op == "Constant":
             # value arrives as a TensorProto attribute (value / value_float
@@ -252,6 +265,8 @@ class OnnxGraphMapper:
             shape = env[ins[0]].shape
             if shape is None or any(s is None for s in shape):
                 raise ValueError("Shape op on dynamic input unsupported")
+            env.setdefault("__raw__", {})[n.outputs[0]] = np.asarray(
+                shape, np.int64)
             env[n.outputs[0]] = sd.constant(
                 np.asarray(shape, np.int64), name=safe)
         elif op in ("Cast", "CastLike"):
@@ -434,7 +449,7 @@ class OnnxGraphMapper:
             # opset 11+: pads arrive as a constant input in
             # [begin_0..begin_k, end_0..end_k] layout; mode is an attr
             if len(ins) > 1 and ins[1]:
-                pads = const_of(ins[1]).ravel()
+                pads = const_of(ins[1], int_exact=True).ravel()
             else:
                 pads = np.asarray(a.get("pads", []), np.int64)
             k = len(pads) // 2
@@ -454,16 +469,20 @@ class OnnxGraphMapper:
                 constant_values=cval)
         elif op == "Slice":
             # opset 10+: starts/ends/axes/steps as constant inputs
-            starts = [int(v) for v in const_of(ins[1]).ravel()]
-            ends = [int(v) for v in const_of(ins[2]).ravel()]
+            starts = [int(v) for v in const_of(ins[1], int_exact=True)
+                      .ravel()]
+            ends = [int(v) for v in const_of(ins[2], int_exact=True)
+                    .ravel()]
             x = env[ins[0]]
             if x.shape is None:
                 raise ValueError("Slice on an input of unknown rank "
                                  "unsupported")
             rank = len(x.shape)
-            axes = [int(v) for v in const_of(ins[3]).ravel()] \
+            axes = [int(v) for v in const_of(ins[3], int_exact=True)
+                    .ravel()] \
                 if len(ins) > 3 and ins[3] else list(range(len(starts)))
-            steps = [int(v) for v in const_of(ins[4]).ravel()] \
+            steps = [int(v) for v in const_of(ins[4], int_exact=True)
+                     .ravel()] \
                 if len(ins) > 4 and ins[4] else [1] * len(starts)
             spec = [["s", None, None, 1] for _ in range(rank)]
             for ax, s, e, st in zip(axes, starts, ends, steps):
@@ -516,6 +535,9 @@ class OnnxGraphMapper:
             shape = tuple(int(s) for s in const_of(ins[0]).ravel())
             val = a.get("value", np.zeros(1, np.float32))
             arr = np.full(shape, np.asarray(val).ravel()[0])
+            # raw side-table too: integer fills (e.g. int64 index seeds)
+            # must keep exact dtype for downstream const_of readers
+            env.setdefault("__raw__", {})[n.outputs[0]] = arr
             env[n.outputs[0]] = sd.constant(arr, name=safe)
         elif op == "ConvTranspose":
             strides = tuple(a.get("strides", [1, 1]))
@@ -579,3 +601,110 @@ class OnnxGraphMapper:
         else:
             raise ValueError(f"unsupported ONNX op {op!r} (node "
                              f"{n.name!r}); extend OnnxGraphMapper")
+        # raw-domain constant-chain folding: keep int64 exactness through
+        # the computed-constant chains torch's exporter emits
+        # (Constant -> Cast/Add/Reshape/Concat/... -> Slice bounds) so
+        # const_of(int_exact=True) never falls back to the lossy jnp fold
+        OnnxGraphMapper._fold_raw(n, a, env)
+
+    _RAW_FOLD_OPS = ("Cast", "Add", "Sub", "Mul", "Div", "Neg", "Reshape",
+                     "Concat", "Squeeze", "Unsqueeze", "Gather", "Range",
+                     "Slice", "Transpose")
+
+    @staticmethod
+    def _fold_raw(n: "_OnnxNode", a: Dict[str, Any], env: Dict[str, Any]):
+        """If every input of a foldable node is a known raw numpy
+        constant, evaluate the node in numpy (int64-exact) and record the
+        result in the ``__raw__`` side-table. jnp-domain truncation never
+        touches these values, so INT64 open-slice sentinels survive
+        Cast/Add/... chains (the advisor's round-4 finding)."""
+        op = n.op
+        raw = env.setdefault("__raw__", {})
+        if op not in OnnxGraphMapper._RAW_FOLD_OPS or n.outputs[0] in raw:
+            return
+        # keep optional-input POSITIONS: ONNX omits an optional input as
+        # an empty name (e.g. Slice [data, starts, ends, "", steps]) —
+        # compacting would fold steps as axes
+        if not n.inputs or not all((not i) or i in raw for i in n.inputs):
+            return
+        vals = [np.asarray(raw[i]) if i else None for i in n.inputs]
+        while vals and vals[-1] is None:
+            vals.pop()
+        if not vals or vals[0] is None:
+            return
+        try:
+            if op == "Cast":
+                np_dtype = {1: np.float32, 6: np.int32, 7: np.int64,
+                            9: np.bool_, 11: np.float64}.get(
+                                a.get("to", 1))
+                if np_dtype is None:
+                    return  # unmapped dtype code: decline, don't guess
+                out = vals[0].astype(np_dtype)
+            elif op == "Add":
+                out = vals[0] + vals[1]
+            elif op == "Sub":
+                out = vals[0] - vals[1]
+            elif op == "Mul":
+                out = vals[0] * vals[1]
+            elif op == "Neg":
+                out = -vals[0]
+            elif op == "Div":
+                if np.issubdtype(vals[0].dtype, np.integer):
+                    # ONNX integer Div truncates toward zero (C
+                    # semantics); numpy // floors, so go via magnitudes
+                    s = np.sign(vals[0]) * np.sign(vals[1])
+                    out = (s * (np.abs(vals[0]) // np.abs(vals[1]))
+                           ).astype(vals[0].dtype)
+                else:
+                    out = vals[0] / vals[1]
+            elif op == "Reshape":
+                target = [int(t) for t in vals[1].ravel()]
+                src = vals[0].shape
+                target = [src[i] if t == 0 else t
+                          for i, t in enumerate(target)]
+                out = vals[0].reshape(target)
+            elif op == "Concat":
+                out = np.concatenate(vals, axis=int(a.get("axis", 0)))
+            elif op in ("Squeeze", "Unsqueeze"):
+                if len(vals) > 1 and vals[1] is not None:
+                    axes = [int(v) for v in vals[1].ravel()]
+                else:
+                    axes = [int(v) for v in a.get("axes", [])]
+                if op == "Squeeze":
+                    out = (np.squeeze(vals[0], axis=tuple(axes))
+                           if axes else np.squeeze(vals[0]))
+                else:
+                    out = vals[0]
+                    for ax in sorted(axes):
+                        out = np.expand_dims(out, ax)
+            elif op == "Gather":
+                out = np.take(vals[0], vals[1].astype(np.int64),
+                              axis=int(a.get("axis", 0)))
+            elif op == "Range":
+                out = np.arange(vals[0].ravel()[0], vals[1].ravel()[0],
+                                vals[2].ravel()[0])
+            elif op == "Slice":
+                data = vals[0]
+                starts, ends = vals[1].ravel(), vals[2].ravel()
+                axes = (vals[3].ravel()
+                        if len(vals) > 3 and vals[3] is not None
+                        else np.arange(len(starts)))
+                steps = (vals[4].ravel()
+                         if len(vals) > 4 and vals[4] is not None
+                         else np.ones(len(starts), np.int64))
+                sl = [slice(None)] * data.ndim
+                for ax, s, e, st in zip(axes, starts, ends, steps):
+                    # python slicing clamps out-of-range bounds exactly
+                    # like ONNX (incl. the INT64 open-slice sentinels)
+                    sl[int(ax)] = slice(int(s), int(e), int(st))
+                out = data[tuple(sl)]
+            elif op == "Transpose":
+                perm = a.get("perm")
+                out = np.transpose(vals[0],
+                                   [int(p) for p in perm] if perm
+                                   else None)
+            else:
+                return
+        except Exception:
+            return  # fold is best-effort; the jnp graph stays correct
+        raw[n.outputs[0]] = np.asarray(out)

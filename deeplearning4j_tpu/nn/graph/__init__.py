@@ -860,9 +860,24 @@ class ComputationGraph:
     def output(self, *data, train: bool = False, mask=None):
         """Returns the list of output activations (ref:
         ComputationGraph.output; `mask` is the [B, T] input feature mask
-        — ref: the featureMaskArrays overload)."""
+        — ref: the featureMaskArrays overload). Accepts a bare array
+        (single-input graphs only — the same restriction _fmask_from
+        enforces on the training path) or a dict keyed by input name."""
         if self._params is None:
             self.init()
+        if mask is not None:
+            if isinstance(mask, dict):
+                mask = self._fmask_from(mask)
+            elif len(self.conf.graph_inputs) > 1:
+                # a bare mask on a multi-input graph would silently
+                # apply one input's padding pattern to every branch —
+                # the training path refuses this, so inference must too
+                raise NotImplementedError(
+                    "a bare feature mask on a multi-input "
+                    "ComputationGraph is ambiguous — only single-input "
+                    "graphs accept one (pass a dict keyed by input "
+                    "name to hit the same single-input check the "
+                    "training path enforces)")
         if len(data) == 1 and isinstance(data[0], (dict, list, tuple)):
             inputs = self._as_inputs(data[0])
         else:

@@ -253,7 +253,15 @@ class SameDiffOutputLayer(SameDiffLayer):
 
 def samediff_layer_from_json(d: dict) -> SameDiffLayer:
     """Reconstruct a custom SameDiff layer from its import path (the
-    Python analogue of the reference's reflective JSON subtyping)."""
+    Python analogue of the reference's reflective JSON subtyping).
+
+    .. warning:: SECURITY — the ``cls`` field is an arbitrary
+       ``module:qualname`` imported and instantiated from the model
+       JSON. Deserializing a model file that contains custom SameDiff
+       layers therefore EXECUTES CODE chosen by whoever wrote the file
+       (same trust model as the reference's reflective subtyping, or
+       pickle). Only load model JSON from sources you trust; see
+       docs/model-import.md."""
     from ... import activations as A
     from ... import learning as U
     path = d.pop("cls", None)

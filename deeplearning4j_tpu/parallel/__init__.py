@@ -104,22 +104,51 @@ class GradientSharingAccumulator:
     and worker updater states drift only through seeing local gradients
     (worker 0's live moments are mirrored into the model's
     checkpointable opt_state EVERY step, so mid-fit preemption
-    checkpoints resume correctly)."""
+    checkpoints resume correctly).
+
+    Two modes (``mode=``):
+
+    - ``"update"`` — the reference-faithful pipeline above: per-worker
+      updater, then sign*threshold quantization of the UPDATE. Wire
+      format parity: index + sign, magnitude fixed at the threshold
+      (`EncodingHandler.java:51`).
+    - ``"gradient"`` (default) — TPU-native redesign: quantize the
+      GRADIENT, transmitting the TRUE value of each fired entry
+      (index + value on the wire, ~2x the sign stream, still
+      sparsity-bounded), pmean the decoded gradients, and run ONE
+      shared updater on the result. Because every worker applies the
+      identical decoded-average gradient, updater state stays
+      synchronized with zero extra communication — eliminating the two
+      dominant convergence costs of the reference pipeline measured in
+      `tools/diag_compress.py` (per-worker updater noise on small
+      shards, and sign*threshold magnitude loss; 12-epoch conv+Adam
+      loss 0.24 vs dense 0.20 vs 0.63 for the faithful mode). The
+      residual/error-feedback carry (EF — Stich et al. 2018, Seide
+      2014; same mechanism as the reference's ResidualPostProcessor)
+      is unchanged. Note this does NOT re-create the round-3
+      limit-cycle bug: that pathology came from sign*threshold firings
+      (constant magnitude) being renormalized by Adam; value-preserving
+      decode keeps gradient magnitudes, so Adam's scaling is sound."""
 
     def __init__(self, threshold: float = 1e-3, adaptive: bool = True,
                  min_sparsity: float = 1e-4, max_sparsity: float = 1e-2,
-                 adapt_factor: float = 1.2):
+                 adapt_factor: float = 1.2, mode: str = "gradient"):
+        if mode not in ("update", "gradient"):
+            raise ValueError(f"mode must be 'update' or 'gradient': {mode}")
         self.initial_threshold = float(threshold)
         self.adaptive = bool(adaptive)
         self.min_sparsity = float(min_sparsity)
         self.max_sparsity = float(max_sparsity)
         self.adapt_factor = float(adapt_factor)
+        self.mode = mode
         # carried (device) state, installed by ParallelWrapper._build_step
         self.residuals = None
         self.threshold = None
         self.last_sparsity = None
         self.opt_state = None  # per-worker updater state (update-domain
-        # quantization runs the updater BEFORE encoding, per worker)
+        # quantization runs the updater BEFORE encoding, per worker;
+        # unused in gradient mode, where the model's own replicated
+        # opt_state stays authoritative)
 
 
 class ParallelWrapper:
@@ -200,6 +229,9 @@ class ParallelWrapper:
         from ..nn.multilayer import _clip_grads
         max_norm = m.conf.max_grad_norm
         clip_value = m.conf.grad_clip_value
+
+        if acc.mode == "gradient":
+            return self._build_gradient_compressed_step()
 
         # per-worker state: one leading device axis, sharded over "data"
         # (each worker owns its residual AND its updater state — ref:
@@ -296,6 +328,99 @@ class ParallelWrapper:
             ckpt_opt = jax.tree_util.tree_map(lambda a: a[0],
                                               acc.opt_state)
             return new_params, ckpt_opt, new_net, loss
+
+        return step_like
+
+    def _build_gradient_compressed_step(self):
+        """Compile the TPU-native ``mode="gradient"`` pipeline: per-worker
+        local grads -> (+ residual) -> threshold-fire with TRUE values
+        (`compression.strom_value_encode_decode`) -> pmean(decoded) ->
+        ONE shared updater on the decoded-average gradient. Every worker
+        applies the identical decoded gradient, so updater state stays
+        replicated/synchronized by construction — the model's own
+        opt_state remains authoritative (checkpoint/resume needs no
+        mirroring). See GradientSharingAccumulator for why this mode
+        converges closer to dense than the reference-faithful update
+        pipeline on small per-worker shards."""
+        from .compression import adapt_threshold, strom_value_encode_decode
+        m = self.model
+        acc = self.accumulator
+        mesh = self.mesh
+        ndev = self.num_workers
+        updaters, layer_keys = m._updaters, m._layer_keys
+        layers = m.layers
+        from ..nn.multilayer import _clip_grads
+        max_norm = m.conf.max_grad_norm
+        clip_value = m.conf.grad_clip_value
+
+        # per-worker residual carry only; updater state stays replicated
+        if acc.residuals is None:
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros((ndev,) + p.shape, p.dtype), m._params)
+            acc.residuals = jax.device_put(
+                zeros, NamedSharding(mesh, P("data")))
+            acc.threshold = jnp.asarray(acc.initial_threshold, jnp.float32)
+            acc.last_sparsity = jnp.asarray(0.0, jnp.float32)
+
+        def worker_step(params, opt_state, net_state, residual, threshold,
+                        step, x, y, mask, rng):
+            (loss, (new_net_state, _)), grads = jax.value_and_grad(
+                lambda p: m._loss_fn(p, net_state, x, y, mask, True, rng),
+                has_aux=True)(params)
+            grads = _clip_grads(grads, max_norm, clip_value)
+            flat_g, treedef = jax.tree_util.tree_flatten(grads)
+            flat_r = treedef.flatten_up_to(residual)
+            enc = [strom_value_encode_decode(g, r[0], threshold)
+                   for g, r in zip(flat_g, flat_r)]
+            decoded = treedef.unflatten([d for d, _ in enc])
+            new_residual = treedef.unflatten([r[None] for _, r in enc])
+            fired = sum(jnp.sum(jnp.abs(d) > 0) for d, _ in enc)
+            total = sum(d.size for d, _ in enc)
+            sparsity = lax.pmean(fired / total, "data")
+            new_threshold = adapt_threshold(
+                threshold, sparsity, acc.min_sparsity, acc.max_sparsity,
+                acc.adapt_factor) if acc.adaptive else threshold
+            # the "bus": average the decoded sparse GRADIENTS, then run
+            # the one shared updater — every worker computes the same
+            # update, so opt_state stays synchronized with no extra
+            # communication
+            shared_g = lax.pmean(decoded, "data")
+            loss = lax.pmean(loss, "data")
+            new_net_state = lax.pmean(new_net_state, "data")
+            new_opt, new_params = {}, {}
+            for i, key in enumerate(layer_keys):
+                if key not in params:
+                    continue
+                st, upd = updaters[i].apply(opt_state[key], shared_g[key],
+                                            step)
+                new_opt[key] = st
+                new_p = jax.tree_util.tree_map(lambda a, u: a - u,
+                                               params[key], upd)
+                if layers[i].constraints:
+                    from ..nn.conf.constraint import apply_constraints
+                    new_p = apply_constraints(layers[i].constraints, new_p,
+                                              layers[i].bias_param_names())
+                new_params[key] = new_p
+            return (new_params, new_opt, new_net_state, new_residual,
+                    new_threshold, sparsity, loss)
+
+        repl = P()
+        data = P("data")
+        sharded = jax.jit(
+            jax.shard_map(
+                worker_step, mesh=mesh,
+                in_specs=(repl, repl, repl, data, repl, repl, data, data,
+                          data, repl),
+                out_specs=(repl, repl, repl, data, repl, repl, repl),
+                check_vma=False),
+            donate_argnums=(0, 1, 2, 3))
+
+        def step_like(params, opt_state, net_state, step, x, y, mask, rng):
+            (new_params, new_opt, new_net, acc.residuals, acc.threshold,
+             acc.last_sparsity, loss) = sharded(
+                params, opt_state, net_state, acc.residuals,
+                acc.threshold, step, x, y, mask, rng)
+            return new_params, new_opt, new_net, loss
 
         return step_like
 

@@ -88,6 +88,21 @@ def strom_encode_decode(update, residual, threshold):
     return decoded, u - decoded
 
 
+def strom_value_encode_decode(update, residual, threshold):
+    """Magnitude-preserving variant (the accumulator's ``mode="gradient"``
+    default): entries of (update + residual) with |u| >= t transmit their
+    TRUE value (wire: index + f32 value, ~2x the reference's index+sign
+    stream, still sparsity-bounded); the rest stays in the residual.
+    Preserving magnitudes keeps a downstream shared Adam's scaling sound
+    — see GradientSharingAccumulator for the measured convergence case.
+
+    Returns (decoded, new_residual)."""
+    u = update + residual
+    fire = jnp.abs(u) >= threshold
+    decoded = jnp.where(fire, u, jnp.zeros((), u.dtype))
+    return decoded, u - decoded
+
+
 def adapt_threshold(threshold, sparsity, min_sparsity=1e-4,
                     max_sparsity=1e-2, adapt_factor=1.2):
     """Jit-friendly AdaptiveThresholdAlgorithm: multiplicative nudge

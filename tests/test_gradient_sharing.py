@@ -86,7 +86,7 @@ class TestGradientSharingTraining:
         comp = MultiLayerNetwork(_conf()).init()
         acc = GradientSharingAccumulator(threshold=1e-3, adaptive=True,
                                          min_sparsity=1e-3,
-                                         max_sparsity=0.5)
+                                         max_sparsity=0.5, mode="update")
         lc = _losses_over(comp, ParallelWrapper(comp, accumulator=acc),
                           x, y, 12)
         assert lc[-1] < lc[0] - 0.05, lc
@@ -99,7 +99,7 @@ class TestGradientSharingTraining:
         dense = MultiLayerNetwork(_conf()).init()
         comp = MultiLayerNetwork(_conf()).init()
         ld = _losses_over(dense, ParallelWrapper(dense), x, y, 30)
-        acc = GradientSharingAccumulator(threshold=1e-3)
+        acc = GradientSharingAccumulator(threshold=1e-3, mode="update")
         pw = ParallelWrapper(comp, accumulator=acc)
         lc = _losses_over(comp, pw, x, y, 30)
         assert lc[-1] < ld[0], "compressed training did not learn"
@@ -147,10 +147,13 @@ class TestGradientSharingTraining:
 
 
 class TestUpdateDomainQuantization:
-    """The encode step must run AFTER the updater (update-domain, ref
-    StochasticGradientDescent.java:52-93): gradient-domain quantization
-    fed to Adam turns every sparse firing into a full-size normalized
-    step (noisy signSGD) and limit-cycles instead of converging."""
+    """mode="update" (reference-faithful): the encode step must run AFTER
+    the updater (update-domain, ref StochasticGradientDescent.java:52-93)
+    because SIGN*THRESHOLD quantization fed to Adam turns every sparse
+    firing into a full-size normalized step (noisy signSGD) and
+    limit-cycles instead of converging. (mode="gradient" avoids this
+    differently: it preserves fired VALUES, so Adam's scaling stays
+    sound even in the gradient domain — see TestGradientDomainValueMode.)"""
 
     def test_adam_compressed_training_converges(self):
         from deeplearning4j_tpu.learning import Adam
@@ -164,7 +167,7 @@ class TestUpdateDomainQuantization:
         model = MultiLayerNetwork(conf).init()
         acc = GradientSharingAccumulator(threshold=1e-3, adaptive=True,
                                          min_sparsity=1e-3,
-                                         max_sparsity=0.5)
+                                         max_sparsity=0.5, mode="update")
         lc = _losses_over(model, ParallelWrapper(model, accumulator=acc),
                           x, y, 25)
         # monotone-ish convergence, no limit cycle: the tail is below
@@ -182,7 +185,7 @@ class TestUpdateDomainQuantization:
                 .input_type_feed_forward(4).build())
         x, y = _data(n=128)
         model = MultiLayerNetwork(conf).init()
-        acc = GradientSharingAccumulator(threshold=1e-3)
+        acc = GradientSharingAccumulator(threshold=1e-3, mode="update")
         pw = ParallelWrapper(model, accumulator=acc)
         pw.fit(ArrayDataSetIterator(x, y, batch=128, shuffle=False),
                epochs=2)
@@ -206,7 +209,7 @@ class TestUpdateDomainQuantization:
                        jax.tree_util.tree_leaves(model._opt_state)]
         pw = ParallelWrapper(model,
                              accumulator=GradientSharingAccumulator(
-                                 threshold=1e-3))
+                                 threshold=1e-3, mode="update"))
         pw.fit(ArrayDataSetIterator(x, y, batch=128, shuffle=False),
                epochs=3)
         after = jax.tree_util.tree_leaves(model._opt_state)
@@ -216,3 +219,101 @@ class TestUpdateDomainQuantization:
                     for a, b in zip(init_leaves,
                                     [np.asarray(l) for l in after]))
         assert moved, "model opt_state still at init after compressed fit"
+
+
+class TestGradientDomainValueMode:
+    """mode="gradient" (the TPU-native default): value-preserving
+    threshold compression of GRADIENTS + one shared updater. The measured
+    contract (tools/diag_compress.py): convergence at near-exact parity
+    with dense — the per-worker-updater noise and sign*threshold
+    magnitude loss of the faithful pipeline are both absent."""
+
+    def test_mode_validation(self):
+        with pytest.raises(ValueError):
+            GradientSharingAccumulator(mode="bogus")
+
+    def test_value_codec_preserves_fired_values(self):
+        from deeplearning4j_tpu.parallel.compression import (
+            strom_value_encode_decode)
+        u = jnp.asarray([0.5, -0.3, 0.05, 0.0, -2.0])
+        dec, res = strom_value_encode_decode(u, jnp.zeros(5), 0.1)
+        np.testing.assert_allclose(np.asarray(dec),
+                                   [0.5, -0.3, 0.0, 0.0, -2.0], atol=1e-7)
+        np.testing.assert_allclose(np.asarray(dec + res), np.asarray(u),
+                                   atol=1e-7)
+
+    def test_adam_conv_parity_with_dense(self):
+        """The round-4 verdict's gap case: conv + Adam. Gradient mode
+        must end within a tight epsilon of dense (the faithful update
+        mode shows ~2.4x loss on this workload — the documented trade)."""
+        from deeplearning4j_tpu.learning import Adam
+        from deeplearning4j_tpu.nn.layers import (ConvolutionLayer,
+                                                  SubsamplingLayer)
+
+        def conv_conf():
+            return (NeuralNetConfiguration.builder().seed(123)
+                    .updater(Adam(1e-3)).weight_init("relu").list()
+                    .layer(ConvolutionLayer(n_out=8, kernel=(3, 3),
+                                            activation="relu"))
+                    .layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+                    .layer(DenseLayer(n_out=32, activation="relu"))
+                    .layer(OutputLayer(n_out=4, loss="mcxent",
+                                       activation="softmax"))
+                    .input_type_convolutional(8, 8, 1).build())
+
+        rng = np.random.RandomState(1)
+        x = rng.rand(64, 8, 8, 1).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[
+            (x.mean((1, 2, 3)) > x.mean()).astype(int) * 2 +
+            (x[:, :4].mean((1, 2, 3)) > x.mean()).astype(int)]
+        dense = MultiLayerNetwork(conv_conf()).init()
+        comp = MultiLayerNetwork(conv_conf()).init()
+        ld = lc = None
+        ld_t, lc_t = [], []
+        pw_d = ParallelWrapper(dense)
+        acc = GradientSharingAccumulator(threshold=1e-3, adaptive=True,
+                                         min_sparsity=1e-3,
+                                         max_sparsity=0.5)
+        assert acc.mode == "gradient"  # the default
+        pw_c = ParallelWrapper(comp, accumulator=acc)
+        for _ in range(12):
+            pw_d.fit(ArrayDataSetIterator(x, y, batch=16, shuffle=False),
+                     epochs=1)
+            pw_c.fit(ArrayDataSetIterator(x, y, batch=16, shuffle=False),
+                     epochs=1)
+            ld_t.append(float(dense.score_))
+            lc_t.append(float(comp.score_))
+        ld, lc = ld_t[-1], lc_t[-1]
+        assert lc < lc_t[0] - 0.1, lc_t
+        assert abs(lc - ld) < 0.1, (lc_t, ld_t)
+
+    def test_opt_state_stays_replicated_and_authoritative(self):
+        """No per-worker updater axis in gradient mode: the model's own
+        replicated opt_state is the live state (checkpointing needs no
+        mirroring)."""
+        from deeplearning4j_tpu.learning import Adam
+        conf = (NeuralNetConfiguration.builder().seed(3)
+                .updater(Adam(1e-2)).weight_init("xavier").list()
+                .layer(DenseLayer(n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_out=2, loss="mcxent",
+                                   activation="softmax"))
+                .input_type_feed_forward(4).build())
+        x, y = _data(n=128)
+        model = MultiLayerNetwork(conf).init()
+        init_leaves = [np.asarray(l) for l in
+                       jax.tree_util.tree_leaves(model._opt_state)]
+        acc = GradientSharingAccumulator(threshold=1e-3)
+        pw = ParallelWrapper(model, accumulator=acc)
+        pw.fit(ArrayDataSetIterator(x, y, batch=128, shuffle=False),
+               epochs=3)
+        assert acc.opt_state is None  # no per-worker mirror in this mode
+        after = jax.tree_util.tree_leaves(model._opt_state)
+        moved = any(a.shape == b.shape and not np.allclose(a, b)
+                    for a, b in zip(init_leaves,
+                                    [np.asarray(l) for l in after]))
+        assert moved, "opt_state still at init after gradient-mode fit"
+        for leaf in after:
+            assert leaf.sharding.is_fully_replicated
+        # residuals still carry per-worker state (leading device axis)
+        for leaf in jax.tree_util.tree_leaves(acc.residuals):
+            assert leaf.shape[0] == pw.num_workers

@@ -311,3 +311,46 @@ class TestKerasTrainingConfigImport:
         # without enforce: imports, loss left at the activation default
         net = KerasModelImport.import_keras_sequential_model_and_weights(p)
         assert net is not None
+
+
+class TestPerOutputLossDict:
+    """Advisor r4: the Keras per-output loss dict ({'out_name': 'mse'})
+    must map entry-by-entry onto multi-output functional imports instead
+    of being dropped wholesale."""
+
+    def _two_headed(self, tmp_path, losses):
+        keras = pytest.importorskip("keras")
+        inp = keras.Input((6,), name="inp")
+        h = keras.layers.Dense(8, activation="relu", name="trunk")(inp)
+        a = keras.layers.Dense(2, activation="softmax", name="head_a")(h)
+        b = keras.layers.Dense(1, activation="linear", name="head_b")(h)
+        m = keras.Model(inp, [a, b])
+        m.compile(optimizer=keras.optimizers.Adam(1e-3), loss=losses)
+        p = str(tmp_path / "two.h5")
+        m.save(p)
+        return p
+
+    def test_dict_losses_restored_per_output(self, tmp_path):
+        p = self._two_headed(tmp_path,
+                             {"head_a": "categorical_crossentropy",
+                              "head_b": "mse"})
+        from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+        g = KerasModelImport.import_keras_model_and_weights(
+            p, enforce_training_config=True)
+        got = {nm: getattr(g.conf.nodes[nm].layer, "loss", None)
+               for nm in ("head_a", "head_b")}
+        assert got["head_a"] is not None and got["head_a"].name == "mcxent"
+        assert got["head_b"] is not None and got["head_b"].name == "mse"
+
+    def test_dict_with_unmappable_entry_raises_under_enforce(self,
+                                                             tmp_path):
+        keras = pytest.importorskip("keras")
+        p = self._two_headed(tmp_path,
+                             {"head_a": "sparse_categorical_crossentropy",
+                              "head_b": "mse"})
+        from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+        with pytest.raises(ValueError, match="sparse"):
+            KerasModelImport.import_keras_model_and_weights(
+                p, enforce_training_config=True)
+        # non-enforce still imports
+        assert KerasModelImport.import_keras_model_and_weights(p) is not None

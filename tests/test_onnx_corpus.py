@@ -77,3 +77,61 @@ def test_unsupported_op_raises_with_name():
     model = ld(7, graph)
     with pytest.raises(ValueError, match="FancyOp"):
         OnnxGraphMapper.import_graph(model)
+
+
+class TestRawConstantFolding:
+    """Advisor r4 (medium): computed int64 constant chains must fold in
+    the raw numpy domain — jnp folding truncates to int32, corrupting
+    ONNX INT64 open-slice sentinels into valid-looking small ints."""
+
+    @staticmethod
+    def _node(op, inputs, outputs, **attrs):
+        from deeplearning4j_tpu.modelimport.onnx import _OnnxNode
+        n = _OnnxNode()
+        n.op, n.inputs, n.outputs, n.attrs = op, list(inputs), list(outputs), attrs
+        return n
+
+    def test_sentinel_survives_cast_add_chain(self):
+        from deeplearning4j_tpu.modelimport.onnx import OnnxGraphMapper
+        sentinel = np.int64(np.iinfo(np.int64).max)
+        env = {"__raw__": {"c": np.asarray([sentinel - 1], np.int64),
+                           "one": np.asarray([1], np.int64)}}
+        n = self._node("Add", ["c", "one"], ["c1"])
+        OnnxGraphMapper._fold_raw(n, {}, env)
+        n2 = self._node("Cast", ["c1"], ["c2"])
+        OnnxGraphMapper._fold_raw(n2, {"to": 7}, env)
+        assert env["__raw__"]["c2"].dtype == np.int64
+        # int32 truncation would have produced -2 here
+        assert int(env["__raw__"]["c2"][0]) == np.iinfo(np.int64).max
+
+    def test_slice_fold_honors_open_slice_sentinel(self):
+        from deeplearning4j_tpu.modelimport.onnx import OnnxGraphMapper
+        env = {"__raw__": {
+            "d": np.arange(10, dtype=np.int64),
+            "s": np.asarray([3], np.int64),
+            "e": np.asarray([np.iinfo(np.int64).max], np.int64),
+            "ax": np.asarray([0], np.int64)}}
+        n = self._node("Slice", ["d", "s", "e", "ax"], ["out"])
+        OnnxGraphMapper._fold_raw(n, {}, env)
+        np.testing.assert_array_equal(env["__raw__"]["out"],
+                                      np.arange(3, 10))
+
+    def test_int_exact_refuses_lossy_jnp_fallback(self):
+        """A Slice bound only reachable through the lossy jnp path must
+        raise, not silently mis-slice (unfoldable producer op)."""
+        import pytest
+        from deeplearning4j_tpu.modelimport import onnx as O
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+        sd = SameDiff.create()
+        env = {"__raw__": {}}
+        x = sd.constant(np.arange(12, dtype=np.float32).reshape(3, 4),
+                        name="x")
+        env["x"] = x
+        # an integer constant NOT in __raw__ (simulates an unfoldable
+        # producer chain whose jnp value was int32-truncated)
+        env["bad_start"] = sd.constant(np.asarray([0], np.int32),
+                                       name="bad_start")
+        env["ends"] = sd.constant(np.asarray([2], np.int32), name="ends")
+        n = self._node("Slice", ["x", "bad_start", "ends"], ["y"])
+        with pytest.raises(ValueError, match="int64"):
+            O.OnnxGraphMapper._map_node(sd, n, env)
