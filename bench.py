@@ -228,6 +228,7 @@ model = MultiLayerNetwork(conf).init()
 it = MnistDataSetIterator(batch=BATCH, train=True, flatten=False,
                           num_examples=4096, shuffle=False)
 synthetic = bool(it.synthetic)
+source = getattr(it, "source", "synthetic" if synthetic else "mnist")
 batches = [(jnp.asarray(b[0]), jnp.asarray(b[1])) for b in it]
 step = model._make_step()
 rng = jax.random.PRNGKey(0)
@@ -246,11 +247,14 @@ dt, final_loss = timed_steps(run_step, 3, N)
 model._params, model._opt_state, model._net_state = state
 model._jit_step = step
 train_it = MnistDataSetIterator(batch=BATCH, train=True, flatten=False)
-model.fit(train_it, epochs=1)
+# enough epochs to hit the >=0.98 bar on the small real-digits split
+# (the vendored fixture is 1,437 train / 360 test samples)
+model.fit(train_it, epochs=1 if source == "mnist" else 8)
 test_it = MnistDataSetIterator(batch=512, train=False, flatten=False)
 acc = model.evaluate(test_it).accuracy()
 emit("LeNet-MNIST train (batch 128)", BATCH, N, dt, final_loss,
-     test_accuracy=round(float(acc), 4), synthetic_data=synthetic)
+     test_accuracy=round(float(acc), 4), synthetic_data=synthetic,
+     data_source=source)
 """
 
 ATTENTION_CODE = _COMMON + r"""
@@ -408,7 +412,7 @@ def _sub(res):
            "final_loss": res.get("final_loss"),
            "mfu": _mfu(res)}
     for k in ("test_accuracy", "synthetic_data", "dtype",
-              "compile_seconds"):
+              "compile_seconds", "data_source"):
         if k in res:
             out[k] = res[k]
     return out
@@ -559,7 +563,7 @@ def main():
         "extra": extras,
     }
     for k in ("test_accuracy", "synthetic_data", "dtype",
-              "compile_seconds"):
+              "compile_seconds", "data_source"):
         if k in res:
             out[k] = res[k]
     if violations:

@@ -746,10 +746,14 @@ class SameDiff:
                 needed.append(node)
         return needed
 
-    def _child_closure(self, child: "SameDiff", out_names, env_keys):
+    def _child_closure(self, child: "SameDiff", out_names, env_keys,
+                       policy_dtype: Optional[str] = None):
         """Build an executor for a control-flow subgraph; child constants/
-        variables are closed over."""
-        cfn = child._build(tuple(out_names))
+        variables are closed over. The mixed-precision cast rewrite
+        (``policy_dtype``) propagates into subgraphs — a Cast(->f32)
+        inside a cond/while body poisons downstream dtypes exactly like
+        one at the top level."""
+        cfn = child._build(tuple(out_names), policy_dtype)
 
         def run(args, rng):
             vals = dict(child._values)
@@ -758,12 +762,24 @@ class SameDiff:
             return cfn(vals, rng)
         return run
 
-    def _build(self, outputs: Tuple[str, ...]) -> Callable:
+    def _build(self, outputs: Tuple[str, ...],
+               policy_dtype: Optional[str] = None) -> Callable:
         """Compile-ready pure function over (values, rng). This is the
         whole-graph lowering that replaces InferenceSession's per-op
-        dispatch."""
-        if outputs in self._fn_cache:
-            return self._fn_cache[outputs]
+        dispatch.
+
+        ``policy_dtype`` (mixed precision): explicit in-graph casts to
+        float32 are re-targeted to the policy dtype — imported graphs
+        carry literal Cast(->f32) nodes (e.g. TF BERT's attention-mask
+        int->float cast) that would otherwise re-promote every
+        downstream op to f32, silently undoing cast-through mixed
+        precision (the round-5 HLO audit measured 282/294 f32 dots in
+        BERT-bf16 from exactly this). TF's auto-mixed-precision rewrites
+        these casts the same way; the loss head stays f32 because labels
+        are never cast (see _train_step_fn)."""
+        cache_key = (outputs, policy_dtype)
+        if cache_key in self._fn_cache:
+            return self._fn_cache[cache_key]
         plan = self._plan(outputs)
         missing = [nm for nm in outputs
                    if nm not in self._vars]
@@ -776,7 +792,8 @@ class SameDiff:
         for n in plan:
             if n.subgraphs:
                 subruns[id(n)] = {
-                    k: self._child_closure(child, onames, None)
+                    k: self._child_closure(child, onames, None,
+                                           policy_dtype)
                     for k, (child, onames) in n.subgraphs.items()}
 
         def fn(values: Dict[str, Any], rng):
@@ -835,6 +852,19 @@ class SameDiff:
                            if k != "__kw_inputs__"}
                     for k, idx in node.kwargs.get("__kw_inputs__", {}).items():
                         kws[k] = env[node.inputs[idx]]
+                    if policy_dtype is not None and node.op == "cast":
+                        def _is_f32_literal(d):
+                            if hasattr(d, "aval") or hasattr(d, "shape"):
+                                return False  # tensor, not a dtype spec
+                            try:  # str, np type, or class all normalize
+                                return np.dtype(d) == np.float32
+                            except TypeError:
+                                return False
+                        if _is_f32_literal(kws.get("dtype")):
+                            kws["dtype"] = policy_dtype
+                        else:  # dtype as positional literal: cast(x, dt)
+                            args = [policy_dtype if _is_f32_literal(a)
+                                    else a for a in args]
                     if node.op == "dropout":
                         # dropout takes rng as a kwarg, not first-positional
                         res = o.fn(*args, rng=key, **kws)
@@ -866,7 +896,7 @@ class SameDiff:
         else:
             out_fn = fn
         out_fn.needed = frozenset(needed)
-        self._fn_cache[outputs] = out_fn
+        self._fn_cache[cache_key] = out_fn
         return out_fn
 
     def _filter_values(self, vals, fn, extra=()):
@@ -909,12 +939,13 @@ class SameDiff:
 
     setLossVariables = set_loss_variables
 
-    def _loss_fn(self, wrt: Tuple[str, ...]):
+    def _loss_fn(self, wrt: Tuple[str, ...],
+                 policy_dtype: Optional[str] = None):
         loss_names = tuple(self._loss_variables)
         if not loss_names:
             raise ValueError("no loss variables set "
                              "(use set_loss_variables)")
-        fn = self._build(loss_names)
+        fn = self._build(loss_names, policy_dtype)
 
         def loss_fn(diff_vals, nondiff_vals, rng):
             outs = fn({**nondiff_vals, **diff_vals}, rng)
@@ -968,15 +999,15 @@ class SameDiff:
     def _train_step_fn(self):
         cfg = self._training_config
         tnames = tuple(self._trainable())
-        loss_fn = self._loss_fn(tnames)
-        updater = cfg.updater
-        l1, l2 = cfg.l1, cfg.l2
-
         # normalize through the shared policy: 'half'/'bf16'/'fp16' all
         # mean bfloat16 on TPU (fp16-without-loss-scaling is never
         # selected — see nn/precision.py)
         from ..nn.precision import compute_dtype as _policy_dtype
         cdt = _policy_dtype(cfg.compute_dtype)
+        loss_fn = self._loss_fn(
+            tnames, str(jnp.dtype(cdt)) if cdt is not None else None)
+        updater = cfg.updater
+        l1, l2 = cfg.l1, cfg.l2
         label_names = frozenset(cfg.data_set_label_mapping)
 
         def _cast(tree, skip=frozenset()):

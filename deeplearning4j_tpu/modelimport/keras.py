@@ -474,7 +474,10 @@ def _map_training_config(f, enforce: bool):
         # a loss-object dict carries class_name/config.name; anything
         # else string-like passes through
         if isinstance(sp, dict):
-            sp = (sp.get("config") or {}).get("name") or sp.get("class_name")
+            cfg_v = sp.get("config")
+            name = cfg_v.get("name") if isinstance(cfg_v, dict) else None
+            cls_v = sp.get("class_name")
+            sp = name or (cls_v if isinstance(cls_v, str) else None)
         return sp if isinstance(sp, str) else None
 
     def _check_sparse(l):
@@ -490,8 +493,12 @@ def _map_training_config(f, enforce: bool):
         return l
 
     raw_loss = tc.get("loss")
-    if (isinstance(raw_loss, dict) and not raw_loss.get("class_name")
-            and not (raw_loss.get("config") or {}).get("name")):
+    # a serialized loss OBJECT has a class_name string (keras serde
+    # invariant); a per-output dict maps output-layer names to specs.
+    # Checking the class_name TYPE keeps an output literally named
+    # "config" or "class_name" from being misparsed as a loss object.
+    if (isinstance(raw_loss, dict)
+            and not isinstance(raw_loss.get("class_name"), str)):
         # keras multi-output per-output dict form {'out_name': spec}:
         # map each entry; the whole dict is unmappable only if some
         # ENTRY is (advisor r4: dropping a fully-mappable dict left

@@ -609,7 +609,8 @@ class OnnxGraphMapper:
 
     _RAW_FOLD_OPS = ("Cast", "Add", "Sub", "Mul", "Div", "Neg", "Reshape",
                      "Concat", "Squeeze", "Unsqueeze", "Gather", "Range",
-                     "Slice", "Transpose")
+                     "Slice", "Transpose", "Min", "Max", "Abs", "Mod",
+                     "Where", "Equal", "Greater", "Less")
 
     @staticmethod
     def _fold_raw(n: "_OnnxNode", a: Dict[str, Any], env: Dict[str, Any]):
@@ -703,6 +704,29 @@ class OnnxGraphMapper:
                 out = np.transpose(vals[0],
                                    [int(p) for p in perm] if perm
                                    else None)
+            elif op == "Min":
+                out = vals[0]
+                for v in vals[1:]:
+                    out = np.minimum(out, v)
+            elif op == "Max":
+                out = vals[0]
+                for v in vals[1:]:
+                    out = np.maximum(out, v)
+            elif op == "Abs":
+                out = np.abs(vals[0])
+            elif op == "Mod":
+                # fmod=1 -> C fmod (truncated); default integer Mod is
+                # python-style (floored), matching numpy
+                out = (np.fmod(vals[0], vals[1]) if a.get("fmod")
+                       else np.mod(vals[0], vals[1]))
+            elif op == "Where":
+                out = np.where(vals[0], vals[1], vals[2])
+            elif op == "Equal":
+                out = vals[0] == vals[1]
+            elif op == "Greater":
+                out = vals[0] > vals[1]
+            elif op == "Less":
+                out = vals[0] < vals[1]
             else:
                 return
         except Exception:

@@ -470,7 +470,9 @@ class MultiLayerNetwork:
                 act, _, c2 = self._forward(params, net_state, x, False, None,
                                            carries=carries)
                 return act, c2
-            self._jit_rnn_step = jax.jit(fwd)
+            # donate the carries: each streaming step replaces them, so
+            # the old buffers can be reused in place
+            self._jit_rnn_step = jax.jit(fwd, donate_argnums=(3,))
         out, self._stored_carries = self._jit_rnn_step(
             self._params, self._net_state, x, self._stored_carries)
         return out[:, 0] if squeeze and out.ndim == 3 else out

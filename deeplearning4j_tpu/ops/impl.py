@@ -701,6 +701,12 @@ def deconv3d(x, w, b=None, stride=(2, 2, 2), padding="valid"):
 def depthwise_conv2d(x, w, b=None, stride=(1, 1), padding="same",
                      dilation=(1, 1)):
     c_in = x.shape[-1]
+    # accept both kernel layouts: grouped-HWIO [H, W, 1, C*mult] and
+    # TF/keras DepthwiseConv2D native [H, W, C, mult] — the reshape
+    # flattens (C, mult) C-major, matching TF's c*mult+m output channel
+    # order exactly
+    if w.ndim == 4 and w.shape[2] == c_in and c_in > 1:
+        w = w.reshape(w.shape[0], w.shape[1], 1, c_in * w.shape[3])
     z = lax.conv_general_dilated(
         x, w, window_strides=tuple(stride), padding=_pad_arg(padding),
         rhs_dilation=tuple(dilation), feature_group_count=c_in,

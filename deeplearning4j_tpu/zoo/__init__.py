@@ -44,22 +44,82 @@ class ZooModel:
         return os.path.expanduser(
             f"~/.deeplearning4j_tpu/zoo/{self.name}.npz")
 
-    def init_pretrained(self):
+    def save_pretrained(self, model, path: Optional[str] = None) -> str:
+        """Export a trained model's params as the npz `init_pretrained`
+        loads, plus a `<path>.sha256` digest file — the publishing half
+        of the reference's checksum contract (`ZooModel.java`
+        initPretrained verifies a checksum before trusting the file;
+        `pretrainedChecksum(...)` per model)."""
+        import hashlib
+        path = path or self.pretrained_cache_path()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        flat = {f"param/{k}": v
+                for k, v in _flatten("", model.params()).items()}
+        # BN running stats etc. travel with the weights — the reference's
+        # pretrained blobs are full inference state, not just kernels
+        flat.update({f"state/{k}": v for k, v in
+                     _flatten("", model._net_state or {}).items()})
+        np.savez(path, **{k: np.asarray(v) for k, v in flat.items()})
+        sha = hashlib.sha256(open(path, "rb").read()).hexdigest()
+        with open(path + ".sha256", "w") as f:
+            f.write(sha + "\n")
+        return path
+
+    def init_pretrained(self, path: Optional[str] = None):
         """Load pretrained params from the local cache (ref:
-        ZooModel.initPretrained — download+checksum; here: local file)."""
-        path = self.pretrained_cache_path()
+        ZooModel.initPretrained — download + checksum verify; no egress
+        here, so the file must have been placed by `save_pretrained` or
+        by hand alongside its `.sha256`). The digest is verified before
+        the file is trusted, and every architecture param must be
+        present with its exact shape — a partial or mismatched blob
+        raises instead of silently half-loading."""
+        import hashlib
+        path = path or self.pretrained_cache_path()
         model = self.init()
         if not os.path.exists(path):
             raise FileNotFoundError(
                 f"no pretrained weights cached at {path}; this environment "
                 "has no network egress (reference downloads from CDN)")
+        sha_path = path + ".sha256"
+        if os.path.exists(sha_path):
+            want = open(sha_path).read().split()[0]
+            got = hashlib.sha256(open(path, "rb").read()).hexdigest()
+            if got != want:
+                raise IOError(
+                    f"pretrained checksum mismatch for {path}: {got} != "
+                    f"{want} (ref ZooModel checksum contract)")
         blob = np.load(path, allow_pickle=False)
         params = model.params()
-        flat = _flatten("", params)
-        for key, arr in flat.items():
-            if key in blob and blob[key].shape == arr.shape:
-                _assign(params, key, jnp.asarray(blob[key]))
+        net_state = model._net_state or {}
+        if not any(k.startswith("param/") for k in blob.files):
+            # legacy flat-key blob (pre-round-5 layout: params only, no
+            # prefixes): accept it, params-strict, without state keys
+            flat = {k: (params, k) for k in _flatten("", params)}
+        else:
+            flat = {f"param/{k}": (params, k)
+                    for k in _flatten("", params)}
+            flat.update({f"state/{k}": (net_state, k)
+                         for k in _flatten("", net_state)})
+        missing = [k for k in flat if k not in blob]
+        if missing:
+            raise ValueError(
+                f"pretrained blob {path} is missing params: "
+                f"{missing[:5]}{'...' if len(missing) > 5 else ''}")
+        bad = []
+        for key, (tree, sub) in flat.items():
+            cur = tree
+            for p in sub.split("/"):
+                cur = cur[p]
+            if blob[key].shape != np.asarray(cur).shape:
+                bad.append(key)
+        if bad:
+            raise ValueError(
+                f"pretrained blob {path} has mismatched shapes for: "
+                f"{bad[:5]}{'...' if len(bad) > 5 else ''}")
+        for key, (tree, sub) in flat.items():
+            _assign(tree, sub, jnp.asarray(blob[key]))
         model.set_params(params)
+        model._net_state = net_state
         return model
 
     def _updater(self):

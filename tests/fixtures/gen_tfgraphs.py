@@ -230,6 +230,17 @@ def main():
              in_ids=ids, in_mask=mask, out_0=expected)
     print(f"bert_tiny: frozen, expected {expected.shape}")
 
+    # 15. whole-architecture zoo case (ref: TFGraphTestZooModels.java):
+    # keras MobileNet a=0.25 frozen to a GraphDef — depthwise convs,
+    # FusedBatchNormV3 (inference), ReLU6, global pooling, 1x1 conv
+    # classifier. Random init (no egress), seeded; real TF is the oracle.
+    tf.keras.utils.set_random_seed(11)
+    mnet = tf.keras.applications.MobileNet(
+        input_shape=(64, 64, 3), alpha=0.25, weights=None, classes=7)
+    _save("zoo_mobilenet025", lambda x: mnet(x, training=False),
+          [spec([2, 64, 64, 3], tf.float32, name="img")],
+          [rs.rand(2, 64, 64, 3).astype(np.float32)])
+
 
 if __name__ == "__main__":
     main()

@@ -473,3 +473,56 @@ class TestMixedPrecision:
         from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
         c = (TrainingConfig.builder().compute_dtype("bfloat16").build())
         assert c.compute_dtype == "bfloat16"
+
+
+class TestPolicyCastRewrite:
+    """Round-5 HLO audit fix: an explicit in-graph Cast(->float32) —
+    e.g. TF BERT's int attention-mask cast — must be re-targeted to the
+    compute dtype under mixed precision, or every downstream op
+    silently re-promotes to f32 (282/294 BERT dots measured before the
+    fix). TF auto-mixed-precision rewrites such casts identically."""
+
+    def _graph(self):
+        from deeplearning4j_tpu.autodiff.samediff import (SameDiff,
+                                                          TrainingConfig)
+        from deeplearning4j_tpu.learning import Sgd
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (None, 8))
+        m = sd.placeholder("m", (None, 8))      # int mask
+        y = sd.placeholder("y", (None, 1))
+        w = sd.var("w", value=np.zeros((8, 1), np.float32))
+        fm = m.cast("float32")                  # the poisoning cast
+        pred = (x * fm) @ w
+        loss = ((pred - y) * (pred - y)).reduce_mean()
+        sd.set_loss_variables(loss.name)
+        sd.set_training_config(TrainingConfig(
+            updater=Sgd(0.1), data_set_feature_mapping=["x", "m"],
+            data_set_label_mapping=["y"], compute_dtype="bfloat16"))
+        return sd
+
+    def test_in_graph_f32_cast_retargeted_to_policy_dtype(self):
+        import re
+        import jax
+        sd = self._graph()
+        sd.initialize_training()
+        step = sd._train_step_fn()
+        tvars = {"w": sd._values["w"]}
+        feed = {"x": np.zeros((4, 8), np.float32),
+                "m": np.ones((4, 8), np.int32),
+                "y": np.zeros((4, 1), np.float32)}
+        txt = step.lower(tvars, sd._updater_state, 0, feed,
+                         jax.random.PRNGKey(0)).as_text()
+        dots = re.findall(r"stablehlo\.dot_general[^\n]*->\s*"
+                          r"tensor<[^>]*x(\w+)>", txt)
+        assert dots and all(d == "bf16" for d in dots), dots
+
+    def test_inference_path_unaffected(self):
+        """Without a policy (plain output), the cast still produces
+        f32 — the rewrite only applies inside the training step."""
+        sd = self._graph()
+        out = sd.output({"x": np.ones((2, 8), np.float32),
+                         "m": np.ones((2, 8), np.int32),
+                         "y": np.zeros((2, 1), np.float32)},
+                        [sd._loss_variables[0]])
+        v = next(iter(out.values()))
+        assert str(np.asarray(v).dtype) == "float32"

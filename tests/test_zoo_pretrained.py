@@ -1,0 +1,95 @@
+"""Zoo pretrained save -> sha256 -> reload round-trip (VERDICT r4 #5 —
+ref: `zoo/ZooModel.java` initPretrained + checksum download; the
+download is egress-gated here, so the contract under test is the full
+local half: export, digest, verified reload, prediction bit-parity)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.zoo import (LeNet, ResNet50, SimpleCNN,
+                                    SqueezeNet, VGG16)
+
+
+def _small(cls):
+    """Small input shapes keep CPU compile time reasonable while
+    exercising the architecture's real param tree."""
+    kw = {"num_classes": 5, "seed": 7}
+    if cls in (ResNet50, VGG16, SqueezeNet):
+        kw["input_shape"] = (64, 64, 3)
+    return cls(**kw)
+
+
+@pytest.mark.parametrize("cls", [LeNet, SimpleCNN, ResNet50],
+                         ids=lambda c: c.name)
+def test_round_trip_bit_parity(cls, tmp_path):
+    zoo = _small(cls)
+    model = zoo.init()
+    # nudge params off init so parity is meaningful (one fit step)
+    h, w, c = zoo.input_shape
+    rs = np.random.RandomState(0)
+    x = rs.rand(2, h, w, c).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[[0, 1]]
+    model.fit(x, y, epochs=1) if hasattr(model, "fit") else None
+    path = str(tmp_path / f"{zoo.name}.npz")
+    out = zoo.save_pretrained(model, path)
+    assert out == path
+    sha = open(path + ".sha256").read().strip()
+    assert len(sha) == 64
+
+    reloaded = _small(cls).init_pretrained(path)
+    a = model.output(x) if not isinstance(model.output(x), list) \
+        else model.output(x)[0]
+    b = reloaded.output(x) if not isinstance(reloaded.output(x), list) \
+        else reloaded.output(x)[0]
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checksum_mismatch_raises(tmp_path):
+    zoo = _small(LeNet)
+    model = zoo.init()
+    path = str(tmp_path / "lenet.npz")
+    zoo.save_pretrained(model, path)
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(blob[:-1] + bytes([blob[-1] ^ 0xFF]))
+    with pytest.raises(IOError, match="checksum"):
+        _small(LeNet).init_pretrained(path)
+
+
+def test_missing_file_raises():
+    with pytest.raises(FileNotFoundError, match="egress"):
+        _small(LeNet).init_pretrained("/nonexistent/zoo/lenet.npz")
+
+
+def test_partial_blob_raises(tmp_path):
+    zoo = _small(LeNet)
+    model = zoo.init()
+    path = str(tmp_path / "lenet.npz")
+    zoo.save_pretrained(model, path)
+    blob = dict(np.load(path))
+    dropped = sorted(blob)[0]
+    del blob[dropped]
+    np.savez(path, **blob)
+    import hashlib
+    with open(path + ".sha256", "w") as f:
+        f.write(hashlib.sha256(open(path, "rb").read()).hexdigest())
+    with pytest.raises(ValueError, match="missing"):
+        _small(LeNet).init_pretrained(path)
+
+
+def test_shape_mismatch_raises(tmp_path):
+    zoo = _small(LeNet)
+    model = zoo.init()
+    path = str(tmp_path / "lenet.npz")
+    zoo.save_pretrained(model, path)
+    blob = dict(np.load(path))
+    k = sorted(blob)[0]
+    blob[k] = np.zeros(tuple(s + 1 for s in blob[k].shape),
+                       blob[k].dtype)
+    np.savez(path, **blob)
+    import hashlib
+    with open(path + ".sha256", "w") as f:
+        f.write(hashlib.sha256(open(path, "rb").read()).hexdigest())
+    with pytest.raises(ValueError, match="mismatched shapes"):
+        _small(LeNet).init_pretrained(path)

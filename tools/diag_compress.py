@@ -92,10 +92,6 @@ def main():
                                    min_sparsity=1e-3, max_sparsity=0.3))
 
 
-if __name__ == "__main__":
-    main()
-
-
 def ablations():
     """Separate the gap sources: (a) per-worker local Adam on 4-sample
     shards (no quantization), (b) quantization given perfect updater."""
@@ -136,14 +132,9 @@ def ablations():
     finally:
         C.strom_encode_decode = orig
 
-    # magnitude-preserving codec: fire at |u|>=t but send the TRUE value
-    def value_codec(update, residual, threshold):
-        import jax.numpy as jnp
-        u = update + residual
-        fire = jnp.abs(u) >= threshold
-        decoded = jnp.where(fire, u, jnp.zeros((), u.dtype))
-        return decoded, u - decoded
-    C.strom_encode_decode = value_codec
+    # magnitude-preserving codec inside the UPDATE-domain pipeline: the
+    # library's value codec swapped in for the sign*threshold one
+    C.strom_encode_decode = C.strom_value_encode_decode
     try:
         run("ablation: value codec thr=1e-3 (sparse but exact values)",
             GradientSharingAccumulator(threshold=1e-3, adaptive=True,
@@ -153,5 +144,5 @@ def ablations():
         C.strom_encode_decode = orig
 
 
-if __name__ == "__main__" and os.environ.get("DIAG_ABLATE"):
-    ablations()
+if __name__ == "__main__":
+    ablations() if os.environ.get("DIAG_ABLATE") else main()
