@@ -300,11 +300,33 @@ class OptimizationConfiguration:
 class LocalOptimizationRunner:
     """Ref: LocalOptimizationRunner — executes candidates, tracks the
     best. `score_function(values)` returns a score or
-    (score, model)."""
+    (score, model).
 
-    def __init__(self, config: OptimizationConfiguration):
+    Pass ``stats_storage`` (any StatsStorage, incl. a
+    RemoteUIStatsStorageRouter) to stream per-candidate progress to the
+    dashboard's arbiter view — the ArbiterModule role
+    (ref: `arbiter-ui/.../module/ArbiterModule.java`: results table +
+    best-score-vs-index chart)."""
+
+    def __init__(self, config: OptimizationConfiguration,
+                 stats_storage=None, session_id: str = "arbiter"):
         self.config = config
         self.results: List[OptimizationResult] = []
+        self.stats_storage = stats_storage
+        self.session_id = session_id
+
+    def _report(self, idx: int, cand, score: float):
+        if self.stats_storage is None:
+            return
+        import time as _time
+        best = (min if self.config.minimize else max)(
+            r.score for r in self.results)
+        self.stats_storage.put_update(self.session_id, {
+            "candidate": idx, "score": score, "best_score": best,
+            "parameters": {k: (v if isinstance(v, (int, float, str,
+                                                   bool)) else str(v))
+                           for k, v in (cand.values or {}).items()},
+            "timestamp": _time.time()})
 
     def execute(self) -> OptimizationResult:
         gen = self.config.generator
@@ -318,6 +340,7 @@ class LocalOptimizationRunner:
             score = float(score)
             gen.report_score(cand, score)
             self.results.append(OptimizationResult(cand, score, model))
+            self._report(len(self.results) - 1, cand, score)
         if not self.results:
             raise RuntimeError("no candidates evaluated")
         key = lambda r: r.score

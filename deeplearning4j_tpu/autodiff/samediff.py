@@ -74,6 +74,17 @@ _N_OUT = {
 
 _CONTROL_OPS = ("__cond", "__while", "__scan")
 
+# TF-AMP-style allowlist for the mixed-precision policy: ONLY the
+# MXU-bound contraction ops consume the policy dtype. Selecting by name
+# (not by catalog category) keeps precision-critical "blas"-category
+# linalg — cholesky/svd/matrix_inverse/determinant — in f32, exactly as
+# TF-AMP keeps them off its allowlist.
+_AMP_ALLOWLIST = frozenset(n for base in (
+    "matmul", "tensormmul", "batched_gemm", "einsum", "xw_plus_b",
+    "conv1d", "conv2d", "conv3dnew", "deconv2d", "deconv2d_tf",
+    "deconv3d", "depthwise_conv2d", "sconv2d", "pointwise_conv2d")
+    for n in (base, base + "_bp"))
+
 
 def _resolve(name: str) -> str:
     if name in catalog.REGISTRY:
@@ -768,14 +779,21 @@ class SameDiff:
         whole-graph lowering that replaces InferenceSession's per-op
         dispatch.
 
-        ``policy_dtype`` (mixed precision): explicit in-graph casts to
-        float32 are re-targeted to the policy dtype — imported graphs
-        carry literal Cast(->f32) nodes (e.g. TF BERT's attention-mask
-        int->float cast) that would otherwise re-promote every
-        downstream op to f32, silently undoing cast-through mixed
-        precision (the round-5 HLO audit measured 282/294 f32 dots in
-        BERT-bf16 from exactly this). TF's auto-mixed-precision rewrites
-        these casts the same way; the loss head stays f32 because labels
+        ``policy_dtype`` (mixed precision): MXU-bound contraction ops
+        (the `_AMP_ALLOWLIST` names — matmul, einsum, conv*) cast their
+        f32 tensor inputs to the policy dtype at the op, the TF-AMP
+        allowlist model; precision-critical linalg (cholesky/svd/
+        inverse) stays f32. This is what guarantees every dot/conv runs at the bf16
+        MXU rate even when an f32 value re-enters mid-graph — imported
+        graphs carry literal Cast(->f32) nodes (e.g. TF BERT's
+        attention-mask int->float cast) that re-promote the elementwise
+        chain to f32 and, before this, poisoned 282/294 BERT train dots
+        to f32 (round-5 HLO audit). Elementwise segments that promote
+        to f32 stay f32 (bandwidth cost only, numerically safer — e.g.
+        softmax after the mask add), and integer-valued f32 casts
+        (positional ranges) keep exact f32 values rather than being
+        blanket-rewritten to bf16, which above 256 cannot represent
+        consecutive integers. The loss head stays f32 because labels
         are never cast (see _train_step_fn)."""
         cache_key = (outputs, policy_dtype)
         if cache_key in self._fn_cache:
@@ -852,19 +870,18 @@ class SameDiff:
                            if k != "__kw_inputs__"}
                     for k, idx in node.kwargs.get("__kw_inputs__", {}).items():
                         kws[k] = env[node.inputs[idx]]
-                    if policy_dtype is not None and node.op == "cast":
-                        def _is_f32_literal(d):
-                            if hasattr(d, "aval") or hasattr(d, "shape"):
-                                return False  # tensor, not a dtype spec
-                            try:  # str, np type, or class all normalize
-                                return np.dtype(d) == np.float32
-                            except TypeError:
-                                return False
-                        if _is_f32_literal(kws.get("dtype")):
-                            kws["dtype"] = policy_dtype
-                        else:  # dtype as positional literal: cast(x, dt)
-                            args = [policy_dtype if _is_f32_literal(a)
-                                    else a for a in args]
+                    if (policy_dtype is not None
+                            and node.op in _AMP_ALLOWLIST):
+                        # TF-AMP allowlist casting: MXU ops consume the
+                        # policy dtype regardless of what dtype the
+                        # elementwise chain reached them in
+                        def _to_policy(v):
+                            if (hasattr(v, "dtype")
+                                    and v.dtype == jnp.float32):
+                                return v.astype(policy_dtype)
+                            return v
+                        args = [_to_policy(a) for a in args]
+                        kws = {k: _to_policy(v) for k, v in kws.items()}
                     if node.op == "dropout":
                         # dropout takes rng as a kwarg, not first-positional
                         res = o.fn(*args, rng=key, **kws)

@@ -1,0 +1,152 @@
+"""Append-only UI log file for SameDiff graphs (ref:
+`nd4j/.../graph/ui/LogFileWriter.java` — the UIGraphStructure /
+UIEvent log the reference's SameDiff UI consumes).
+
+The reference's wire format is kept at the FRAMING level so the file
+has the same two-block scan property it documents:
+
+1. a *static information* block — zero or more static frames (graph
+   structure, system info), terminated by a ``START_EVENTS`` marker
+   frame; readers that only need the graph can stop there without
+   scanning events, and
+2. an *events* block — append-only scalar event frames
+   (name/iteration/epoch/timestamp/value).
+
+Each frame is ``[header_len:int32 BE, content_len:int32 BE,
+header_bytes, content_bytes]`` exactly as `LogFileWriter.java`'s format
+comment specifies; header/content payloads are JSON here instead of
+FlatBuffers (the serde policy of this port — see SURVEY §N11: the
+FlatBuffers role maps to JSON/StableHLO).
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["LogFileWriter", "LogFileReader"]
+
+_START_EVENTS = "START_EVENTS"
+
+
+def _frame(header: dict, content: Optional[dict]) -> bytes:
+    h = json.dumps(header).encode()
+    c = b"" if content is None else json.dumps(content).encode()
+    return struct.pack(">ii", len(h), len(c)) + h + c
+
+
+class LogFileWriter:
+    """Write-side. Static info first, then `end_static_info()`, then
+    events — the same state machine the reference enforces."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._static_done = False
+        open(path, "ab").close()
+        # resuming an existing log (append-only contract): if the file
+        # already carries a START_EVENTS marker, the static block is
+        # closed — only events may be appended. Writing a second static
+        # block would corrupt the two-block scan format.
+        if os.path.getsize(path):
+            try:
+                LogFileReader(path).read_static()
+                self._static_done = True
+            except ValueError:
+                raise ValueError(
+                    f"{path} exists but has no START_EVENTS marker "
+                    "(truncated static block?) — refusing to append; "
+                    "remove the file or finish its static block")
+
+    def _append(self, data: bytes):
+        with open(self.path, "ab") as f:
+            f.write(data)
+
+    def write_graph_structure(self, sd):
+        """Static frame: variables (name/type/dtype/shape) + ops
+        (name/op/inputs/outputs) — the UIGraphStructure role."""
+        if self._static_done:
+            raise ValueError("static block already closed "
+                             "(START_EVENTS written)")
+        vars_ = []
+        for name, v in sd._vars.items():
+            shape = getattr(v, "shape", None)
+            vars_.append({"name": name, "type": v.vtype.name,
+                          "shape": (list(shape) if shape else None)})
+        ops = [{"name": n.outputs[0] if n.outputs else "",
+                "op": n.op, "inputs": list(n.inputs),
+                "outputs": list(n.outputs)}
+               for n in sd._nodes]
+        self._append(_frame({"type": "GRAPH_STRUCTURE"},
+                            {"variables": vars_, "ops": ops}))
+
+    def write_system_info(self, info: Optional[Dict[str, Any]] = None):
+        if self._static_done:
+            raise ValueError("static block already closed")
+        if info is None:
+            import jax
+            d = jax.devices()[0]
+            info = {"platform": d.platform, "device": str(d),
+                    "device_count": jax.device_count()}
+        self._append(_frame({"type": "SYSTEM_INFO"}, info))
+
+    def end_static_info(self):
+        """The START_EVENTS marker: no static frames after, no events
+        before (ref format contract)."""
+        if not self._static_done:
+            self._append(_frame({"type": _START_EVENTS}, None))
+            self._static_done = True
+
+    def write_scalar_event(self, name: str, value: float,
+                           iteration: int = 0, epoch: int = 0,
+                           timestamp: Optional[float] = None):
+        if not self._static_done:
+            raise ValueError("write START_EVENTS (end_static_info) "
+                             "before events")
+        self._append(_frame(
+            {"type": "SCALAR_EVENT"},
+            {"name": name, "value": float(value),
+             "iteration": int(iteration), "epoch": int(epoch),
+             "timestamp": float(timestamp if timestamp is not None
+                                else time.time())}))
+
+
+class LogFileReader:
+    """Read-side. `read_static()` scans ONLY the static prefix (stops at
+    START_EVENTS — the format's purpose); `read_events()` returns the
+    event frames."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def _frames(self):
+        with open(self.path, "rb") as f:
+            while True:
+                head = f.read(8)
+                if len(head) < 8:
+                    return
+                hl, cl = struct.unpack(">ii", head)
+                header = json.loads(f.read(hl).decode())
+                content = json.loads(f.read(cl).decode()) if cl else None
+                yield header, content
+
+    def read_static(self) -> List[Tuple[dict, Optional[dict]]]:
+        out = []
+        for header, content in self._frames():
+            if header.get("type") == _START_EVENTS:
+                return out
+            out.append((header, content))
+        raise ValueError(f"{self.path}: no START_EVENTS marker — "
+                         "truncated or not a UI log file")
+
+    def read_events(self) -> List[Tuple[dict, Optional[dict]]]:
+        out = []
+        seen_marker = False
+        for header, content in self._frames():
+            if header.get("type") == _START_EVENTS:
+                seen_marker = True
+                continue
+            if seen_marker:
+                out.append((header, content))
+        return out

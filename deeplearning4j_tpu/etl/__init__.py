@@ -16,6 +16,7 @@ from .analysis import (CategoricalColumnAnalysis, DataAnalysis,
                        NumericalColumnAnalysis, analyze)
 from .records import (CSVRecordReader, CSVSequenceRecordReader,
                       CollectionRecordReader, ImageRecordReader,
+                      WavFileRecordReader,
                       LineRecordReader, NumpyRecordReader, RecordReader)
 from .transform import (Condition, Filter, LocalTransformExecutor,
                         TransformProcess)
@@ -31,7 +32,7 @@ __all__ = [
     "sequence_moving_window",
     "Schema", "ColumnType", "RecordReader", "CSVRecordReader",
     "CSVSequenceRecordReader", "CollectionRecordReader", "LineRecordReader",
-    "ImageRecordReader", "NumpyRecordReader", "TransformProcess",
+    "ImageRecordReader", "WavFileRecordReader", "NumpyRecordReader", "TransformProcess",
     "LocalTransformExecutor", "Filter", "Condition",
     "RecordReaderDataSetIterator", "SequenceRecordReaderDataSetIterator",
     "NormalizerStandardize", "NormalizerMinMaxScaler",

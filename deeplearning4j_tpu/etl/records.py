@@ -3,9 +3,11 @@
 Ref: `datavec-api/.../records/reader/RecordReader.java:40` SPI and its
 implementations (`impl/csv/CSVRecordReader.java`,
 `impl/csv/CSVSequenceRecordReader.java`, `impl/LineRecordReader.java`,
-`impl/collection/CollectionRecordReader.java`) plus the media reader
+`impl/collection/CollectionRecordReader.java`) plus the media readers
 `datavec-data/datavec-data-image/.../NativeImageLoader.java` (JavaCPP
-OpenCV there; PIL/numpy here).
+OpenCV there; PIL/numpy here) and
+`datavec-data/datavec-data-audio/.../WavFileRecordReader.java` (stdlib
+wave + numpy FFT here).
 
 A "record" is a list of python/numpy values (the reference's
 List<Writable>); a sequence record is a list of records. Readers are
@@ -16,7 +18,7 @@ from __future__ import annotations
 import csv
 import io
 import os
-from typing import Iterator, List, Optional, Sequence
+from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -273,6 +275,102 @@ class ImageRecordReader(RecordReader):
         label = os.path.basename(os.path.dirname(path))
         idx = self.labels.index(label) if label in self.labels else -1
         return [arr, idx]
+
+    def reset(self):
+        self._pos = 0
+
+
+class WavFileRecordReader(RecordReader):
+    """Ref: datavec-data-audio `WavFileRecordReader.java` (whole-file
+    audio records) + the datavec audio processing tier (FFT features).
+    Stdlib `wave` only — 8/16/32-bit PCM, channels mixed to mono,
+    samples normalized to [-1, 1] float32; the label is the parent
+    directory name (ParentPathLabelGenerator semantics, same as
+    ImageRecordReader).
+
+    Modes:
+    - default: one record per file = [signal [n_samples], label_idx]
+    - ``frame_length``/``frame_step`` set: overlapping windowed frames
+      [n_frames, frame_length] — static-shaped per file for the
+      transform pipeline
+    - ``spectrogram=True`` (requires frame_length): per-frame magnitude
+      of the real FFT -> [n_frames, frame_length // 2 + 1] (the
+      Spectrogram feature of the reference's audio tier)
+    """
+
+    def __init__(self, paths: Optional[Sequence[str]] = None,
+                 root_dir: Optional[str] = None,
+                 labels: Optional[Sequence[str]] = None,
+                 frame_length: Optional[int] = None,
+                 frame_step: Optional[int] = None,
+                 spectrogram: bool = False):
+        if spectrogram and frame_length is None:
+            raise ValueError("spectrogram=True requires frame_length")
+        if frame_length is None and frame_step is not None:
+            raise ValueError("frame_step requires frame_length (whole-"
+                             "file records are unframed)")
+        if root_dir is not None:
+            paths = sorted(
+                os.path.join(dp, f)
+                for dp, _, fs in os.walk(root_dir) for f in fs
+                if f.lower().endswith(".wav"))
+        self.paths = list(paths or [])
+        dirs = sorted({os.path.basename(os.path.dirname(p))
+                       for p in self.paths})
+        self.labels = list(labels) if labels is not None else dirs
+        self.frame_length = frame_length
+        self.frame_step = frame_step or frame_length
+        self.spectrogram = spectrogram
+        self.sample_rate: Optional[int] = None  # of the LAST read file
+        self._pos = 0
+
+    @staticmethod
+    def _decode(path) -> Tuple[np.ndarray, int]:
+        import wave
+        with wave.open(path, "rb") as w:
+            n = w.getnframes()
+            width = w.getsampwidth()
+            channels = w.getnchannels()
+            rate = w.getframerate()
+            raw = w.readframes(n)
+        if width == 1:       # unsigned 8-bit PCM
+            x = np.frombuffer(raw, np.uint8).astype(np.float32)
+            x = (x - 128.0) / 128.0
+        elif width == 2:     # signed 16-bit
+            x = np.frombuffer(raw, "<i2").astype(np.float32) / 32768.0
+        elif width == 4:     # signed 32-bit
+            x = np.frombuffer(raw, "<i4").astype(np.float32) / 2147483648.0
+        else:
+            raise ValueError(f"unsupported PCM sample width {width}")
+        if channels > 1:
+            x = x.reshape(-1, channels).mean(axis=1)
+        return x, rate
+
+    def _features(self, x: np.ndarray) -> np.ndarray:
+        if self.frame_length is None:
+            return x
+        fl, st = self.frame_length, self.frame_step
+        n_frames = max(0, (len(x) - fl) // st + 1)
+        idx = (np.arange(fl)[None, :] +
+               st * np.arange(n_frames)[:, None])
+        frames = x[idx] if n_frames else np.zeros((0, fl), np.float32)
+        if not self.spectrogram:
+            return frames.astype(np.float32)
+        win = np.hanning(fl).astype(np.float32)
+        return np.abs(np.fft.rfft(frames * win, axis=-1)
+                      ).astype(np.float32)
+
+    def has_next(self):
+        return self._pos < len(self.paths)
+
+    def next(self):
+        path = self.paths[self._pos]
+        self._pos += 1
+        x, rate = self._decode(path)
+        self.sample_rate = rate
+        label = os.path.basename(os.path.dirname(path))
+        idx = self.labels.index(label) if label in self.labels else -1
+        return [self._features(x), idx]
 
     def reset(self):
         self._pos = 0

@@ -77,6 +77,85 @@ class FileStatsStorage:
         return [json.loads(r[0]) for r in rows]
 
 
+class RemoteUIStatsStorageRouter:
+    """Routes StatsListener updates to a REMOTE UIServer over HTTP (ref:
+    `ui/storage/impl/RemoteUIStatsStorageRouter.java` — the worker side
+    of PlayUIServer.enableRemoteListener). Quacks like a StatsStorage
+    for the listener; each put is queued and shipped by a background
+    thread with bounded retry + backoff like the reference (async queue,
+    maxRetries, exponential delay), so a slow or briefly-down UI server
+    never blocks the training loop."""
+
+    def __init__(self, url: str, max_retries: int = 5,
+                 retry_backoff_s: float = 0.2, queue_size: int = 1024):
+        import queue
+        if url.endswith("/"):
+            url = url[:-1]
+        if not url.endswith("/remoteReceive"):
+            url = url + "/remoteReceive"
+        self.url = url
+        self.max_retries = int(max_retries)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.dropped = 0
+        self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        self._shutdown = threading.Event()
+        self._thread = threading.Thread(target=self._pump, daemon=True)
+        self._thread.start()
+
+    def put_update(self, session_id: str, update: dict):
+        if self._shutdown.is_set():
+            self.dropped += 1  # pump is gone; don't queue into the void
+            return
+        try:
+            self._q.put_nowait({"session_id": session_id,
+                                "update": update})
+        except Exception:
+            self.dropped += 1  # bounded queue: never block training
+
+    def _post(self, payload) -> bool:
+        import urllib.request
+        body = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            self.url, data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                return 200 <= resp.status < 300
+        except Exception:
+            return False
+
+    def _pump(self):
+        import queue as _queue
+        while not self._shutdown.is_set() or not self._q.empty():
+            try:
+                item = self._q.get(timeout=0.1)
+            except _queue.Empty:
+                continue
+            for attempt in range(self.max_retries):
+                if self._post(item):
+                    break
+                if attempt + 1 < self.max_retries:
+                    # no sleep after the FINAL failure, and a shutdown
+                    # interrupts the backoff so flush stays prompt
+                    self._shutdown.wait(
+                        self.retry_backoff_s * (2 ** attempt))
+            else:
+                self.dropped += 1
+
+    def shutdown(self, timeout: float = 10.0):
+        """Flush the queue and stop the pump thread."""
+        self._shutdown.set()
+        self._thread.join(timeout)
+
+    # storage-protocol stubs: a router is write-only (the reference's
+    # StatsStorageRouter is exactly the put-side interface)
+    def list_session_ids(self):
+        return []
+
+    def get_updates(self, session_id: str):
+        return []
+
+
 # ---------------------------------------------------------------------------
 # listener (ref: deeplearning4j-ui-model StatsListener.java)
 # ---------------------------------------------------------------------------
@@ -273,6 +352,7 @@ class UIServer:
 
     def __init__(self, port: int = 0):
         self.storages: List = []
+        self._remote_storage = None
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -286,6 +366,28 @@ class UIServer:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_POST(self):
+                # remote stats routing (ref: PlayUIServer.java:401
+                # enableRemoteListener + RemoteUIStatsStorageRouter):
+                # workers POST StatsListener updates to a central UI
+                if self.path == "/remoteReceive":
+                    if server._remote_storage is None:
+                        self._json({"error": "remote listener disabled "
+                                    "(call enable_remote_listener)"}, 403)
+                        return
+                    try:
+                        n = int(self.headers.get("Content-Length", 0))
+                        payload = json.loads(self.rfile.read(n).decode())
+                        sid = payload["session_id"]
+                        update = payload["update"]
+                    except Exception:
+                        self._json({"error": "bad payload"}, 400)
+                        return
+                    server._remote_storage.put_update(sid, update)
+                    self._json({"ok": True})
+                else:
+                    self._json({"error": "not found"}, 404)
 
             def do_GET(self):
                 if self.path in ("/", "/train"):
@@ -309,6 +411,19 @@ class UIServer:
                     for st in server.storages:
                         out.extend(st.get_updates(sid))
                     self._json(out)
+                elif self.path.startswith("/arbiter/"):
+                    # arbiter view (ref: ArbiterModule.java — results
+                    # table + best-score chart): serves the updates a
+                    # LocalOptimizationRunner(stats_storage=...) streams
+                    sid = self.path[len("/arbiter/"):]
+                    ups = []
+                    for st in server.storages:
+                        ups.extend(st.get_updates(sid))
+                    ups = [u for u in ups if "candidate" in u]
+                    self._json({
+                        "candidates": ups,
+                        "best_scores": [u.get("best_score") for u in ups],
+                        "scores": [u.get("score") for u in ups]})
                 elif self.path.startswith("/train/") and \
                         self.path.endswith("/model"):
                     # model tab: per-param mean-magnitude series for
@@ -350,6 +465,22 @@ class UIServer:
 
     def attach(self, storage):
         self.storages.append(storage)
+
+    def enable_remote_listener(self, storage=None):
+        """Accept POSTed stats from remote workers at /remoteReceive
+        (ref: PlayUIServer.enableRemoteListener — cluster training
+        observability: each worker routes its StatsListener through a
+        RemoteUIStatsStorageRouter pointed at this server). Returns the
+        receiver storage (attached for serving)."""
+        if storage is None:
+            storage = InMemoryStatsStorage()
+        self._remote_storage = storage
+        if storage not in self.storages:
+            self.attach(storage)
+        return storage
+
+    def disable_remote_listener(self):
+        self._remote_storage = None
 
     def detach(self, storage):
         self.storages.remove(storage)

@@ -477,10 +477,12 @@ class TestMixedPrecision:
 
 class TestPolicyCastRewrite:
     """Round-5 HLO audit fix: an explicit in-graph Cast(->float32) —
-    e.g. TF BERT's int attention-mask cast — must be re-targeted to the
-    compute dtype under mixed precision, or every downstream op
-    silently re-promotes to f32 (282/294 BERT dots measured before the
-    fix). TF auto-mixed-precision rewrites such casts identically."""
+    e.g. TF BERT's int attention-mask cast — re-promotes the downstream
+    elementwise chain to f32, which before the fix poisoned 282/294
+    BERT train dots to f32. The TF-AMP allowlist model applies instead:
+    MXU ops (blas/convo) cast their f32 inputs to the policy dtype AT
+    the op, so every dot runs bf16 while integer-valued f32 casts (e.g.
+    positional ranges > 256) keep exact f32 values."""
 
     def _graph(self):
         from deeplearning4j_tpu.autodiff.samediff import (SameDiff,
@@ -526,3 +528,37 @@ class TestPolicyCastRewrite:
                         [sd._loss_variables[0]])
         v = next(iter(out.values()))
         assert str(np.asarray(v).dtype) == "float32"
+
+    def test_integer_valued_f32_cast_stays_exact(self):
+        """Blanket cast-to-bf16 rewriting would corrupt integer-valued
+        f32 data (bf16 represents consecutive integers only to 256);
+        the allowlist model must keep e.g. positional indices exact in
+        the elementwise domain under a bf16 policy."""
+        from deeplearning4j_tpu.autodiff.samediff import (SameDiff,
+                                                          TrainingConfig)
+        from deeplearning4j_tpu.learning import Sgd
+        sd = SameDiff.create()
+        pos = sd.placeholder("pos", (None, 1))       # int positions
+        y = sd.placeholder("y", (None, 1))
+        w = sd.var("w", value=np.ones((1, 1), np.float32))
+        fpos = pos.cast("float32")                   # 0..599 exact in f32
+        pred = fpos @ w
+        loss = ((pred - y) * (pred - y)).reduce_mean()
+        sd.set_loss_variables(loss.name)
+        sd.set_training_config(TrainingConfig(
+            updater=Sgd(0.0), data_set_feature_mapping=["pos"],
+            data_set_label_mapping=["y"], compute_dtype="bfloat16"))
+        sd.initialize_training()
+        step = sd._train_step_fn()
+        import jax
+        n = 600
+        feed = {"pos": np.arange(n, dtype=np.int32)[:, None],
+                "y": np.arange(n, dtype=np.float32)[:, None]}
+        _, _, lv = step({"w": sd._values["w"]}, sd._updater_state, 0,
+                        feed, jax.random.PRNGKey(0))
+        # positions enter the dot exactly; w=1, lr=0 => loss is only the
+        # bf16 rounding of the MATMUL output, bounded by bf16 eps
+        # relative error (~0.4%) — a blanket bf16 cast of the positions
+        # themselves would alias 257/258... and inflate this by orders
+        # of magnitude on the squared-integer scale
+        assert float(lv) <= (0.004 * n) ** 2, float(lv)

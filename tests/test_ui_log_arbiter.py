@@ -1,0 +1,144 @@
+"""SameDiff UI log format + Arbiter UI routing (VERDICT r4 missing #8 —
+ref: `nd4j/.../graph/ui/LogFileWriter.java` and
+`arbiter/arbiter-ui/.../ArbiterModule.java`)."""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+from deeplearning4j_tpu.autodiff.ui_log import LogFileReader, LogFileWriter
+
+
+def _tiny_graph():
+    sd = SameDiff.create()
+    x = sd.placeholder("x", (None, 4))
+    w = sd.var("w", value=np.zeros((4, 2), np.float32))
+    (x @ w).rename("out")
+    return sd
+
+
+class TestLogFileWriter:
+    def test_two_block_format_round_trips(self, tmp_path):
+        p = str(tmp_path / "ui.log")
+        w = LogFileWriter(p)
+        w.write_graph_structure(_tiny_graph())
+        w.write_system_info({"platform": "cpu", "device_count": 1})
+        w.end_static_info()
+        for i in range(3):
+            w.write_scalar_event("loss", 1.0 / (i + 1), iteration=i,
+                                 epoch=0)
+        r = LogFileReader(p)
+        static = r.read_static()
+        types = [h["type"] for h, _ in static]
+        assert types == ["GRAPH_STRUCTURE", "SYSTEM_INFO"]
+        graph = static[0][1]
+        names = {v["name"] for v in graph["variables"]}
+        assert {"x", "w", "out"} <= names
+        assert any(o["op"] for o in graph["ops"])
+        events = r.read_events()
+        assert [c["iteration"] for _, c in events] == [0, 1, 2]
+        assert events[0][1]["name"] == "loss"
+
+    def test_static_scan_stops_at_marker(self, tmp_path):
+        """The format's purpose: reading the graph must not require
+        scanning events (ref LogFileWriter.java format comment)."""
+        p = str(tmp_path / "ui.log")
+        w = LogFileWriter(p)
+        w.write_system_info({"platform": "cpu"})
+        w.end_static_info()
+        w.write_scalar_event("score", 1.0)
+        # corrupt the events block only: static scan must still succeed
+        with open(p, "r+b") as f:
+            f.seek(-4, 2)
+            f.write(b"\xff\xff\xff\xff")
+        static = LogFileReader(p).read_static()
+        assert static[0][0]["type"] == "SYSTEM_INFO"
+
+    def test_state_machine_enforced(self, tmp_path):
+        p = str(tmp_path / "ui.log")
+        w = LogFileWriter(p)
+        with pytest.raises(ValueError, match="START_EVENTS"):
+            w.write_scalar_event("loss", 1.0)
+        w.end_static_info()
+        with pytest.raises(ValueError, match="static"):
+            w.write_system_info({})
+
+    def test_truncated_file_without_marker_raises(self, tmp_path):
+        p = str(tmp_path / "ui.log")
+        LogFileWriter(p).write_system_info({"platform": "cpu"})
+        with pytest.raises(ValueError, match="START_EVENTS"):
+            LogFileReader(p).read_static()
+
+
+class TestArbiterUI:
+    def test_runner_streams_to_dashboard(self):
+        from deeplearning4j_tpu.arbiter import (
+            ContinuousParameterSpace, GridSearchCandidateGenerator,
+            LocalOptimizationRunner, OptimizationConfiguration)
+        from deeplearning4j_tpu.ui import InMemoryStatsStorage, UIServer
+
+        storage = InMemoryStatsStorage()
+        cfg = OptimizationConfiguration(
+            GridSearchCandidateGenerator(
+                {"lr": ContinuousParameterSpace(0.01, 0.1)},
+                discretization_count=4),
+            score_function=lambda v: (v["lr"] - 0.05) ** 2,
+            minimize=True)
+        runner = LocalOptimizationRunner(cfg, stats_storage=storage,
+                                         session_id="hpo1")
+        best = runner.execute()
+        ups = storage.get_updates("hpo1")
+        assert len(ups) == 4
+        assert [u["candidate"] for u in ups] == [0, 1, 2, 3]
+        # best_score is the running minimum
+        bs = [u["best_score"] for u in ups]
+        assert bs == sorted(bs, reverse=True)
+        assert ups[0]["parameters"]["lr"] == pytest.approx(0.01)
+
+        server = UIServer(port=0)
+        try:
+            server.attach(storage)
+            got = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/arbiter/hpo1",
+                timeout=10).read())
+            assert len(got["candidates"]) == 4
+            assert got["best_scores"][-1] == pytest.approx(
+                best.score, abs=1e-9)
+        finally:
+            server.stop()
+
+
+class TestLogResume:
+    def test_reopen_appends_events_only(self, tmp_path):
+        """Append-only resume: a second writer on an existing log may
+        only add events — a second static block would corrupt the
+        two-block scan format."""
+        p = str(tmp_path / "ui.log")
+        w1 = LogFileWriter(p)
+        w1.write_system_info({"platform": "cpu"})
+        w1.end_static_info()
+        w1.write_scalar_event("loss", 1.0, iteration=0)
+        w2 = LogFileWriter(p)          # resume
+        with pytest.raises(ValueError, match="static"):
+            w2.write_graph_structure(_tiny_graph())
+        w2.write_scalar_event("loss", 0.5, iteration=1)
+        r = LogFileReader(p)
+        assert len(r.read_static()) == 1
+        assert [c["iteration"] for _, c in r.read_events()] == [0, 1]
+
+    def test_reopen_of_markerless_file_refuses(self, tmp_path):
+        p = str(tmp_path / "ui.log")
+        LogFileWriter(p).write_system_info({"platform": "cpu"})
+        with pytest.raises(ValueError, match="refusing to append"):
+            LogFileWriter(p)
+
+
+def test_router_counts_drops_after_shutdown():
+    from deeplearning4j_tpu.ui import RemoteUIStatsStorageRouter
+    r = RemoteUIStatsStorageRouter("http://127.0.0.1:1", max_retries=1,
+                                   retry_backoff_s=0.01)
+    r.shutdown()
+    r.put_update("s", {"iteration": 0})
+    assert r.dropped >= 1

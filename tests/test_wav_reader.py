@@ -1,0 +1,97 @@
+"""WAV audio record reader (VERDICT r4 #9 — ref:
+datavec-data-audio/.../WavFileRecordReader.java + the audio feature
+tier). Fixtures are synthesized in-test with stdlib `wave` (sine vs
+square tones under class-named directories)."""
+import os
+import struct
+import wave
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.etl import WavFileRecordReader
+
+
+def _write_wav(path, signal, rate=8000, width=2, channels=1):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    sig = np.clip(signal, -1.0, 1.0)
+    if channels > 1:
+        sig = np.stack([sig] * channels, axis=1).ravel()
+    if width == 2:
+        data = (sig * 32767).astype("<i2").tobytes()
+    elif width == 1:
+        data = ((sig * 127) + 128).astype(np.uint8).tobytes()
+    else:
+        data = (sig * (2 ** 31 - 1)).astype("<i4").tobytes()
+    with wave.open(path, "wb") as w:
+        w.setnchannels(channels)
+        w.setsampwidth(width)
+        w.setframerate(rate)
+        w.writeframes(data)
+
+
+@pytest.fixture()
+def wav_root(tmp_path):
+    t = np.arange(800) / 8000.0
+    _write_wav(str(tmp_path / "sine" / "a.wav"), np.sin(2 * np.pi * 440 * t))
+    _write_wav(str(tmp_path / "sine" / "b.wav"), np.sin(2 * np.pi * 220 * t))
+    _write_wav(str(tmp_path / "square" / "c.wav"),
+               np.sign(np.sin(2 * np.pi * 440 * t)))
+    return str(tmp_path)
+
+
+class TestWavFileRecordReader:
+    def test_whole_file_records_with_dir_labels(self, wav_root):
+        r = WavFileRecordReader(root_dir=wav_root)
+        recs = list(r)
+        assert len(recs) == 3
+        assert r.labels == ["sine", "square"]
+        sig, label = recs[0]
+        assert sig.dtype == np.float32 and sig.shape == (800,)
+        assert label == 0
+        assert recs[2][1] == 1          # square/c.wav
+        assert r.sample_rate == 8000
+        assert float(np.abs(sig).max()) <= 1.0
+        # 16-bit round trip of a 440 Hz sine is accurate to ~1e-4
+        t = np.arange(800) / 8000.0
+        np.testing.assert_allclose(sig, np.sin(2 * np.pi * 440 * t),
+                                   atol=1e-3)
+
+    def test_8bit_and_stereo_mixdown(self, tmp_path):
+        t = np.arange(400) / 8000.0
+        s = 0.5 * np.sin(2 * np.pi * 100 * t)
+        _write_wav(str(tmp_path / "x" / "m.wav"), s, width=1)
+        _write_wav(str(tmp_path / "x" / "s.wav"), s, channels=2)
+        r = WavFileRecordReader(root_dir=str(tmp_path))
+        (m, _), (st, _) = list(r)
+        assert m.shape == st.shape == (400,)
+        np.testing.assert_allclose(m, s, atol=1.5 / 127)
+        np.testing.assert_allclose(st, s, atol=1e-3)
+
+    def test_windowed_frames(self, wav_root):
+        r = WavFileRecordReader(root_dir=wav_root, frame_length=128,
+                                frame_step=64)
+        frames, _ = r.next()
+        assert frames.shape == ((800 - 128) // 64 + 1, 128)
+        # frames overlap: second frame starts 64 samples in
+        sig = WavFileRecordReader(root_dir=wav_root).next()[0]
+        np.testing.assert_allclose(frames[1], sig[64:192], atol=1e-6)
+
+    def test_spectrogram_peaks_at_tone_bin(self, wav_root):
+        r = WavFileRecordReader(root_dir=wav_root, frame_length=256,
+                                frame_step=128, spectrogram=True)
+        spec, label = r.next()          # sine/a.wav, 440 Hz @ 8 kHz
+        assert spec.shape[1] == 129
+        peak_bin = int(np.argmax(spec.mean(axis=0)))
+        expect = round(440 * 256 / 8000)
+        assert abs(peak_bin - expect) <= 1, (peak_bin, expect)
+
+    def test_reset_and_transform_pipeline(self, wav_root):
+        r = WavFileRecordReader(root_dir=wav_root, frame_length=64)
+        n1 = len(list(r))
+        n2 = len(list(r))               # __iter__ resets
+        assert n1 == n2 == 3
+
+    def test_spectrogram_requires_frame_length(self):
+        with pytest.raises(ValueError, match="frame_length"):
+            WavFileRecordReader(paths=[], spectrogram=True)
