@@ -534,6 +534,26 @@ def main():
             extras["etl_pipeline"] = {k: etl[k] for k in
                                       ("rows_per_sec", "rows",
                                        "wall_seconds") if k in etl}
+    # static cost model (tools/perf_audit.py — chip-independent): the
+    # roofline predictions the measured numbers are judged against
+    # (VERDICT r4 #2). Committed JSON, so this costs no compile time.
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "tools", "perf_audit.json")) as f:
+            audit = json.load(f)
+        extras["cost_model"] = {
+            m["model"]: {"flops": m["flops"],
+                         "roofline_ms_v5e_bf16": m["roofline_ms_v5e_bf16"],
+                         "pred_samples_per_sec_at_40pct_mfu":
+                             m["pred_throughput_at_40pct_mfu"],
+                         "stablehlo_dots": m["stablehlo_dtypes"]
+                             .get("by_dtype")}
+            for m in audit.get("models", [])}
+    except Exception as e:
+        # missing/stale audit file: keep the bench line flowing, but
+        # say so — silently dropping the prediction table would unmoor
+        # the measured numbers from their judging baseline
+        print(f"cost_model unavailable: {e!r}", file=sys.stderr)
     # physics gates — hard-fail rather than publish impossible numbers
     measured = [("headline", res if not fallback else None),
                 ("resnet50_b128", r128)]
