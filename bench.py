@@ -541,14 +541,19 @@ def main():
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "tools", "perf_audit.json")) as f:
             audit = json.load(f)
-        extras["cost_model"] = {
-            m["model"]: {"flops": m["flops"],
-                         "roofline_ms_v5e_bf16": m["roofline_ms_v5e_bf16"],
-                         "pred_samples_per_sec_at_40pct_mfu":
-                             m["pred_throughput_at_40pct_mfu"],
-                         "stablehlo_dots": m["stablehlo_dtypes"]
-                             .get("by_dtype")}
-            for m in audit.get("models", [])}
+        cm = {}
+        for m in audit.get("models", []):
+            try:  # keep valid rows even if one model record is stale
+                cm[m["model"]] = {
+                    "flops": m["flops"],
+                    "roofline_ms_v5e_bf16": m["roofline_ms_v5e_bf16"],
+                    "pred_samples_per_sec_at_40pct_mfu":
+                        m["pred_throughput_at_40pct_mfu"],
+                    "stablehlo_dots": m["stablehlo_dtypes"]
+                        .get("by_dtype")}
+            except Exception as e:
+                print(f"cost_model row skipped: {e!r}", file=sys.stderr)
+        extras["cost_model"] = cm
     except Exception as e:
         # missing/stale audit file: keep the bench line flowing, but
         # say so — silently dropping the prediction table would unmoor

@@ -1,6 +1,11 @@
 """Bidirectional-LSTM sequence classification over token embeddings
 (ref: dl4j-examples RNN text classification family).
 Run: python examples/bilstm_text_classification.py"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 from deeplearning4j_tpu.learning import Adam

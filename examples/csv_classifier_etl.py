@@ -4,6 +4,10 @@ dl4j-examples CSVExample + the DataVec pipeline). All-numeric CSVs take
 the native C parser fast path automatically.
 Run: python examples/csv_classifier_etl.py"""
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import tempfile
 
 import numpy as np

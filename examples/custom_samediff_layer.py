@@ -4,6 +4,11 @@ network (ref: dl4j-examples samediff custom-layer examples /
 traced once and inlined into the network's single jitted train step —
 a custom SameDiff layer costs the same as a built-in one.
 Run: python examples/custom_samediff_layer.py"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 from deeplearning4j_tpu.learning import Adam

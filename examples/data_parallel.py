@@ -6,6 +6,11 @@ gradient-sharing examples). On a CPU host, run under the virtual mesh:
   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python examples/data_parallel.py
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 from deeplearning4j_tpu.datasets import ArrayDataSetIterator

@@ -10,6 +10,11 @@ slice would take:
 Run: JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
      XLA_FLAGS=--xla_force_host_platform_device_count=8 \
      python examples/distributed_transformer_4d.py"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 from deeplearning4j_tpu.parallel.transformer import (DistributedTransformer,

@@ -1,5 +1,10 @@
 """DQN on CartPole (ref: rl4j-examples CartpoleDQN).
 Run: python examples/dqn_cartpole.py"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 from deeplearning4j_tpu.learning import Adam

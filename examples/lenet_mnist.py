@@ -1,5 +1,10 @@
 """LeNet on MNIST — BASELINE config 1, the reference's canonical starter
 (ref: dl4j-examples LenetMnistExample). Run: python examples/lenet_mnist.py"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 from deeplearning4j_tpu.datasets import MnistDataSetIterator

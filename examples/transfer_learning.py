@@ -1,6 +1,11 @@
 """Transfer learning: train a base net, freeze the features, retrain a
 new head (ref: dl4j-examples TransferLearning examples).
 Run: python examples/transfer_learning.py"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 from deeplearning4j_tpu.learning import Adam, Sgd

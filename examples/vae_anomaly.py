@@ -1,6 +1,11 @@
 """VAE anomaly detection — unsupervised pretraining, then score samples
 by reconstruction error (ref: dl4j-examples VaeMNISTAnomaly).
 Run: python examples/vae_anomaly.py"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 import jax.numpy as jnp
 

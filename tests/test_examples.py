@@ -10,7 +10,8 @@ sys.path.insert(0, EX_DIR)
 _COVERED = {"lenet_mnist", "vae_anomaly", "bilstm_text_classification",
             "data_parallel", "dqn_cartpole", "transfer_learning",
             "custom_samediff_layer", "csv_classifier_etl",
-            "distributed_transformer_4d"}
+            "distributed_transformer_4d", "remote_training_dashboard",
+            "audio_classification_wav"}
 
 
 def test_every_example_has_a_test():
@@ -73,3 +74,15 @@ def test_distributed_transformer_4d():
     import distributed_transformer_4d
     drop = distributed_transformer_4d.main(quick=True)
     assert drop > 0.1   # quick mode: loss moves on the 4D mesh
+
+
+def test_remote_training_dashboard():
+    import remote_training_dashboard
+    n_updates, n_cands = remote_training_dashboard.main(quick=True)
+    assert n_updates >= 1 and n_cands == 3
+
+
+def test_audio_classification_wav():
+    import audio_classification_wav
+    acc = audio_classification_wav.main(quick=True)
+    assert acc > 0.7
