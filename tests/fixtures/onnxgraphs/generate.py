@@ -160,6 +160,52 @@ class _LNMultiAxis(torch.nn.Module):
         return torch.relu(self.ln(x)) + 0.5
 
 
+class _ResBlock(torch.nn.Module):
+    def __init__(self, cin, cout, stride=1):
+        super().__init__()
+        self.c1 = torch.nn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+        self.b1 = torch.nn.BatchNorm2d(cout)
+        self.c2 = torch.nn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+        self.b2 = torch.nn.BatchNorm2d(cout)
+        self.down = None
+        if stride != 1 or cin != cout:
+            self.down = torch.nn.Sequential(
+                torch.nn.Conv2d(cin, cout, 1, stride, bias=False),
+                torch.nn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        idn = x if self.down is None else self.down(x)
+        y = torch.relu(self.b1(self.c1(x)))
+        y = self.b2(self.c2(y))
+        return torch.relu(y + idn)
+
+
+class _ZooResNetMini(torch.nn.Module):
+    """Whole-architecture ONNX case (the ONNX analogue of the TF
+    corpus's frozen-MobileNet zoo case): a true ResNet — stem, three
+    residual stages with downsampling + projection shortcuts, global
+    average pool, fc classifier. The exported graph carries 9 Convs,
+    residual Adds, ReduceMean pooling, Gemm and Softmax; the BNs are
+    FOLDED into the convs by torch's eval-mode exporter (so this case
+    covers deep conv/residual topology, not BatchNormalization import —
+    the TF corpus's cnn/zoo cases cover live BN)."""
+
+    def __init__(self, classes=7):
+        super().__init__()
+        self.stem = torch.nn.Conv2d(3, 16, 3, 1, 1, bias=False)
+        self.bn = torch.nn.BatchNorm2d(16)
+        self.s1 = _ResBlock(16, 16)
+        self.s2 = _ResBlock(16, 32, stride=2)
+        self.s3 = _ResBlock(32, 64, stride=2)
+        self.fc = torch.nn.Linear(64, classes)
+
+    def forward(self, x):
+        y = torch.relu(self.bn(self.stem(x)))
+        y = self.s3(self.s2(self.s1(y)))
+        y = y.mean(dim=(2, 3))
+        return torch.softmax(self.fc(y), dim=-1)
+
+
 FIXTURES = [
     ("mlp_softmax", _GemmChain(), [(3, 6)]),
     ("mlp_deep", _MLPDeep(), [(4, 8)]),
@@ -173,6 +219,7 @@ FIXTURES = [
     ("pad_slice_split", _PadSliceSplit(), [(4, 6)]),
     ("deconv_prelu", _Deconv(), [(2, 3, 5, 5)]),
     ("ln_multiaxis", _LNMultiAxis(), [(2, 4, 6)]),
+    ("zoo_resnet_mini", _ZooResNetMini(), [(2, 3, 32, 32)]),
 ]
 
 
