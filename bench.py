@@ -265,8 +265,11 @@ from deeplearning4j_tpu.kernels import flash_attention
 from deeplearning4j_tpu.parallel.longseq import dot_product_attention
 
 B, H, D = 4, 8, 64
+# T list overridable for the CPU harness smoke (tiny sizes): the sweep
+# itself must be known-good BEFORE the first real chip window
+Ts = tuple(int(t) for t in sys.argv[1:]) or (512, 2048, 8192)
 results = {}
-for T in (512, 2048, 8192):
+for T in Ts:
     rs = np.random.RandomState(0)
     q = jnp.asarray(rs.rand(B, T, H, D).astype(np.float32)) * 0.1
     k = jnp.asarray(rs.rand(B, T, H, D).astype(np.float32)) * 0.1

@@ -1,0 +1,39 @@
+"""Bench-harness smoke (round 5): the flash-vs-XLA attention sweep only
+executes when the TPU tunnel is alive, so a harness bug would burn the
+first (rare) chip window. Validate the sweep code itself on CPU at tiny
+sizes — Pallas runs in interpret mode here, so timings are meaningless
+but every code path (flash/xla, masked/unmasked, grad chain, JSON
+emission) must complete."""
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def test_attention_sweep_harness_runs_on_cpu():
+    import bench
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable, "-c", bench.ATTENTION_CODE, "64"],
+                       capture_output=True, text=True, timeout=600,
+                       env=env, cwd=ROOT)
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = [l for l in r.stdout.splitlines() if l.startswith("{")][-1]
+    res = json.loads(line)["results"]
+    expected = {"T64_flash", "T64_xla", "T64_flash_masked",
+                "T64_xla_masked"}
+    assert set(res) == expected, res
+    for k, v in res.items():
+        assert isinstance(v, float), f"{k} did not produce a timing: {v}"
+
+
+def test_probe_code_is_platform_gated():
+    """bench's liveness probe must not count a CPU fallback as a live
+    TPU (the round-4 bug class)."""
+    import bench
+    assert '128.0 ** 3' in bench.PROBE_CODE
+    src = open(os.path.join(ROOT, "bench.py")).read()
+    assert '"tpu", "axon"' in src or "('tpu', 'axon')" in src
