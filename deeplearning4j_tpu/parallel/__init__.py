@@ -63,8 +63,25 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def batch_sharded(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P("data"))
+def batch_sharded(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    return NamedSharding(mesh, P(axis))
+
+
+def jit_sharded_step(model, mesh: Mesh, axis: str = "data"):
+    """THE data-parallel jit contract for a model training step —
+    params/opt/net state replicated (and donated), batch sharded over
+    `axis`. Single definition shared by ParallelWrapper (single-host)
+    and parallel.multihost (cross-process mesh) so the step-fn
+    signature's sharding map lives in exactly one place."""
+    if model._params is None:
+        model.init()
+    repl = replicated(mesh)
+    data = batch_sharded(mesh, axis)
+    return jax.jit(
+        model._make_step_fn(),
+        in_shardings=(repl, repl, repl, repl, data, data, None, repl),
+        out_shardings=(repl, repl, repl, None),
+        donate_argnums=(0, 1, 2))
 
 
 class GradientSharingAccumulator:
@@ -191,14 +208,7 @@ class ParallelWrapper:
         if self.accumulator is not None:
             self._sharded_step = self._build_compressed_step()
             return
-        repl = replicated(self.mesh)
-        data = batch_sharded(self.mesh)
-        self._sharded_step = jax.jit(
-            m._make_step_fn(),
-            in_shardings=(repl, repl, repl, repl, data, data, None, repl),
-            out_shardings=(repl, repl, repl, None),
-            donate_argnums=(0, 1, 2),
-        )
+        self._sharded_step = jit_sharded_step(m, self.mesh)
 
     def _build_compressed_step(self):
         """Compile the gradient-sharing step with the reference's

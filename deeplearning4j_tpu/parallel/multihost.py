@@ -1,0 +1,56 @@
+"""Multi-host (multi-process) training helpers (ref: §5.8 — the role
+Spark's distributed fit plays for the reference; here the PJRT
+distributed runtime + jax global arrays over a cross-process mesh).
+
+After `elastic.initialize_cluster(...)`, every process sees the GLOBAL
+device set; a `Mesh` over `jax.devices()` then spans processes, and a
+jitted step with sharded inputs runs one SPMD program across all hosts
+— XLA inserts the cross-host collectives (Gloo on CPU, ICI/DCN on TPU
+pods). The only extra ingredient over single-host `ParallelWrapper` is
+building GLOBAL arrays from per-process local shards, which is what
+these helpers do.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def global_mesh(axis: str = "data") -> Mesh:
+    """1-D mesh over the GLOBAL device set (call after
+    initialize_cluster; every process must construct it identically)."""
+    return Mesh(np.array(jax.devices()), (axis,))
+
+
+def host_local_array(mesh: Mesh, spec: P, local: np.ndarray,
+                     global_shape: Optional[Tuple[int, ...]] = None):
+    """Build a global sharded array from THIS process's shard (the
+    multi-host input pipeline: each process loads only its rows).
+
+    `local` is this process's slice along the sharded axis; the global
+    shape defaults to scaling axis 0 by the process count."""
+    if global_shape is None:
+        global_shape = (local.shape[0] * jax.process_count(),
+                        *local.shape[1:])
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, spec), local, global_shape)
+
+
+def replicated_array(mesh: Mesh, value):
+    """Place a value (array or pytree — params / optimizer state)
+    replicated on every device of the global mesh."""
+    return jax.device_put(value, NamedSharding(mesh, P()))
+
+
+def build_multihost_step(model, mesh: Mesh, axis: str = "data"):
+    """Jit the model's training step over the cross-process mesh —
+    the multi-host `ParallelWrapper._build_step`. Feed it arrays built
+    with `host_local_array` / `replicated_array`. Every process calls
+    the step with the same global values; the compiled program runs
+    SPMD across all hosts. The sharding contract is the single shared
+    `parallel.jit_sharded_step` definition."""
+    from . import jit_sharded_step
+    return jit_sharded_step(model, mesh, axis)
