@@ -12,7 +12,7 @@ these helpers do.
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Tuple
 
 import jax
 import numpy as np
@@ -28,13 +28,22 @@ def global_mesh(axis: str = "data") -> Mesh:
 def host_local_array(mesh: Mesh, spec: P, local: np.ndarray,
                      global_shape: Optional[Tuple[int, ...]] = None):
     """Build a global sharded array from THIS process's shard (the
-    multi-host input pipeline: each process loads only its rows).
+    multi-host input pipeline: each process loads only its slice).
 
-    `local` is this process's slice along the sharded axis; the global
-    shape defaults to scaling axis 0 by the process count."""
+    `local` is this process's slice along the sharded axis. The default
+    global shape scales the axis the spec actually shards by the
+    process count (pass `global_shape` explicitly for layouts the
+    default cannot infer, e.g. multi-axis sharding)."""
     if global_shape is None:
-        global_shape = (local.shape[0] * jax.process_count(),
-                        *local.shape[1:])
+        sharded_axes = [i for i, s in enumerate(spec) if s is not None]
+        if len(sharded_axes) != 1:
+            raise ValueError(
+                f"cannot infer global_shape for spec {spec}: exactly "
+                "one sharded axis expected — pass global_shape")
+        ax = sharded_axes[0]
+        global_shape = tuple(
+            d * jax.process_count() if i == ax else d
+            for i, d in enumerate(local.shape))
     return jax.make_array_from_process_local_data(
         NamedSharding(mesh, spec), local, global_shape)
 

@@ -5,11 +5,10 @@ localhost). Coordinator + one worker on CPU; both must see the GLOBAL
 device set — the framework's one multi-host entry point actually
 executes."""
 import os
-import socket
-import subprocess
 import sys
 
-ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _mp_util import run_two_process
 
 WORKER = """
 import sys
@@ -23,42 +22,10 @@ print("RESULT", {pid}, ok, jax.process_count(), jax.local_device_count(),
 """
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
 def test_two_process_cluster_sees_global_devices():
-    addr = f"127.0.0.1:{_free_port()}"
-    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
-    env.pop("XLA_FLAGS", None)  # 1 CPU device per process
-    procs = [subprocess.Popen(
-        [sys.executable, "-c",
-         WORKER.format(root=ROOT, addr=addr, pid=pid)],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        env=env) for pid in (0, 1)]
-    outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=240)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        outs.append((p.returncode, out, err))
-    for rc, out, err in outs:
-        assert rc == 0, (out, err[-2000:])
-    results = {}
-    for rc, out, err in outs:
-        for line in out.splitlines():
-            if line.startswith("RESULT"):
-                _, pid, ok, nproc, local, glob = line.split()
-                results[int(pid)] = (ok, int(nproc), int(local),
-                                     int(glob))
-    assert set(results) == {0, 1}, results
+    raw = run_two_process(WORKER, timeout=240)
+    results = {pid: (v[0], int(v[1]), int(v[2]), int(v[3]))
+               for pid, v in raw.items()}
     for pid, (ok, nproc, local, glob) in results.items():
         assert ok == "True"
         assert nproc == 2, results

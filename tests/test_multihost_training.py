@@ -9,13 +9,12 @@ parameter-averaging master (`SharedTrainingMaster`); here one compiled
 program over a cross-process mesh (Gloo collectives on CPU, ICI/DCN on
 TPU pods)."""
 import os
-import socket
-import subprocess
 import sys
 
 import numpy as np
 
-ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _mp_util import ROOT, run_two_process
 
 WORKER = """
 import sys
@@ -65,14 +64,6 @@ print("LOSSES", {pid}, jax.process_count(),
 """
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
 def _single_process_reference():
     """Same seeded model + same GLOBAL batch on one process."""
     import jax
@@ -103,35 +94,9 @@ def _single_process_reference():
 
 
 def test_two_process_training_matches_single_process():
-    addr = f"127.0.0.1:{_free_port()}"
-    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
-    env.pop("XLA_FLAGS", None)  # 1 device per process -> 2 global
-    procs = [subprocess.Popen(
-        [sys.executable, "-c", WORKER.format(root=ROOT, addr=addr,
-                                             pid=pid)],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        env=env) for pid in (0, 1)]
-    outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        outs.append((p.returncode, out, err))
-    for rc, out, err in outs:
-        assert rc == 0, (out, err[-3000:])
-    results = {}
-    for rc, out, err in outs:
-        for line in out.splitlines():
-            if line.startswith("LOSSES"):
-                parts = line.split()
-                results[int(parts[1])] = (int(parts[2]),
-                                          [float(v) for v in parts[3:]])
-    assert set(results) == {0, 1}, outs
-    nproc0, losses0 = results[0]
-    nproc1, losses1 = results[1]
+    results = run_two_process(WORKER, marker="LOSSES")
+    nproc0, losses0 = int(results[0][0]), [float(v) for v in results[0][1:]]
+    nproc1, losses1 = int(results[1][0]), [float(v) for v in results[1][1:]]
     assert nproc0 == nproc1 == 2
     # both processes observed the identical global loss trajectory
     np.testing.assert_allclose(losses0, losses1, rtol=0, atol=1e-7)
@@ -140,3 +105,63 @@ def test_two_process_training_matches_single_process():
     np.testing.assert_allclose(losses0, ref, atol=1e-5)
     # the model actually learned across the two hosts
     assert losses0[-1] < losses0[0]
+
+
+COMP_WORKER = """
+import sys
+sys.path.insert(0, {root!r})
+import numpy as np
+from deeplearning4j_tpu.parallel.elastic import initialize_cluster
+initialize_cluster(coordinator_address={addr!r}, num_processes=2,
+                   process_id={pid})
+import jax, jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from deeplearning4j_tpu.parallel import (GradientSharingAccumulator,
+                                         ParallelWrapper)
+from deeplearning4j_tpu.parallel.multihost import (host_local_array,
+                                                   replicated_array)
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-2))
+        .weight_init("xavier").list()
+        .layer(DenseLayer(n_out=16, activation="tanh"))
+        .layer(OutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+        .input_type_feed_forward(4).build())
+m = MultiLayerNetwork(conf).init()
+mesh = Mesh(np.asarray(jax.devices()).reshape(2, 1), ("data", "model"))
+acc = GradientSharingAccumulator(threshold=1e-3)
+pw = ParallelWrapper(m, mesh=mesh, prefetch_buffer=0, accumulator=acc)
+pw._build_step()
+rs = np.random.RandomState(0)
+X = (rs.rand(16, 4) * 2 - 1).astype(np.float32)
+Y = np.eye(2, dtype=np.float32)[(X.sum(-1) > 0).astype(int)]
+lo = {pid} * 8
+x = host_local_array(mesh, P("data"), X[lo:lo + 8])
+y = host_local_array(mesh, P("data"), Y[lo:lo + 8])
+params = replicated_array(mesh, m._params)
+opt = replicated_array(mesh, m._opt_state)
+net = replicated_array(mesh, m._net_state)
+rng = jax.random.PRNGKey(0)
+losses = []
+with mesh:
+    for i in range(4):
+        params, opt, net, loss = pw._sharded_step(
+            params, opt, net, jnp.asarray(i), x, y, None, rng)
+        losses.append(float(loss))
+print("COMP_LOSSES", {pid},
+      " ".join(f"{{l:.6f}}" for l in losses), flush=True)
+"""
+
+
+def test_two_process_compressed_bus_runs_and_agrees():
+    """The Strom-compression stack (the reference's DCN/parameter-server
+    role) executing over REAL cross-process collectives: residual carry
+    + threshold firing + pmean sharing inside one SPMD program spanning
+    two processes, both observing the identical loss trajectory."""
+    results = run_two_process(COMP_WORKER, marker="COMP_LOSSES")
+    l0 = [float(v) for v in results[0]]
+    l1 = [float(v) for v in results[1]]
+    np.testing.assert_allclose(l0, l1, rtol=0, atol=1e-7)
+    assert l0[-1] < l0[0]  # it learns across hosts
