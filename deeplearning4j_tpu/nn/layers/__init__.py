@@ -527,24 +527,33 @@ class BatchNormalization(Layer):
 
     def apply(self, params, x, state, train, rng):
         axes = tuple(range(x.ndim - 1))
-        # statistics in f32 even under a bf16 compute policy: batch
-        # mean/var over ~1e5 elements loses real precision in bf16, and
-        # the running stats (state) are always f32
-        xs = x.astype(jnp.float32)
+        # statistics in AT LEAST f32 even under a bf16 compute policy:
+        # batch mean/var over ~1e5 elements loses real precision in
+        # bf16, and the running stats (state) are f32. promote_types
+        # keeps f64 inputs in f64 (x64 mode) instead of truncating
+        xs = x.astype(jnp.promote_types(x.dtype, jnp.float32))
         if train:
             mean = jnp.mean(xs, axis=axes)
             var = jnp.var(xs, axis=axes)
+            # running stats keep THEIR dtype (f32 checkpoint contract):
+            # promoting the carried state with an f64 input would change
+            # the net-state pytree dtype mid-training (scan carries and
+            # donated buffers would mismatch)
             new_state = {
-                "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
-                "var": self.decay * state["var"] + (1 - self.decay) * var,
+                "mean": (self.decay * state["mean"] +
+                         (1 - self.decay) * mean
+                         ).astype(state["mean"].dtype),
+                "var": (self.decay * state["var"] +
+                        (1 - self.decay) * var
+                        ).astype(state["var"].dtype),
             }
         else:
             mean, var = state["mean"], state["var"]
             new_state = state
         xn = (xs - mean) * jax.lax.rsqrt(var + self.eps)
         if not self.lock_gamma_beta:
-            xn = xn * params["gamma"].astype(jnp.float32) \
-                + params["beta"].astype(jnp.float32)
+            xn = xn * params["gamma"].astype(xs.dtype) \
+                + params["beta"].astype(xs.dtype)
         return self.activation(xn).astype(x.dtype), new_state
 
     def _extra_json(self):
