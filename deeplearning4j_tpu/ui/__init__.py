@@ -234,6 +234,9 @@ h3{margin-bottom:4px}#sys{font-size:13px;color:#444}</style></head><body>
 <canvas id=ratio width=800 height=160></canvas>
 <h3>Latest parameter histogram</h3>
 <canvas id=hist width=800 height=160></canvas>
+<h3>Arbiter: candidate scores (blue) / best-so-far (green)</h3>
+<canvas id=arb width=800 height=160></canvas>
+<pre id=arbt style="font-size:12px"></pre>
 <h3>System</h3><pre id=sys></pre>
 <script>
 let CUR = null, PARAM = null;
@@ -304,6 +307,25 @@ async function draw(){
     }
     const h = (m.histograms||{})[PARAM];
     if (h) bars('hist', h.counts, h.min, h.max);
+  }
+  // arbiter view (ArbiterModule role): same session id namespace
+  const a = await (await fetch('/arbiter/'+CUR)).json();
+  if (a.candidates && a.candidates.length){
+    const idx = a.candidates.map(c=>c.candidate);
+    line('arb', idx, a.scores, '#2060c0');
+    line('arb', idx, a.best_scores, '#208040', false);
+    // best_score already encodes the runner's minimize/maximize
+    // direction: the best candidate is the one whose score equals the
+    // final best-so-far value
+    const target = a.best_scores[a.best_scores.length-1];
+    const best = a.candidates.find(c=>c.score===target) || a.candidates[0];
+    document.getElementById('arbt').textContent =
+      'best candidate #' + best.candidate + ': score ' + best.score +
+      '  params ' + JSON.stringify(best.parameters);
+  } else {
+    const c = document.getElementById('arb').getContext('2d');
+    c.clearRect(0,0,c.canvas.width,c.canvas.height);
+    document.getElementById('arbt').textContent = '';
   }
 }
 sessions(); setInterval(sessions, 5000);
