@@ -449,6 +449,16 @@ class ParallelWrapper:
         if (self.prefetch_buffer and isinstance(iterator, DataSetIterator)
                 and not isinstance(iterator, AsyncDataSetIterator)):
             iterator = AsyncDataSetIterator(iterator, prefetch=self.prefetch_buffer)
+        if jax.process_count() > 1:
+            # multi-host: each process's iterator yields its OWN shard
+            # of every global batch; assemble global sharded arrays.
+            # Only DataSetIterator inputs auto-wrap (lists/generators
+            # lack the reset protocol the wrapper needs — pass a real
+            # iterator or a pre-built MultiHostIterator for those)
+            from .multihost import MultiHostIterator
+            if (isinstance(iterator, DataSetIterator)
+                    and not isinstance(iterator, MultiHostIterator)):
+                iterator = MultiHostIterator(iterator, self.mesh)
         prev_step = m._jit_step
         m._jit_step = self._sharded_step
         try:

@@ -54,6 +54,44 @@ def replicated_array(mesh: Mesh, value):
     return jax.device_put(value, NamedSharding(mesh, P()))
 
 
+from ..datasets import DataSetIterator as _DataSetIterator
+
+
+class MultiHostIterator(_DataSetIterator):
+    """Adapts a per-process DataSetIterator for cross-process training:
+    each process's iterator yields ITS shard of every global batch (the
+    standard multi-host input pipeline — every process loads different
+    rows), and this wrapper assembles the global sharded arrays the
+    compiled step consumes. All processes must step their iterators in
+    lockstep (same number of batches per epoch).
+
+    `ParallelWrapper.fit` applies it automatically when
+    `jax.process_count() > 1` (the base-class protocol supplies
+    __iter__/__next__)."""
+
+    def __init__(self, base, mesh: Mesh, axis: str = "data"):
+        self.base = base
+        self.mesh = mesh
+        self.axis = axis
+
+    def _to_global(self, arr):
+        return host_local_array(self.mesh, P(self.axis), np.asarray(arr))
+
+    def has_next(self):
+        return self.base.has_next()
+
+    def next(self):
+        b = self.base.next()
+        return tuple(self._to_global(v) if v is not None else None
+                     for v in b)
+
+    def reset(self):
+        self.base.reset()
+
+    def batch_size(self):
+        return self.base.batch_size() * jax.process_count()
+
+
 def build_multihost_step(model, mesh: Mesh, axis: str = "data"):
     """Jit the model's training step over the cross-process mesh —
     the multi-host `ParallelWrapper._build_step`. Feed it arrays built

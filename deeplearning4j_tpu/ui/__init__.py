@@ -240,13 +240,14 @@ h3{margin-bottom:4px}#sys{font-size:13px;color:#444}</style></head><body>
 <h3>System</h3><pre id=sys></pre>
 <script>
 let CUR = null, PARAM = null;
-function line(cv, xs, ys, color, clear=true){
+function line(cv, xs, ys, color, clear=true, yr=null){
   const c = document.getElementById(cv).getContext('2d');
   const W = c.canvas.width, H = c.canvas.height;
   if (clear) c.clearRect(0,0,W,H);
   if (!xs.length) return;
-  const xmax = Math.max(...xs), ymax = Math.max(...ys),
-        ymin = Math.min(...ys);
+  const xmax = Math.max(...xs),
+        ymax = yr ? yr[1] : Math.max(...ys),
+        ymin = yr ? yr[0] : Math.min(...ys);
   c.beginPath();
   xs.forEach((x,i)=>{const px = 10+(W-20)*x/Math.max(xmax,1);
     const py = H-10-(H-20)*(ys[i]-ymin)/Math.max(ymax-ymin,1e-12);
@@ -284,7 +285,10 @@ async function sessions(){
 async function draw(){
   if (!CUR) return;
   const u = await (await fetch('/train/'+CUR+'/overview')).json();
-  line('score', u.map(p=>p.iteration), u.map(p=>p.score), '#2060c0');
+  // arbiter candidate updates share the session stream; keep them off
+  // the training score chart
+  const tr = u.filter(p=>!('candidate' in p));
+  line('score', tr.map(p=>p.iteration), tr.map(p=>p.score), '#2060c0');
   const m = await (await fetch('/train/'+CUR+'/model')).json();
   const names = m.params ? Object.keys(m.params) : [];
   const psel = document.getElementById('param');
@@ -308,17 +312,24 @@ async function draw(){
     const h = (m.histograms||{})[PARAM];
     if (h) bars('hist', h.counts, h.min, h.max);
   }
-  // arbiter view (ArbiterModule role): same session id namespace
-  const a = await (await fetch('/arbiter/'+CUR)).json();
-  if (a.candidates && a.candidates.length){
-    const idx = a.candidates.map(c=>c.candidate);
-    line('arb', idx, a.scores, '#2060c0');
-    line('arb', idx, a.best_scores, '#208040', false);
+  // arbiter view (ArbiterModule role): candidate updates ride the
+  // same session stream already fetched for the overview — filter
+  // client-side instead of a second full get_updates round trip
+  const cands = u.filter(p=>'candidate' in p);
+  if (cands.length){
+    const idx = cands.map(c=>c.candidate);
+    const scores = cands.map(c=>c.score);
+    const bests = cands.map(c=>c.best_score);
+    // both series share units: one y-scale for the overlay
+    const yr = [Math.min(...scores, ...bests),
+                Math.max(...scores, ...bests)];
+    line('arb', idx, scores, '#2060c0', true, yr);
+    line('arb', idx, bests, '#208040', false, yr);
     // best_score already encodes the runner's minimize/maximize
     // direction: the best candidate is the one whose score equals the
     // final best-so-far value
-    const target = a.best_scores[a.best_scores.length-1];
-    const best = a.candidates.find(c=>c.score===target) || a.candidates[0];
+    const target = bests[bests.length-1];
+    const best = cands.find(c=>c.score===target) || cands[0];
     document.getElementById('arbt').textContent =
       'best candidate #' + best.candidate + ': score ' + best.score +
       '  params ' + JSON.stringify(best.parameters);

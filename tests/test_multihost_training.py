@@ -165,3 +165,78 @@ def test_two_process_compressed_bus_runs_and_agrees():
     l1 = [float(v) for v in results[1]]
     np.testing.assert_allclose(l0, l1, rtol=0, atol=1e-7)
     assert l0[-1] < l0[0]  # it learns across hosts
+
+
+FIT_WORKER = """
+import sys
+sys.path.insert(0, {root!r})
+import numpy as np
+from deeplearning4j_tpu.parallel.elastic import initialize_cluster
+initialize_cluster(coordinator_address={addr!r}, num_processes=2,
+                   process_id={pid})
+import jax
+from deeplearning4j_tpu.parallel import ParallelWrapper
+from deeplearning4j_tpu.parallel.multihost import global_mesh
+from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+from deeplearning4j_tpu.learning import Sgd
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+conf = (NeuralNetConfiguration.builder().seed(7).updater(Sgd(0.1))
+        .weight_init("xavier").list()
+        .layer(DenseLayer(n_out=16, activation="tanh"))
+        .layer(OutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+        .input_type_feed_forward(4).build())
+m = MultiLayerNetwork(conf).init()
+rs = np.random.RandomState(0)
+X = (rs.rand(32, 4) * 2 - 1).astype(np.float32)   # the GLOBAL dataset
+Y = np.eye(2, dtype=np.float32)[(X.sum(-1) > 0).astype(int)]
+# this process's interleaved shard of every global batch of 16:
+# batch k = rows [16k, 16k+16); process p owns rows [16k+8p, 16k+8p+8)
+rows = np.concatenate([np.arange(16 * k + 8 * {pid},
+                                 16 * k + 8 * ({pid} + 1))
+                       for k in range(2)])
+it = ArrayDataSetIterator(X[rows], Y[rows], batch=8, shuffle=False)
+pw = ParallelWrapper(m, mesh=global_mesh(), prefetch_buffer=0)
+losses = []
+for _ in range(3):
+    pw.fit(it, epochs=1)
+    losses.append(float(m.score_))
+print("FIT_LOSSES", {pid}, " ".join(f"{{l:.6f}}" for l in losses),
+      flush=True)
+"""
+
+
+def test_two_process_parallelwrapper_fit_matches_single():
+    """The USER-API multi-host path: ParallelWrapper.fit on a
+    per-process shard iterator (auto-wrapped by MultiHostIterator)
+    matches single-process fit over the same global batches."""
+    results = run_two_process(FIT_WORKER, marker="FIT_LOSSES")
+    l0 = [float(v) for v in results[0]]
+    l1 = [float(v) for v in results[1]]
+    np.testing.assert_allclose(l0, l1, rtol=0, atol=1e-7)
+
+    # single-process reference over the SAME global batches
+    import jax
+    from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+    from deeplearning4j_tpu.learning import Sgd
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Sgd(0.1))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=2, loss="mcxent",
+                               activation="softmax"))
+            .input_type_feed_forward(4).build())
+    m = MultiLayerNetwork(conf).init()
+    rs = np.random.RandomState(0)
+    X = (rs.rand(32, 4) * 2 - 1).astype(np.float32)
+    Y = np.eye(2, dtype=np.float32)[(X.sum(-1) > 0).astype(int)]
+    it = ArrayDataSetIterator(X, Y, batch=16, shuffle=False)
+    ref = []
+    for _ in range(3):
+        m.fit(it, epochs=1)
+        ref.append(float(m.score_))
+    np.testing.assert_allclose(l0, ref, atol=1e-5)
+    assert l0[-1] < l0[0]
