@@ -1061,6 +1061,14 @@ class SameDiff:
         # every variable (halves steady-state HBM for the train state)
         return jax.jit(step, donate_argnums=(0, 1))
 
+    @property
+    def score_(self) -> float:
+        """Freshest training loss (the Listener SPI accessor shared with
+        MultiLayerNetwork/ComputationGraph — StatsListener et al. read
+        `model.score_`)."""
+        last = getattr(self, "_last_loss", None)
+        return float("nan") if last is None else float(last)
+
     def fit(self, data, epochs: int = 1, listeners: Sequence = (),
             key=None) -> History:
         """Train on a DataSetIterator / iterable of (features, labels) /
@@ -1093,6 +1101,9 @@ class SameDiff:
                 loss = float(loss)
                 history.loss_curve.append(loss)
                 ep_losses.append(loss)
+                # expose the freshest loss to listeners through the
+                # same score_ SPI MultiLayerNetwork provides
+                self._last_loss = loss
                 for lst in listeners:
                     if hasattr(lst, "iteration_done"):
                         lst.iteration_done(self, self._step, epoch)

@@ -26,7 +26,7 @@ import struct
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-__all__ = ["LogFileWriter", "LogFileReader"]
+__all__ = ["LogFileWriter", "LogFileReader", "UILogListener"]
 
 _START_EVENTS = "START_EVENTS"
 
@@ -110,6 +110,28 @@ class LogFileWriter:
              "iteration": int(iteration), "epoch": int(epoch),
              "timestamp": float(timestamp if timestamp is not None
                                 else time.time())}))
+
+
+class UILogListener:
+    """Listener gluing `SameDiff.fit(..., listeners=[...])` to the UI
+    log: writes the graph structure + system info once, then a scalar
+    loss event per iteration (ref: the reference attaches its UI file
+    writing through the same Listener SPI)."""
+
+    def __init__(self, path: str, name: str = "loss"):
+        self.writer = LogFileWriter(path)
+        self.name = name
+
+    def iteration_done(self, sd, iteration: int, epoch: int):
+        if not self.writer._static_done:
+            self.writer.write_graph_structure(sd)
+            self.writer.write_system_info()
+            self.writer.end_static_info()
+        loss = getattr(sd, "score_", None)
+        if loss is None or loss != loss:  # absent or NaN before 1st step
+            return  # the event stream is best-effort
+        self.writer.write_scalar_event(self.name, float(loss),
+                                       iteration=iteration, epoch=epoch)
 
 
 class LogFileReader:

@@ -142,3 +142,34 @@ def test_router_counts_drops_after_shutdown():
     r.shutdown()
     r.put_update("s", {"iteration": 0})
     assert r.dropped >= 1
+
+
+def test_ui_log_listener_streams_fit(tmp_path):
+    """UILogListener glues SameDiff.fit to the UI log through the
+    Listener SPI: one static block, then a loss event per iteration."""
+    from deeplearning4j_tpu.autodiff.samediff import (SameDiff,
+                                                      TrainingConfig)
+    from deeplearning4j_tpu.autodiff.ui_log import UILogListener
+    from deeplearning4j_tpu.learning import Sgd
+    sd = SameDiff.create()
+    x = sd.placeholder("x", (None, 4))
+    y = sd.placeholder("y", (None, 1))
+    w = sd.var("w", value=np.zeros((4, 1), np.float32))
+    loss = (((x @ w) - y) * ((x @ w) - y)).reduce_mean()
+    sd.set_loss_variables(loss.name)
+    sd.set_training_config(TrainingConfig(
+        updater=Sgd(0.1), data_set_feature_mapping=["x"],
+        data_set_label_mapping=["y"]))
+    p = str(tmp_path / "fit_ui.log")
+    rs = np.random.RandomState(0)
+    X = rs.rand(32, 4).astype(np.float32)
+    Y = (X.sum(-1, keepdims=True) > 2).astype(np.float32)
+    h = sd.fit([(X, Y)], epochs=4, listeners=[UILogListener(p)])
+    r = LogFileReader(p)
+    static = r.read_static()
+    assert [hh["type"] for hh, _ in static] == ["GRAPH_STRUCTURE",
+                                                "SYSTEM_INFO"]
+    events = r.read_events()
+    assert len(events) == 4
+    np.testing.assert_allclose([c["value"] for _, c in events],
+                               h.loss_curve, rtol=1e-6)
