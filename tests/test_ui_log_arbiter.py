@@ -173,3 +173,33 @@ def test_ui_log_listener_streams_fit(tmp_path):
     assert len(events) == 4
     np.testing.assert_allclose([c["value"] for _, c in events],
                                h.loss_curve, rtol=1e-6)
+
+
+def test_stats_listener_works_on_samediff_fit():
+    """SameDiff.score_ makes the shared Listener SPI uniform: the same
+    StatsListener used with MultiLayerNetwork streams SameDiff training
+    scores (param collection no-ops gracefully — SameDiff has no
+    _params tree)."""
+    from deeplearning4j_tpu.autodiff.samediff import (SameDiff,
+                                                      TrainingConfig)
+    from deeplearning4j_tpu.learning import Sgd
+    from deeplearning4j_tpu.ui import InMemoryStatsStorage, StatsListener
+    sd = SameDiff.create()
+    x = sd.placeholder("x", (None, 4))
+    y = sd.placeholder("y", (None, 1))
+    w = sd.var("w", value=np.zeros((4, 1), np.float32))
+    loss = (((x @ w) - y) * ((x @ w) - y)).reduce_mean()
+    sd.set_loss_variables(loss.name)
+    sd.set_training_config(TrainingConfig(
+        updater=Sgd(0.1), data_set_feature_mapping=["x"],
+        data_set_label_mapping=["y"]))
+    st = InMemoryStatsStorage()
+    rs = np.random.RandomState(0)
+    X = rs.rand(32, 4).astype(np.float32)
+    Y = X.sum(-1, keepdims=True)
+    h = sd.fit([(X, Y)], epochs=3,
+               listeners=[StatsListener(st, session_id="sd")])
+    ups = st.get_updates("sd")
+    assert len(ups) == 3
+    np.testing.assert_allclose([u["score"] for u in ups], h.loss_curve,
+                               rtol=1e-6)
