@@ -577,15 +577,46 @@ class ComputationGraph:
     # -- forward -------------------------------------------------------
     def _forward(self, params, net_state, inputs: Dict[str, jnp.ndarray],
                  train: bool, rng, fmask=None, stop_at: Optional[str] = None):
-        """Topological evaluation. Returns (activations dict, new_state)."""
+        """Topological evaluation. Returns (activations dict, new_state).
+
+        ``fmask`` is either a single [B, T] array (applied to every
+        input — the single-input convenience) or a dict keyed by input
+        name. Masks PROPAGATE along each branch (ref: ComputationGraph
+        feedForwardMaskArrays): a node inherits the mask of its masked
+        inputs (vertices with several masked inputs combine them by
+        elementwise OR, the MergeVertex rule), and the mask ends where
+        activations stop carrying a time axis."""
         conf = self.conf
         acts: Dict[str, jnp.ndarray] = dict(inputs)
+        if isinstance(fmask, dict):
+            macts: Dict[str, Any] = {k: fmask.get(k) for k in inputs}
+        else:
+            macts = {k: fmask for k in inputs}
         new_state = dict(net_state)
         if rng is not None:
             node_rngs = jax.random.split(rng, max(len(self._order), 1))
         for i, name in enumerate(self._order):
             node = conf.nodes[name]
             ins = [acts[x] for x in node.inputs]
+            # MergeVertex.feedForwardMaskArrays: elementwise OR, where
+            # an UNMASKED sequence input means all-timesteps-valid —
+            # all-ones dominates the OR, so any unmasked 3-D input
+            # clears the merged mask (a masked branch's padding must
+            # not be imposed on a fully-valid sibling)
+            seq_masks = []
+            any_unmasked_seq = False
+            for x in node.inputs:
+                mx = macts.get(x)
+                if mx is not None:
+                    seq_masks.append(mx)
+                elif getattr(acts[x], "ndim", 0) == 3:
+                    any_unmasked_seq = True
+            if any_unmasked_seq or not seq_masks:
+                fm = None
+            else:
+                fm = seq_masks[0]
+                for m2 in seq_masks[1:]:
+                    fm = jnp.maximum(fm, m2)
             if node.layer is not None:
                 layer = node.layer
                 p = params.get(name, {})
@@ -595,7 +626,7 @@ class ComputationGraph:
                     p = layer._maybe_weight_noise(p, train, r)
                 remat = getattr(conf, "remat", False) and train
                 if getattr(layer, "is_rnn", False):
-                    m = fmask if ins[0].ndim == 3 else None
+                    m = fm if ins[0].ndim == 3 else None
                     carry = layer.init_carry(ins[0].shape[0],
                                              ins[0].dtype)
                     if remat:
@@ -609,7 +640,7 @@ class ComputationGraph:
                 elif getattr(layer, "wants_mask", False):
                     # MaskLayer (ref: nn/conf/layers/util/MaskLayer.java):
                     # consumes the [B,T] feature mask on sequence inputs
-                    m = fmask if ins[0].ndim == 3 else None
+                    m = fm if ins[0].ndim == 3 else None
                     act, s2 = layer.apply_with_mask(p, ins[0], s, train,
                                                     r, m)
                 elif remat and layer.has_params:
@@ -624,6 +655,9 @@ class ComputationGraph:
             else:
                 act = node.vertex.apply(ins)
             acts[name] = act
+            # mask propagation: carried while the activation keeps a
+            # time axis, dropped once it collapses (pooling/last-step)
+            macts[name] = fm if getattr(act, "ndim", 0) == 3 else None
             if stop_at is not None and name == stop_at:
                 break
         return acts, new_state
@@ -834,55 +868,56 @@ class ComputationGraph:
         return {self.conf.graph_outputs[0]: jnp.asarray(m)}
 
     def _fmask_from(self, masks):
-        """Feature mask for the forward pass (RNN padding + MaskLayer).
-        Only a mask keyed by an INPUT name is a feature mask (ref:
+        """Feature masks for the forward pass (RNN padding + MaskLayer).
+        Only masks keyed by INPUT names are feature masks (ref:
         ComputationGraph keeps featureMaskArrays and labelMaskArrays
         distinct — setLayerMaskArrays). A bare/output-keyed mask stays a
         label mask: silently reusing it as a feature mask would corrupt
         many-to-one RNN training (a last-step-only label mask would make
-        the RNN treat every earlier timestep as padding)."""
+        the RNN treat every earlier timestep as padding).
+
+        Returns a dict {input_name: [B, T] mask} — `_forward` propagates
+        each input's mask along its own branch (the reference's
+        feedForwardMaskArrays role), so multi-input graphs take
+        per-input masks."""
         if not masks:
             return None
-        keyed = [n for n in self.conf.graph_inputs if n in masks]
-        if not keyed:
-            return None
-        if len(self.conf.graph_inputs) > 1:
-            # _forward threads ONE fmask globally; applying input A's
-            # padding pattern to input B's branch would silently corrupt
-            # it. Per-branch mask propagation is not implemented — fail
-            # loudly instead.
-            raise NotImplementedError(
-                "per-input feature masks on a multi-input "
-                "ComputationGraph are not supported — only single-input "
-                "graphs can take an input-keyed feature mask")
-        return masks[keyed[0]]
+        keyed = {n: masks[n] for n in self.conf.graph_inputs
+                 if n in masks}
+        return keyed or None
 
     def output(self, *data, train: bool = False, mask=None):
         """Returns the list of output activations (ref:
-        ComputationGraph.output; `mask` is the [B, T] input feature mask
-        — ref: the featureMaskArrays overload). Accepts a bare array
-        (single-input graphs only — the same restriction _fmask_from
-        enforces on the training path) or a dict keyed by input name."""
+        ComputationGraph.output; `mask` carries the [B, T] input
+        feature masks — ref: the featureMaskArrays overload). Accepts a
+        bare array (single-input graphs) or a dict keyed by input name
+        (multi-input graphs; each mask propagates along its own
+        branch)."""
         if self._params is None:
             self.init()
         if mask is not None:
             if isinstance(mask, dict):
                 mask = self._fmask_from(mask)
             elif len(self.conf.graph_inputs) > 1:
-                # a bare mask on a multi-input graph would silently
-                # apply one input's padding pattern to every branch —
-                # the training path refuses this, so inference must too
-                raise NotImplementedError(
+                # a bare mask on a multi-input graph is ambiguous —
+                # which input's padding pattern is it? Pass a dict
+                # keyed by input name (per-branch propagation handles
+                # the rest)
+                raise ValueError(
                     "a bare feature mask on a multi-input "
-                    "ComputationGraph is ambiguous — only single-input "
-                    "graphs accept one (pass a dict keyed by input "
-                    "name to hit the same single-input check the "
-                    "training path enforces)")
+                    "ComputationGraph is ambiguous — pass a dict "
+                    "keyed by input name")
         if len(data) == 1 and isinstance(data[0], (dict, list, tuple)):
             inputs = self._as_inputs(data[0])
         else:
             inputs = self._as_inputs(list(data))
-        key = ("out", train, mask is not None)
+        if isinstance(mask, dict):
+            mask = {k: jnp.asarray(v) for k, v in mask.items()}
+            mkey = frozenset(mask)
+        else:
+            mask = None if mask is None else jnp.asarray(mask)
+            mkey = mask is not None
+        key = ("out", train, mkey)
         if key not in self._jit_forward:
             def fwd(params, net_state, inputs, fmask):
                 acts, _ = self._forward(params, net_state, inputs, train,
@@ -890,8 +925,7 @@ class ComputationGraph:
                 return [acts[n] for n in self.conf.graph_outputs]
             self._jit_forward[key] = jax.jit(fwd)
         outs = self._jit_forward[key](
-            self._params, self._net_state, inputs,
-            None if mask is None else jnp.asarray(mask))
+            self._params, self._net_state, inputs, mask)
         return outs[0] if len(outs) == 1 else outs
 
     def feed_forward(self, data, train: bool = False):

@@ -171,22 +171,62 @@ class TestMaskLayer:
         # an output-keyed (label) mask must not become a feature mask
         assert g._fmask_from({"out": jnp.asarray(mask)}) is None
 
-    def test_multi_input_graph_rejects_input_masks(self):
+    def test_multi_input_graph_per_branch_masks(self):
+        """Round 5: per-input feature masks propagate along their own
+        branch (ref: ComputationGraph.feedForwardMaskArrays) — garbage
+        in a branch's masked-out timesteps must not affect the output,
+        independently per input."""
         from deeplearning4j_tpu.nn.conf import InputType
         from deeplearning4j_tpu.nn.graph import ComputationGraph
         from deeplearning4j_tpu.nn.graph import MergeVertex
+        from deeplearning4j_tpu.nn.layers import GlobalPoolingLayer, LSTM
         conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.1))
                 .graph_builder()
                 .add_inputs("a", "b")
-                .set_input_types(InputType.feed_forward(4),
-                                 InputType.feed_forward(4))
-                .add_vertex("m", MergeVertex(), "a", "b")
+                .set_input_types(InputType.recurrent(4, 6),
+                                 InputType.recurrent(4, 6))
+                .add_layer("la", LSTM(n_out=5), "a")
+                .add_layer("pa", GlobalPoolingLayer("max"), "la")
+                .add_layer("lb", LSTM(n_out=5), "b")
+                .add_layer("pb", GlobalPoolingLayer("max"), "lb")
+                .add_vertex("m", MergeVertex(), "pa", "pb")
                 .add_layer("out", OutputLayer(n_out=2, loss="mcxent"), "m")
                 .set_outputs("out")
                 .build())
         g = ComputationGraph(conf).init()
-        with pytest.raises(NotImplementedError, match="multi-input"):
-            g._fmask_from({"a": jnp.ones((2, 5))})
+        rs = np.random.RandomState(0)
+        xa = rs.rand(2, 6, 4).astype(np.float32)
+        xb = rs.rand(2, 6, 4).astype(np.float32)
+        ma = np.ones((2, 6), np.float32)
+        ma[:, 4:] = 0.0                      # a's last 2 steps padded
+        mb = np.ones((2, 6), np.float32)     # b fully valid
+        masks = {"a": ma, "b": mb}
+        base = np.asarray(g.output([xa, xb], mask=masks))
+        # garbage in a's MASKED steps: output unchanged
+        xa_g = xa.copy()
+        xa_g[:, 4:] = 1e3
+        np.testing.assert_allclose(
+            np.asarray(g.output([xa_g, xb], mask=masks)), base,
+            atol=1e-5)
+        # garbage in a's VALID steps: output changes
+        xa_v = xa.copy()
+        xa_v[:, 1] = 1e3
+        assert not np.allclose(
+            np.asarray(g.output([xa_v, xb], mask=masks)), base)
+        # garbage in b's steps (unmasked branch): output changes —
+        # a's mask must NOT have leaked onto b's branch
+        xb_g = xb.copy()
+        xb_g[:, 4:] = 1e3
+        assert not np.allclose(
+            np.asarray(g.output([xa, xb_g], mask=masks)), base)
+        # training with per-input masks runs and learns
+        y = np.eye(2, dtype=np.float32)[[0, 1]]
+        s0 = g.score([xa, xb], [y])
+        g.fit([([xa, xb], [y], {"a": ma, "b": mb})], epochs=10)
+        assert g.score([xa, xb], [y]) != s0
+        # a bare mask stays ambiguous on multi-input graphs
+        with pytest.raises(ValueError, match="ambiguous"):
+            g.output([xa, xb], mask=ma)
 
     def test_identity_without_mask(self):
         l = MaskLayer()
@@ -490,3 +530,50 @@ class TestSameDiffVertex:
         first = g.score([x], [y])
         g.fit([x], [y], epochs=100)
         assert g.score([x], [y]) < first * 0.7
+
+
+class TestSequenceMergeMasks:
+    def test_masked_plus_unmasked_sequence_merge_clears_mask(self):
+        """OR semantics at a sequence-level merge: an unmasked input
+        means all-timesteps-valid, which dominates the OR — the masked
+        sibling's padding must not suppress the valid branch's data
+        (review finding, round 5)."""
+        from deeplearning4j_tpu.nn.conf import InputType
+        from deeplearning4j_tpu.nn.graph import (ComputationGraph,
+                                                 ElementWiseVertex)
+        from deeplearning4j_tpu.nn.layers import (GlobalPoolingLayer,
+                                                  LSTM, OutputLayer)
+        conf = (NeuralNetConfiguration.builder().seed(2).updater(Sgd(0.1))
+                .graph_builder()
+                .add_inputs("a", "b")
+                .set_input_types(InputType.recurrent(4, 6),
+                                 InputType.recurrent(4, 6))
+                .add_layer("la", LSTM(n_out=5), "a")
+                .add_layer("lb", LSTM(n_out=5), "b")
+                .add_vertex("s", ElementWiseVertex("add"), "la", "lb")
+                .add_layer("l2", LSTM(n_out=5), "s")
+                .add_layer("p", GlobalPoolingLayer("max"), "l2")
+                .add_layer("out", OutputLayer(n_out=2, loss="mcxent"), "p")
+                .set_outputs("out")
+                .build())
+        g = ComputationGraph(conf).init()
+        rs = np.random.RandomState(0)
+        xa = rs.rand(2, 6, 4).astype(np.float32)
+        xb = rs.rand(2, 6, 4).astype(np.float32)
+        ma = np.ones((2, 6), np.float32)
+        ma[:, 4:] = 0.0                  # a padded, b fully valid
+        base = np.asarray(g.output([xa, xb], mask={"a": ma}))
+        # b's timesteps 4-5 are REAL data: changing them must change
+        # the output (a's padding must not leak onto the merged branch)
+        xb_g = xb.copy()
+        xb_g[:, 4:] = 5.0
+        assert not np.allclose(
+            np.asarray(g.output([xa, xb_g], mask={"a": ma})), base)
+        # both branches masked identically: padding stays suppressed
+        masks_both = {"a": ma, "b": ma}
+        b2 = np.asarray(g.output([xa, xb], mask=masks_both))
+        xa_g = xa.copy(); xa_g[:, 4:] = 5.0
+        xb_g2 = xb.copy(); xb_g2[:, 4:] = 5.0
+        np.testing.assert_allclose(
+            np.asarray(g.output([xa_g, xb_g2], mask=masks_both)), b2,
+            atol=1e-5)
