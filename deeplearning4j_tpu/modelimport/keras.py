@@ -70,6 +70,33 @@ class _Skip:
     (InputLayer, Flatten — dense auto-flattens)."""
 
 
+class _Masking(_Skip):
+    """Marker for keras `Masking(mask_value=...)`: DL4J's KerasMasking
+    realizes it by wrapping the NEXT recurrent layer in MaskZeroLayer
+    (derive the [B,T] mask from all-mask_value timesteps); same here."""
+
+    def __init__(self, mask_value: float):
+        self.mask_value = float(mask_value)
+
+
+def _apply_pending_mask(pending, layer, enforce: bool):
+    """Wrap `layer` per a preceding Masking marker. Only recurrent
+    consumers honor data-derived masks (the MaskZeroLayer contract);
+    anything else is unmappable."""
+    if pending is None:
+        return layer
+    from ..nn.layers.recurrent import MaskZeroLayer
+    if getattr(layer, "is_rnn", False):
+        return MaskZeroLayer(layer=layer, mask_value=pending.mask_value,
+                             name=getattr(layer, "name", None))
+    if enforce:
+        raise ValueError(
+            "keras Masking must be followed by a recurrent layer "
+            f"(got {type(layer).__name__}) — the MaskZeroLayer "
+            "wrapping pattern (ref KerasMasking) has no dense analogue")
+    return layer
+
+
 _LOSS_BY_ACTIVATION = {"softmax": "mcxent", "sigmoid": "xent"}
 
 
@@ -115,6 +142,8 @@ def _map_layer(class_name: str, cfg: dict) -> Optional[object]:
                                   eps=cfg.get("epsilon", 1e-3), name=name)
     if class_name == "Dropout":
         return DropoutLayer(dropout=cfg["rate"], name=name)
+    if class_name == "Masking":
+        return _Masking(cfg.get("mask_value", 0.0))
     if class_name == "Activation":
         return ActivationLayer(activation=_act(cfg), name=name)
     if class_name == "ZeroPadding2D":
@@ -360,8 +389,15 @@ def _translate_params(kind: str, ours: dict, keras_w: Dict[str, np.ndarray],
                       layer_name: str, layer=None) -> dict:
     if kind == "bidirectional":
         # split direction-prefixed datasets, translate each half with the
-        # wrapped layer's own mapping, re-prefix to our f_/b_ params
-        inner_kind = layer.layer.kind if layer is not None else "lstm"
+        # wrapped layer's own mapping, re-prefix to our f_/b_ params.
+        # Unwrap MaskZeroLayer/LastTimeStep first: `layer` may be the
+        # wrapper, and reading .layer.kind off the wrapper returns
+        # "bidirectional" again (double-split drops every weight)
+        from ..nn.layers.recurrent import MaskZeroLayer as _MZ
+        bidir = layer
+        while isinstance(bidir, (LastTimeStep, _MZ)):
+            bidir = bidir.layer
+        inner_kind = bidir.layer.kind if bidir is not None else "lstm"
         fwd = {k.split(":", 1)[1]: v for k, v in keras_w.items()
                if k.startswith("forward:")}
         bwd = {k.split(":", 1)[1]: v for k, v in keras_w.items()
@@ -409,8 +445,10 @@ def _bn_state(keras_w) -> Optional[dict]:
 
 
 def _wrapped_kind(layer) -> str:
-    if isinstance(layer, LastTimeStep):
-        return layer.layer.kind
+    # unwrap nested wrappers (MaskZeroLayer(LastTimeStep(LSTM)) etc.)
+    from ..nn.layers.recurrent import MaskZeroLayer
+    while isinstance(layer, (LastTimeStep, MaskZeroLayer)):
+        layer = layer.layer
     return layer.kind
 
 
@@ -531,6 +569,7 @@ class KerasModelImport:
                     "import_keras_model_and_weights")
             layer_cfgs = cfg["config"]["layers"]
             batch_shape = None
+            pending_mask = None
             mapped: List[Tuple[str, object]] = []
             for lc in layer_cfgs:
                 c = lc["config"]
@@ -542,7 +581,12 @@ class KerasModelImport:
                     if bs:
                         batch_shape = bs
                 layer = _map_layer(lc["class_name"], c)
-                if not isinstance(layer, _Skip):
+                if isinstance(layer, _Masking):
+                    pending_mask = layer
+                elif not isinstance(layer, _Skip):
+                    layer = _apply_pending_mask(
+                        pending_mask, layer, enforce_training_config)
+                    pending_mask = None
                     mapped.append((c.get("name"), layer))
             if batch_shape is None:
                 raise ValueError("could not determine model input shape")
@@ -622,6 +666,7 @@ class KerasModelImport:
             builder = GraphBuilder(base)
             input_names = []
             mapped: Dict[str, object] = {}
+            mask_markers: Dict[str, object] = {}
             shapes: Dict[str, list] = {}
             for lc in gcfg["layers"]:
                 c = lc["config"]
@@ -633,15 +678,36 @@ class KerasModelImport:
                         "batch_input_shape")
                     continue
                 if lc["class_name"] in _MERGE_VERTICES:
+                    if enforce_training_config and any(
+                            i in mask_markers for i in inbound):
+                        raise ValueError(
+                            "keras Masking feeding a merge vertex "
+                            f"({lc['class_name']}) is not mapped — "
+                            "only a directly-following recurrent "
+                            "layer honors the derived mask")
                     builder.add_vertex(nm, _MERGE_VERTICES[lc["class_name"]](c),
                                        *inbound)
                     continue
                 layer = _map_layer(lc["class_name"], c)
                 if isinstance(layer, _Skip):
-                    # passthrough: alias by scale-1 vertex
+                    # passthrough: alias by scale-1 vertex. A Masking
+                    # node records its marker here; plain skips FORWARD
+                    # any inbound marker so Masking -> Flatten-like ->
+                    # RNN still wraps (same as the sequential path)
+                    if isinstance(layer, _Masking):
+                        mask_markers[nm] = layer
+                    else:
+                        fwd_marker = next((mask_markers[i] for i in inbound
+                                           if i in mask_markers), None)
+                        if fwd_marker is not None:
+                            mask_markers[nm] = fwd_marker
                     from ..nn.graph import ScaleVertex
                     builder.add_vertex(nm, ScaleVertex(1.0), *inbound)
                     continue
+                marker = next((mask_markers[i] for i in inbound
+                               if i in mask_markers), None)
+                layer = _apply_pending_mask(marker, layer,
+                                            enforce_training_config)
                 mapped[nm] = layer
                 builder.add_layer(nm, layer, *inbound)
             builder.add_inputs(*input_names)

@@ -611,7 +611,26 @@ class ComputationGraph:
                     seq_masks.append(mx)
                 elif getattr(acts[x], "ndim", 0) == 3:
                     any_unmasked_seq = True
-            if any_unmasked_seq or not seq_masks:
+            vkind = getattr(getattr(node, "vertex", None), "kind", None)
+            if vkind == "stack" and seq_masks:
+                # StackVertex concatenates along BATCH: masks stack the
+                # same way, all-ones standing in for unmasked inputs
+                # (ref: StackVertex.feedForwardMaskArrays)
+                parts = []
+                for x in node.inputs:
+                    mx = macts.get(x)
+                    if mx is None:
+                        a = acts[x]
+                        mx = jnp.ones((a.shape[0],) + seq_masks[0].shape[1:],
+                                      seq_masks[0].dtype)
+                    parts.append(mx)
+                fm = jnp.concatenate(parts, axis=0)
+            elif vkind == "unstack" and seq_masks:
+                v = node.vertex
+                step = seq_masks[0].shape[0] // v.stack_size
+                fm = seq_masks[0][v.from_idx * step:
+                                  (v.from_idx + 1) * step]
+            elif any_unmasked_seq or not seq_masks:
                 fm = None
             else:
                 fm = seq_masks[0]
