@@ -70,33 +70,6 @@ class _Skip:
     (InputLayer, Flatten — dense auto-flattens)."""
 
 
-class _Masking(_Skip):
-    """Marker for keras `Masking(mask_value=...)`: DL4J's KerasMasking
-    realizes it by wrapping the NEXT recurrent layer in MaskZeroLayer
-    (derive the [B,T] mask from all-mask_value timesteps); same here."""
-
-    def __init__(self, mask_value: float):
-        self.mask_value = float(mask_value)
-
-
-def _apply_pending_mask(pending, layer, enforce: bool):
-    """Wrap `layer` per a preceding Masking marker. Only recurrent
-    consumers honor data-derived masks (the MaskZeroLayer contract);
-    anything else is unmappable."""
-    if pending is None:
-        return layer
-    from ..nn.layers.recurrent import MaskZeroLayer
-    if getattr(layer, "is_rnn", False):
-        return MaskZeroLayer(layer=layer, mask_value=pending.mask_value,
-                             name=getattr(layer, "name", None))
-    if enforce:
-        raise ValueError(
-            "keras Masking must be followed by a recurrent layer "
-            f"(got {type(layer).__name__}) — the MaskZeroLayer "
-            "wrapping pattern (ref KerasMasking) has no dense analogue")
-    return layer
-
-
 _LOSS_BY_ACTIVATION = {"softmax": "mcxent", "sigmoid": "xent"}
 
 
@@ -106,6 +79,64 @@ def _as_output_layer(d: DenseLayer) -> OutputLayer:
     loss = _LOSS_BY_ACTIVATION.get(act_name, "mse")
     return OutputLayer(n_out=d.n_out, loss=loss, activation=d.activation,
                        has_bias=d.has_bias, name=d.name)
+
+
+def _check_masking_semantics_graph(layer_cfgs, mapped):
+    """enforce_training_config guards for Masking semantics this import
+    cannot reproduce exactly (without enforce these import with the
+    documented divergences):
+
+    - a merge vertex consuming a masked branch: keras ANDs masks at
+      Concatenate, while the graph forward uses the DL4J MergeVertex OR
+      rule (an unmasked sequence sibling clears the merged mask);
+    - a sequence-shaped (per-timestep) OUTPUT downstream of Masking:
+      keras excludes masked timesteps from the LOSS, but the derived
+      mask here lives in the forward pass only — pass an explicit label
+      mask to fit() instead."""
+    from ..nn.layers import MaskingLayer
+    from ..nn.layers.recurrent import LastTimeStep
+    masking_nodes = {nm for nm, l in mapped.items()
+                     if isinstance(l, MaskingLayer)}
+    if not masking_nodes:
+        return
+    # transitive downstream closure of the masking nodes
+    downstream = set(masking_nodes)
+    changed = True
+    by_name = {lc["config"].get("name"): lc for lc in layer_cfgs}
+    while changed:
+        changed = False
+        for lc in layer_cfgs:
+            nm = lc["config"].get("name")
+            if nm in downstream:
+                continue
+            if any(i in downstream for i in _inbound_names(lc)):
+                downstream.add(nm)
+                changed = True
+    for lc in layer_cfgs:
+        nm = lc["config"].get("name")
+        if nm not in downstream or nm in masking_nodes:
+            continue
+        if lc["class_name"] in _MERGE_VERTICES and any(
+                i in downstream for i in _inbound_names(lc)):
+            # merging a masked branch with a possibly-unmasked one
+            others = [i for i in _inbound_names(lc)
+                      if i not in downstream]
+            if others:
+                raise ValueError(
+                    "keras Masking feeding a merge vertex alongside an "
+                    "unmasked branch is not mapped exactly (keras ANDs "
+                    "masks; the DL4J MergeVertex OR rule applies here) "
+                    "— import with enforce_training_config=False to "
+                    "accept the divergence")
+    # per-timestep outputs: the derived mask does not reach the loss
+    out_like = [l for l in mapped.values()
+                if getattr(l, "kind", "") in ("rnnoutput", "rnnloss")]
+    if out_like:
+        raise ValueError(
+            "keras Masking with a per-timestep output is not mapped "
+            "exactly: the derived mask is forward-only and does not "
+            "reach the loss — pass an explicit label mask to fit(), "
+            "or import with enforce_training_config=False")
 
 
 def _map_layer(class_name: str, cfg: dict) -> Optional[object]:
@@ -143,7 +174,9 @@ def _map_layer(class_name: str, cfg: dict) -> Optional[object]:
     if class_name == "Dropout":
         return DropoutLayer(dropout=cfg["rate"], name=name)
     if class_name == "Masking":
-        return _Masking(cfg.get("mask_value", 0.0))
+        from ..nn.layers import MaskingLayer
+        return MaskingLayer(mask_value=cfg.get("mask_value", 0.0),
+                            name=name)
     if class_name == "Activation":
         return ActivationLayer(activation=_act(cfg), name=name)
     if class_name == "ZeroPadding2D":
@@ -393,10 +426,7 @@ def _translate_params(kind: str, ours: dict, keras_w: Dict[str, np.ndarray],
         # Unwrap MaskZeroLayer/LastTimeStep first: `layer` may be the
         # wrapper, and reading .layer.kind off the wrapper returns
         # "bidirectional" again (double-split drops every weight)
-        from ..nn.layers.recurrent import MaskZeroLayer as _MZ
-        bidir = layer
-        while isinstance(bidir, (LastTimeStep, _MZ)):
-            bidir = bidir.layer
+        bidir = _unwrap(layer) if layer is not None else None
         inner_kind = bidir.layer.kind if bidir is not None else "lstm"
         fwd = {k.split(":", 1)[1]: v for k, v in keras_w.items()
                if k.startswith("forward:")}
@@ -444,12 +474,16 @@ def _bn_state(keras_w) -> Optional[dict]:
     return None
 
 
-def _wrapped_kind(layer) -> str:
-    # unwrap nested wrappers (MaskZeroLayer(LastTimeStep(LSTM)) etc.)
+def _unwrap(layer):
+    """Peel recurrent wrappers (MaskZeroLayer(LastTimeStep(LSTM)) ...)."""
     from ..nn.layers.recurrent import MaskZeroLayer
     while isinstance(layer, (LastTimeStep, MaskZeroLayer)):
         layer = layer.layer
-    return layer.kind
+    return layer
+
+
+def _wrapped_kind(layer) -> str:
+    return _unwrap(layer).kind
 
 
 def _input_type(list_builder, batch_shape):
@@ -569,7 +603,6 @@ class KerasModelImport:
                     "import_keras_model_and_weights")
             layer_cfgs = cfg["config"]["layers"]
             batch_shape = None
-            pending_mask = None
             mapped: List[Tuple[str, object]] = []
             for lc in layer_cfgs:
                 c = lc["config"]
@@ -581,12 +614,7 @@ class KerasModelImport:
                     if bs:
                         batch_shape = bs
                 layer = _map_layer(lc["class_name"], c)
-                if isinstance(layer, _Masking):
-                    pending_mask = layer
-                elif not isinstance(layer, _Skip):
-                    layer = _apply_pending_mask(
-                        pending_mask, layer, enforce_training_config)
-                    pending_mask = None
+                if not isinstance(layer, _Skip):
                     mapped.append((c.get("name"), layer))
             if batch_shape is None:
                 raise ValueError("could not determine model input shape")
@@ -597,6 +625,19 @@ class KerasModelImport:
             if mapped and type(mapped[-1][1]) is DenseLayer:
                 nm, d = mapped[-1]
                 mapped[-1] = (nm, _as_output_layer(d))
+            if enforce_training_config:
+                from ..nn.layers import MaskingLayer
+                has_masking = any(isinstance(l, MaskingLayer)
+                                  for _, l in mapped)
+                if has_masking and mapped and getattr(
+                        mapped[-1][1], "kind", "") in ("rnnoutput",
+                                                       "rnnloss"):
+                    raise ValueError(
+                        "keras Masking with a per-timestep output is "
+                        "not mapped exactly: the derived mask is "
+                        "forward-only and does not reach the loss — "
+                        "pass an explicit label mask to fit(), or "
+                        "import with enforce_training_config=False")
 
             # restore the compile-time training config (optimizer + loss)
             # so an imported model fine-tunes with the same settings
@@ -666,8 +707,15 @@ class KerasModelImport:
             builder = GraphBuilder(base)
             input_names = []
             mapped: Dict[str, object] = {}
-            mask_markers: Dict[str, object] = {}
             shapes: Dict[str, list] = {}
+            # positional references from DATA-path nodes only: the
+            # aux mask subgraph (NotEqual -> Any) references itself
+            # positionally, which must not veto dropping it
+            _aux = {lc2["config"].get("name") for lc2 in gcfg["layers"]
+                    if lc2["class_name"] in ("NotEqual", "Any")}
+            _positional_refs = {n for lc2 in gcfg["layers"]
+                                if lc2["config"].get("name") not in _aux
+                                for n in _inbound_names(lc2)}
             for lc in gcfg["layers"]:
                 c = lc["config"]
                 nm = c["name"]
@@ -677,39 +725,35 @@ class KerasModelImport:
                     shapes[nm] = c.get("batch_shape") or c.get(
                         "batch_input_shape")
                     continue
-                if lc["class_name"] in _MERGE_VERTICES:
-                    if enforce_training_config and any(
-                            i in mask_markers for i in inbound):
+                if lc["class_name"] in ("NotEqual", "Any"):
+                    # keras-3 functional serialization materializes the
+                    # Masking mask computation as auxiliary NotEqual/Any
+                    # nodes wired to consumers via kwargs only; our
+                    # MaskingLayer derives the mask in-band, so these
+                    # nodes have no data-path consumers — drop them.
+                    # Safety: a model legitimately using
+                    # keras.ops.not_equal/any IN the data path would be
+                    # positionally referenced — refuse those clearly
+                    if nm in _positional_refs:
                         raise ValueError(
-                            "keras Masking feeding a merge vertex "
-                            f"({lc['class_name']}) is not mapped — "
-                            "only a directly-following recurrent "
-                            "layer honors the derived mask")
+                            f"unsupported Keras layer type "
+                            f"{lc['class_name']!r} in the data path "
+                            f"(layer {nm!r})")
+                    continue
+                if lc["class_name"] in _MERGE_VERTICES:
                     builder.add_vertex(nm, _MERGE_VERTICES[lc["class_name"]](c),
                                        *inbound)
                     continue
                 layer = _map_layer(lc["class_name"], c)
                 if isinstance(layer, _Skip):
-                    # passthrough: alias by scale-1 vertex. A Masking
-                    # node records its marker here; plain skips FORWARD
-                    # any inbound marker so Masking -> Flatten-like ->
-                    # RNN still wraps (same as the sequential path)
-                    if isinstance(layer, _Masking):
-                        mask_markers[nm] = layer
-                    else:
-                        fwd_marker = next((mask_markers[i] for i in inbound
-                                           if i in mask_markers), None)
-                        if fwd_marker is not None:
-                            mask_markers[nm] = fwd_marker
+                    # passthrough: alias by scale-1 vertex
                     from ..nn.graph import ScaleVertex
                     builder.add_vertex(nm, ScaleVertex(1.0), *inbound)
                     continue
-                marker = next((mask_markers[i] for i in inbound
-                               if i in mask_markers), None)
-                layer = _apply_pending_mask(marker, layer,
-                                            enforce_training_config)
                 mapped[nm] = layer
                 builder.add_layer(nm, layer, *inbound)
+            if enforce_training_config:
+                _check_masking_semantics_graph(gcfg["layers"], mapped)
             builder.add_inputs(*input_names)
             outs = gcfg["output_layers"]
             if (len(outs) >= 2 and isinstance(outs[0], str)
@@ -805,6 +849,13 @@ def _inbound_names(layer_cfg: dict) -> List[str]:
         if isinstance(obj, dict):
             if "keras_history" in obj:
                 names.append(obj["keras_history"][0])
+            elif "args" in obj:
+                # keras-3 node form {'args': [...], 'kwargs': {...}}:
+                # only positional args are DATA inputs — kwargs carry
+                # auxiliary wiring (e.g. the mask tensor from the
+                # serialized Masking infrastructure's NotEqual/Any
+                # nodes, which the importer drops)
+                walk(obj["args"])
             else:
                 for v in obj.values():
                     walk(v)

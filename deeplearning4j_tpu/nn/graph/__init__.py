@@ -641,6 +641,12 @@ class ComputationGraph:
                 p = params.get(name, {})
                 s = net_state.get(name, {})
                 r = node_rngs[i] if rng is not None else None
+                if getattr(layer, "derives_mask", False):
+                    # MaskingLayer: inject the data-derived mask into
+                    # this branch's propagation
+                    derived = layer.derive_mask(ins[0])
+                    if derived is not None:
+                        fm = derived if fm is None else fm * derived
                 if layer.weight_noise is not None:
                     p = layer._maybe_weight_noise(p, train, r)
                 remat = getattr(conf, "remat", False) and train

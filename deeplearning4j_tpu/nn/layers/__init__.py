@@ -612,6 +612,7 @@ class GlobalPoolingLayer(Layer):
     pooled dims stay as size-1 axes)."""
 
     kind = "globalpool"
+    wants_mask = True
 
     def __init__(self, pooling: str = "avg", pnorm: int = 2,
                  keep_dims: bool = False, **kw):
@@ -622,8 +623,37 @@ class GlobalPoolingLayer(Layer):
         self.keep_dims = bool(keep_dims)
 
     def apply(self, params, x, state, train, rng):
+        return self.apply_with_mask(params, x, state, train, rng, None)
+
+    def apply_with_mask(self, params, x, state, train, rng, mask):
+        """Masked pooling over time (ref: GlobalPoolingLayer.java
+        activateHelperFullArray vs the masked path — padded timesteps
+        are EXCLUDED, so avg divides by the true length and max ignores
+        padding entirely)."""
         axes = tuple(range(1, x.ndim - 1))  # all but batch & channel
         kd = self.keep_dims
+        if mask is not None and x.ndim == 3:
+            m = mask[:, :, None].astype(x.dtype)
+            if self.pooling == "max":
+                z = jnp.max(jnp.where(m > 0, x, -jnp.inf), axis=axes,
+                            keepdims=kd)
+                # a fully-masked row (ragged batching) would pool to
+                # -inf and NaN-poison downstream; emit 0 like an empty
+                # average instead
+                any_valid = jnp.sum(m, axis=axes, keepdims=kd) > 0
+                z = jnp.where(any_valid, z, 0.0)
+            elif self.pooling == "avg":
+                z = (jnp.sum(x * m, axis=axes, keepdims=kd) /
+                     jnp.maximum(jnp.sum(m, axis=axes, keepdims=kd), 1.0))
+            elif self.pooling == "sum":
+                z = jnp.sum(x * m, axis=axes, keepdims=kd)
+            elif self.pooling == "pnorm":
+                p = float(self.pnorm)
+                z = jnp.sum(jnp.abs(x * m) ** p, axis=axes,
+                            keepdims=kd) ** (1.0 / p)
+            else:
+                raise ValueError(self.pooling)
+            return z, state
         if self.pooling == "max":
             z = jnp.max(x, axis=axes, keepdims=kd)
         elif self.pooling == "avg":
@@ -785,7 +815,8 @@ from .variational import VariationalAutoencoder  # noqa: E402,F401
 from .specialized_outputs import (CenterLossOutputLayer,  # noqa: E402,F401
                                   OCNNOutputLayer)
 from .misc import (AutoEncoder, Cnn3DLossLayer,  # noqa: E402,F401
-                   CnnLossLayer, FrozenLayerWithBackprop, MaskLayer)
+                   CnnLossLayer, FrozenLayerWithBackprop, MaskLayer,
+                   MaskingLayer)
 from .samediff_layer import (SameDiffLambdaLayer,  # noqa: E402,F401
                              SameDiffLayer, SameDiffOutputLayer,
                              SDLayerParams)

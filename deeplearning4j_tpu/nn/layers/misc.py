@@ -172,6 +172,37 @@ class MaskLayer(Layer):
 
 
 @register
+class MaskingLayer(Layer):
+    """Derives a [B, T] feature mask from the DATA (timesteps whose
+    every feature equals ``mask_value`` are padding) and injects it
+    into the network's mask propagation; activations pass through
+    unchanged. The keras `Masking` semantics (ref: KerasMasking.java) —
+    downstream RNNs, MaskLayer, and masked global pooling all consume
+    the derived mask through the ordinary fmask chain, and it survives
+    mask-transparent layers (Dropout/BN/Activation) exactly as in
+    keras."""
+
+    kind = "masking"
+    derives_mask = True
+
+    def __init__(self, mask_value: float = 0.0, **kw):
+        kw.setdefault("activation", "identity")
+        super().__init__(**kw)
+        self.mask_value = float(mask_value)
+
+    def derive_mask(self, x):
+        if x.ndim != 3:
+            return None
+        return jnp.any(x != self.mask_value, axis=-1).astype(x.dtype)
+
+    def apply(self, params, x, state, train, rng):
+        return x, state
+
+    def _extra_json(self):
+        return {"mask_value": self.mask_value}
+
+
+@register
 class CnnLossLayer(LossLayer):
     """Per-pixel loss on [B, H, W, C] input (segmentation heads etc.) —
     no params; labels share the input shape; an optional [B, H, W] (or

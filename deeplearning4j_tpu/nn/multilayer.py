@@ -168,6 +168,12 @@ class MultiLayerNetwork:
             if layer.weight_noise is not None:
                 p = layer._maybe_weight_noise(p, train, r)
             remat = getattr(self.conf, "remat", False) and train
+            if getattr(layer, "derives_mask", False):
+                # MaskingLayer: derive the feature mask from the data
+                # and inject it into the chain for downstream consumers
+                derived = layer.derive_mask(act)
+                if derived is not None:
+                    fmask = derived if fmask is None else fmask * derived
             if getattr(layer, "is_rnn", False):
                 m = fmask if act.ndim == 3 else None
                 if remat:

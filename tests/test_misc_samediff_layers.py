@@ -632,3 +632,50 @@ class TestSequenceMergeMasks:
         xb_g = xb.copy(); xb_g[:, 4:] = 1e3
         assert not np.allclose(
             np.asarray(g2.output([xa, xb_g], mask={"a": ma})), b2)
+
+
+class TestMaskedGlobalPooling:
+    """GlobalPoolingLayer excludes masked timesteps (ref:
+    GlobalPoolingLayer.java masked path — avg divides by TRUE length,
+    max ignores padding)."""
+
+    def test_masked_avg_and_max_semantics(self):
+        from deeplearning4j_tpu.nn.layers import GlobalPoolingLayer
+        import jax.numpy as jnp
+        x = np.zeros((2, 4, 3), np.float32)
+        x[0, :2] = [[1, 2, 3], [3, 4, 5]]
+        x[0, 2:] = 99.0                       # padding garbage
+        x[1] = 1.0
+        mask = np.array([[1, 1, 0, 0], [1, 1, 1, 1]], np.float32)
+        avg = GlobalPoolingLayer("avg"); avg.build((4, 3), {})
+        z, _ = avg.apply_with_mask({}, jnp.asarray(x), {}, False, None,
+                                   jnp.asarray(mask))
+        np.testing.assert_allclose(np.asarray(z)[0], [2, 3, 4], atol=1e-6)
+        np.testing.assert_allclose(np.asarray(z)[1], [1, 1, 1], atol=1e-6)
+        mx = GlobalPoolingLayer("max"); mx.build((4, 3), {})
+        z, _ = mx.apply_with_mask({}, jnp.asarray(x), {}, False, None,
+                                  jnp.asarray(mask))
+        np.testing.assert_allclose(np.asarray(z)[0], [3, 4, 5], atol=1e-6)
+
+    def test_mask_reaches_pooling_through_graph(self):
+        """End to end: the input mask must flow to the pooling layer so
+        padded garbage never enters the pooled features."""
+        from deeplearning4j_tpu.nn.conf import InputType
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.layers import (GlobalPoolingLayer,
+                                                  OutputLayer)
+        conf = (NeuralNetConfiguration.builder().seed(5).updater(Sgd(0.1))
+                .graph_builder()
+                .add_inputs("in")
+                .set_input_types(InputType.recurrent(3, 4))
+                .add_layer("p", GlobalPoolingLayer("avg"), "in")
+                .add_layer("out", OutputLayer(n_out=2, loss="mcxent"), "p")
+                .set_outputs("out")
+                .build())
+        g = ComputationGraph(conf).init()
+        x = np.random.RandomState(0).rand(2, 4, 3).astype(np.float32)
+        mask = np.array([[1, 1, 0, 0], [1, 1, 1, 1]], np.float32)
+        base = np.asarray(g.output([x], mask=mask))
+        xg = x.copy(); xg[0, 2:] = 1e3
+        np.testing.assert_allclose(
+            np.asarray(g.output([xg], mask=mask)), base, atol=1e-5)

@@ -377,8 +377,8 @@ class TestKerasMasking:
         want = np.asarray(m.predict(x, verbose=0))
         from deeplearning4j_tpu.modelimport.keras import KerasModelImport
         net = KerasModelImport.import_keras_sequential_model_and_weights(p)
-        from deeplearning4j_tpu.nn.layers.recurrent import MaskZeroLayer
-        assert any(isinstance(l, MaskZeroLayer) for l in net.layers), \
+        from deeplearning4j_tpu.nn.layers import MaskingLayer
+        assert any(isinstance(l, MaskingLayer) for l in net.layers), \
             [type(l).__name__ for l in net.layers]
         got = np.asarray(net.output(x))
         np.testing.assert_allclose(got, want, atol=1e-5)
@@ -393,16 +393,52 @@ class TestKerasMasking:
         assert not np.allclose(got_g[0], want[0])  # steps re-validated
         np.testing.assert_allclose(got_g, kw, atol=1e-5)
 
-    def test_masking_before_dense_enforce_raises(self, tmp_path):
+    def test_masking_through_dropout_matches_keras(self, tmp_path):
+        """keras propagates masks through mask-transparent layers
+        (Dropout); the MaskingLayer + fmask-chain design does the same
+        (marker-wrapping designs break on exactly this model)."""
         keras = pytest.importorskip("keras")
         m = keras.Sequential([
-            keras.layers.Input((4,)),
+            keras.layers.Input((6, 3)),
             keras.layers.Masking(mask_value=0.0),
-            keras.layers.Dense(2)])
-        m.compile(optimizer="adam", loss="mse")
+            keras.layers.Dropout(0.25),
+            keras.layers.LSTM(5),
+            keras.layers.Dense(2, activation="softmax")])
         p = str(tmp_path / "md.h5")
         m.save(p)
         from deeplearning4j_tpu.modelimport.keras import KerasModelImport
-        with pytest.raises(ValueError, match="recurrent"):
-            KerasModelImport.import_keras_sequential_model_and_weights(
-                p, enforce_training_config=True)
+        net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+        rs = np.random.RandomState(1)
+        x = rs.rand(4, 6, 3).astype(np.float32)
+        x[0, 3:] = 0.0
+        want = np.asarray(m.predict(x, verbose=0))   # dropout off at eval
+        got = np.asarray(net.output(x))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+        # garbage in masked steps changes nothing only if it stays the
+        # sentinel... perturb VALID step instead to prove liveness
+        xg = x.copy(); xg[0, 1] = 2.0
+        assert not np.allclose(np.asarray(net.output(xg)), got)
+
+
+    def test_functional_masking_two_branches_matches_keras(self, tmp_path):
+        """keras-3 functional serialization materializes Masking's mask
+        computation as NotEqual/Any aux nodes wired via kwargs; the
+        importer drops them and MaskingLayer re-derives the mask
+        in-band — multi-branch parity against the oracle."""
+        keras = pytest.importorskip("keras")
+        inp = keras.Input((6, 3))
+        msk = keras.layers.Masking(mask_value=0.0)(inp)
+        l1 = keras.layers.LSTM(4)(msk)
+        l2 = keras.layers.LSTM(4)(msk)
+        cat = keras.layers.Concatenate()([l1, l2])
+        out = keras.layers.Dense(2, activation="softmax")(cat)
+        m = keras.Model(inp, out)
+        p = str(tmp_path / "fm.h5")
+        m.save(p)
+        g = KerasModelImport.import_keras_model_and_weights(p)
+        rs = np.random.RandomState(0)
+        x = rs.rand(4, 6, 3).astype(np.float32)
+        x[0, 4:] = 0.0
+        want = np.asarray(m.predict(x, verbose=0))
+        got = np.asarray(g.output([x]))
+        np.testing.assert_allclose(got, want, atol=1e-5)
