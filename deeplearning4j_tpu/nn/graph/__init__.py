@@ -576,8 +576,12 @@ class ComputationGraph:
 
     # -- forward -------------------------------------------------------
     def _forward(self, params, net_state, inputs: Dict[str, jnp.ndarray],
-                 train: bool, rng, fmask=None, stop_at: Optional[str] = None):
-        """Topological evaluation. Returns (activations dict, new_state).
+                 train: bool, rng, fmask=None, stop_at: Optional[str] = None,
+                 carries: Optional[Dict[str, Any]] = None):
+        """Topological evaluation. Returns (activations dict, new_state),
+        or (acts, new_state, new_carries) when ``carries`` is passed
+        (TBPTT: per-RNN-node state threaded across time chunks — ref:
+        ComputationGraph.rnnActivateUsingStoredState).
 
         ``fmask`` is either a single [B, T] array (applied to every
         input — the single-input convenience) or a dict keyed by input
@@ -593,6 +597,7 @@ class ComputationGraph:
         else:
             macts = {k: fmask for k in inputs}
         new_state = dict(net_state)
+        new_carries: Dict[str, Any] = {}
         if rng is not None:
             node_rngs = jax.random.split(rng, max(len(self._order), 1))
         for i, name in enumerate(self._order):
@@ -652,16 +657,20 @@ class ComputationGraph:
                 remat = getattr(conf, "remat", False) and train
                 if getattr(layer, "is_rnn", False):
                     m = fm if ins[0].ndim == 3 else None
-                    carry = layer.init_carry(ins[0].shape[0],
-                                             ins[0].dtype)
+                    carry = (carries.get(name) if carries is not None
+                             else None)
+                    if carry is None:
+                        carry = layer.init_carry(ins[0].shape[0],
+                                                 ins[0].dtype)
                     if remat:
-                        act, s2, _ = jax.checkpoint(
+                        act, s2, c2 = jax.checkpoint(
                             lambda p_, a_, s_, r_, c_, m_, _l=layer:
                             _l.apply_seq(p_, a_, s_, train, r_, c_, m_)
                         )(p, ins[0], s, r, carry, m)
                     else:
-                        act, s2, _ = layer.apply_seq(p, ins[0], s, train,
-                                                     r, carry, m)
+                        act, s2, c2 = layer.apply_seq(p, ins[0], s, train,
+                                                      r, carry, m)
+                    new_carries[name] = c2
                 elif getattr(layer, "wants_mask", False):
                     # MaskLayer (ref: nn/conf/layers/util/MaskLayer.java):
                     # consumes the [B,T] feature mask on sequence inputs
@@ -685,6 +694,8 @@ class ComputationGraph:
             macts[name] = fm if getattr(act, "ndim", 0) == 3 else None
             if stop_at is not None and name == stop_at:
                 break
+        if carries is not None:
+            return acts, new_state, new_carries
         return acts, new_state
 
     @property
@@ -695,9 +706,10 @@ class ComputationGraph:
         return compute_dtype(getattr(self.conf, "dtype", None))
 
     def _loss_fn(self, params, net_state, inputs, labels: Dict[str, jnp.ndarray],
-                 masks, train, rng):
+                 masks, train, rng, carries=None):
         """Sum of output-layer losses + L1/L2 (ref: computeGradientAndScore
-        :1320 sums scores over output layers)."""
+        :1320 sums scores over output layers). With ``carries``, the aux
+        becomes (new_state, new_carries) — the TBPTT chunk contract."""
         from ..precision import (cast_feats_to_f32, cast_input_for_compute,
                                  cast_params_for_compute)
         r_fwd = r_out = None
@@ -708,8 +720,13 @@ class ComputationGraph:
             params, set(self.conf.graph_outputs), cdt)
         inputs_c = {k: cast_input_for_compute(v, cdt)
                     for k, v in inputs.items()} if cdt is not None else inputs
-        acts, new_state = self._forward(params_c, net_state, inputs_c, train,
-                                        r_fwd, fmask=self._fmask_from(masks))
+        fwd = self._forward(params_c, net_state, inputs_c, train,
+                            r_fwd, fmask=self._fmask_from(masks),
+                            carries=carries)
+        if carries is not None:
+            acts, new_state, new_carries = fwd
+        else:
+            acts, new_state = fwd
         total = 0.0
         for out_name in self.conf.graph_outputs:
             node = self.conf.nodes[out_name]
@@ -719,6 +736,8 @@ class ComputationGraph:
             total = total + node.layer.compute_loss(
                 params.get(out_name, {}), feats, y, m, train=train, rng=r_out)
         reg = _regularization_penalty(params, self._layers_meta)
+        if carries is not None:
+            return total + reg, (new_state, new_carries)
         return total + reg, new_state
 
     # NOTE: output layers' loss consumes the activation of their INPUT node
@@ -727,22 +746,22 @@ class ComputationGraph:
     # activates and scores.
 
     # -- train step ----------------------------------------------------
-    def _make_step_fn(self):
+    def _make_step_fn(self, with_carries: bool = False):
+        """One step body shared by the plain and TBPTT paths (the only
+        difference is RNN-carry threading) — a single definition keeps
+        clipping/updater/constraint behavior identical on both."""
         updaters = self._updaters
         max_norm = self.conf.max_grad_norm
         clip_value = self.conf.grad_clip_value
 
         nodes = self.conf.nodes
 
-        def step_fn(params, opt_state, net_state, step, inputs, labels, masks, rng):
-            (loss, new_net_state), grads = jax.value_and_grad(
-                lambda p: self._loss_fn(p, net_state, inputs, labels, masks,
-                                        True, rng), has_aux=True)(params)
-            grads = _clip_grads(grads, max_norm, clip_value)
+        def _apply_updates(params, opt_state, grads, step):
             new_opt = {}
             new_params = {}
             for key, p in params.items():
-                st, upd = updaters[key].apply(opt_state[key], grads[key], step)
+                st, upd = updaters[key].apply(opt_state[key], grads[key],
+                                              step)
                 new_opt[key] = st
                 new_p = jax.tree_util.tree_map(
                     lambda a, u: a - u, p, upd)
@@ -752,12 +771,124 @@ class ComputationGraph:
                     new_p = apply_constraints(layer.constraints, new_p,
                                               layer.bias_param_names())
                 new_params[key] = new_p
+            return new_params, new_opt
+
+        if with_carries:
+            def step_fn(params, opt_state, net_state, step, inputs,
+                        labels, masks, rng, carries):
+                carries = jax.tree_util.tree_map(lax.stop_gradient,
+                                                 carries)
+                (loss, (new_net_state, new_carries)), grads =                     jax.value_and_grad(
+                        lambda p: self._loss_fn(p, net_state, inputs,
+                                                labels, masks, True, rng,
+                                                carries=carries),
+                        has_aux=True)(params)
+                grads = _clip_grads(grads, max_norm, clip_value)
+                new_params, new_opt = _apply_updates(params, opt_state,
+                                                     grads, step)
+                return (new_params, new_opt, new_net_state, loss,
+                        new_carries)
+            return step_fn
+
+        def step_fn(params, opt_state, net_state, step, inputs, labels, masks, rng):
+            (loss, new_net_state), grads = jax.value_and_grad(
+                lambda p: self._loss_fn(p, net_state, inputs, labels, masks,
+                                        True, rng), has_aux=True)(params)
+            grads = _clip_grads(grads, max_norm, clip_value)
+            new_params, new_opt = _apply_updates(params, opt_state, grads,
+                                                 step)
             return new_params, new_opt, new_net_state, loss
 
         return step_fn
 
     def _make_step(self):
         return jax.jit(self._make_step_fn(), donate_argnums=(0, 1, 2))
+
+    def _init_carries(self, batch: int, dtype=jnp.float32):
+        """Zero RNN carries keyed by node name (ref:
+        ComputationGraph.rnnClearPreviousState's state map)."""
+        out = {}
+        for name in self._order:
+            layer = self.conf.nodes[name].layer
+            if layer is not None and getattr(layer, "is_rnn", False):
+                out[name] = layer.init_carry(batch, dtype)
+        return out
+
+    def _make_tbptt_step(self):
+        """Truncated-BPTT chunk step (ref:
+        ComputationGraph.doTruncatedBPTT :~1870): the shared step body
+        with RNN carries threaded across chunks, gradient-stopped at
+        the chunk boundary."""
+        return jax.jit(self._make_step_fn(with_carries=True),
+                       donate_argnums=(0, 1, 2))
+
+    def _fit_tbptt(self, inputs, labels, masks, tbptt: int):
+        """Chunked fwd/bwd over time for every sequence input/label (ref:
+        ComputationGraph.doTruncatedBPTT). Ragged tails pad to the chunk
+        length with feature-mask zeros so every chunk reuses one
+        compiled program."""
+        if getattr(self, "_tbptt_step", None) is None:
+            self._tbptt_step = self._make_tbptt_step()
+        seq_ins = [k for k, v in inputs.items() if v.ndim == 3]
+        T = max(inputs[k].shape[1] for k in seq_ins)
+        B = next(iter(inputs.values())).shape[0]
+        masks = dict(masks) if masks else {}
+        # every sequence input carries an explicit [B, T] feature mask so
+        # the pad region is masked out uniformly; inputs shorter than the
+        # longest sequence are zero-padded to the SAME global T so every
+        # mask/chunk pair stays shape-consistent
+        inputs = dict(inputs)
+        for k in seq_ins:
+            Tk = inputs[k].shape[1]
+            if k not in masks:
+                masks[k] = jnp.ones((B, Tk), inputs[k].dtype)
+            if Tk < T:
+                inputs[k] = jnp.pad(
+                    inputs[k], ((0, 0), (0, T - Tk), (0, 0)))
+                masks[k] = jnp.pad(masks[k], ((0, 0), (0, T - Tk)))
+        # ragged TAILS must also be excluded from the LOSS: sequence
+        # outputs get an explicit label mask (padded with zeros below),
+        # the graph analogue of multilayer TBPTT's single mask doubling
+        # as feature+label mask
+        labels = dict(labels)
+        for out_name in self.conf.graph_outputs:
+            y = labels.get(out_name)
+            if y is not None and getattr(y, "ndim", 0) == 3:
+                Ty = y.shape[1]
+                if out_name not in masks:
+                    masks[out_name] = jnp.ones((B, Ty), y.dtype)
+                if Ty < T:
+                    labels[out_name] = jnp.pad(
+                        y, ((0, 0), (0, T - Ty), (0, 0)))
+                    masks[out_name] = jnp.pad(masks[out_name],
+                                              ((0, 0), (0, T - Ty)))
+        dtype = inputs[seq_ins[0]].dtype
+        carries = self._init_carries(B, dtype)
+        loss = None
+        for t0 in range(0, T, tbptt):
+            def chunk(v):
+                if getattr(v, "ndim", 0) != 3 and getattr(
+                        v, "ndim", 0) != 2:
+                    return v
+                c = v[:, t0:t0 + tbptt]
+                pad = tbptt - c.shape[1]
+                if pad:
+                    widths = ((0, 0), (0, pad)) + ((0, 0),) * (c.ndim - 2)
+                    c = jnp.pad(c, widths)
+                return c
+            ic = {k: chunk(v) if v.ndim == 3 else v
+                  for k, v in inputs.items()}
+            lc = {k: chunk(v) if getattr(v, "ndim", 0) == 3 else v
+                  for k, v in labels.items()}
+            mc = {k: (chunk(v) if getattr(v, "ndim", 0) >= 2
+                      and v.shape[1] == T else v)
+                  for k, v in masks.items()}
+            self._rng, sub = jax.random.split(self._rng)
+            (self._params, self._opt_state, self._net_state, loss,
+             carries) = self._tbptt_step(
+                self._params, self._opt_state, self._net_state,
+                jnp.asarray(self._step), ic, lc, mc, sub, carries)
+        return loss
 
     # -- public API ----------------------------------------------------
     def _as_inputs(self, data) -> Dict[str, jnp.ndarray]:
@@ -794,12 +925,23 @@ class ComputationGraph:
             for item in batches:
                 x, y, m = self._unpack(item)
                 t0 = time.perf_counter()
-                self._rng, sub = jax.random.split(self._rng)
-                (self._params, self._opt_state, self._net_state,
-                 loss) = self._jit_step(
-                    self._params, self._opt_state, self._net_state,
-                    jnp.asarray(self._step), self._as_inputs(x),
-                    self._as_labels(y), self._as_masks(m), sub)
+                inputs = self._as_inputs(x)
+                labels = self._as_labels(y)
+                masks = self._as_masks(m)
+                tbptt = self.conf.tbptt_fwd_length
+                seq_T = [v.shape[1] for v in inputs.values()
+                         if v.ndim == 3]
+                if tbptt and seq_T and max(seq_T) > tbptt:
+                    # ref: ComputationGraph.doTruncatedBPTT — chunk the
+                    # time axis, carry RNN state across chunks
+                    loss = self._fit_tbptt(inputs, labels, masks, tbptt)
+                else:
+                    self._rng, sub = jax.random.split(self._rng)
+                    (self._params, self._opt_state, self._net_state,
+                     loss) = self._jit_step(
+                        self._params, self._opt_state, self._net_state,
+                        jnp.asarray(self._step), inputs, labels, masks,
+                        sub)
                 self._step += 1
                 self._last_loss = loss
                 dur = time.perf_counter() - t0

