@@ -13,6 +13,7 @@ the jax.debug/checkify-era equivalent of the reference's per-op panics.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from collections import defaultdict
 from enum import Enum
@@ -65,6 +66,9 @@ class OpProfiler:
         self.mode = ProfilingMode.DISABLED
         self._totals: Dict[str, float] = defaultdict(float)
         self._counts: Dict[str, int] = defaultdict(int)
+        # serving records sections from many threads; unlocked '+=' on
+        # the shared dicts would lose updates under preemption
+        self._rec_lock = threading.Lock()
 
     @classmethod
     def get_instance(cls) -> "OpProfiler":
@@ -84,8 +88,10 @@ class OpProfiler:
             yield
         finally:
             if self.mode in (ProfilingMode.OPERATIONS, ProfilingMode.ALL):
-                self._totals[name] += time.perf_counter() - t0
-                self._counts[name] += 1
+                dt = time.perf_counter() - t0
+                with self._rec_lock:
+                    self._totals[name] += dt
+                    self._counts[name] += 1
 
     def check(self, tree, label: str = "array"):
         """Apply the active panic mode to a pytree of arrays."""
@@ -113,6 +119,86 @@ class OpProfiler:
             print(f"{name:<32} {t['count']:>8} calls "
                   f"{t['total_s'] * 1e3:>10.2f} ms total "
                   f"{t['mean_s'] * 1e6:>10.1f} us/call")
+
+
+class Reservoir:
+    """Bounded sample reservoir with percentile queries (ref role: the
+    reference's PerformanceListener latency aggregation). Keeps the most
+    recent ``size`` samples (ring buffer) — serving traffic wants the
+    recent distribution, not the all-time one — and answers p50/p99 via
+    a sorted copy on read. Thread-safe; record() is O(1)."""
+
+    def __init__(self, size: int = 8192):
+        self._size = int(size)
+        self._buf = [0.0] * self._size
+        self._n = 0          # total samples ever
+        self._lock = threading.Lock()
+
+    def record(self, value: float):
+        with self._lock:
+            self._buf[self._n % self._size] = float(value)
+            self._n += 1
+
+    def count(self) -> int:
+        return self._n
+
+    def _samples(self):
+        with self._lock:
+            k = min(self._n, self._size)
+            return sorted(self._buf[:k])
+
+    @staticmethod
+    def _nearest_rank(s, p: float) -> float:
+        return s[min(len(s) - 1,
+                     max(0, int(round(p / 100.0 * (len(s) - 1)))))]
+
+    def percentile(self, p: float) -> float:
+        """Nearest-rank percentile over the retained window (0 if empty)."""
+        s = self._samples()
+        return self._nearest_rank(s, p) if s else 0.0
+
+    def snapshot(self) -> Dict[str, float]:
+        s = self._samples()
+        if not s:
+            return {"count": self._n, "mean": 0.0, "p50": 0.0,
+                    "p90": 0.0, "p99": 0.0, "max": 0.0}
+        return {"count": self._n,
+                "mean": float(sum(s) / len(s)),
+                "p50": self._nearest_rank(s, 50),
+                "p90": self._nearest_rank(s, 90),
+                "p99": self._nearest_rank(s, 99),
+                "max": s[-1]}
+
+
+class CountHistogram:
+    """Exact value->count histogram for small integer domains (batch
+    sizes, bucket ids). Thread-safe."""
+
+    def __init__(self):
+        self._counts: Dict[int, int] = defaultdict(int)
+        self._lock = threading.Lock()
+
+    def record(self, value: int, weight: int = 1):
+        with self._lock:
+            self._counts[int(value)] += int(weight)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {str(k): v for k, v in sorted(self._counts.items())}
+
+    def total(self) -> int:
+        with self._lock:
+            return sum(self._counts.values())
+
+    def weighted_sum(self) -> int:
+        with self._lock:
+            return sum(k * v for k, v in self._counts.items())
+
+    def mean(self) -> float:
+        with self._lock:
+            n = sum(self._counts.values())
+            return (sum(k * v for k, v in self._counts.items()) / n
+                    if n else 0.0)
 
 
 @contextlib.contextmanager

@@ -1,20 +1,44 @@
 """Model serving + compiled-program export.
 
 Ref: the reference's serving surface — `libnd4j/server/GraphServer.cpp`
-(gRPC + FlatBuffers inference server), the KNN REST server
+(gRPC + FlatBuffers inference server that caches the compiled graph
+across requests), the KNN REST server
 (`deeplearning4j-nearestneighbor-server`), and datavec's
 spark-inference REST endpoints (L7 inventory).
 
-TPU-native shape:
-- :class:`InferenceServer`: one stdlib HTTP endpoint serving any model
-  with an `output(x)` method (MultiLayerNetwork, ComputationGraph) or a
-  SameDiff (named-placeholder feed). JSON in/out; the compiled forward
-  is cached across requests exactly like the C++ server caches its
-  FlatBuffers graph.
+TPU-native shape — a real inference runtime, not one call per request:
+
+- :class:`~.engine.InferenceEngine`: pads request batches into
+  power-of-two buckets and keeps a bounded LRU of AOT-compiled
+  executables per (bucket, signature), with `warmup()` so steady-state
+  traffic never recompiles (the GraphServer compiled-graph cache,
+  generalized across batch shapes).
+- :class:`~.batcher.MicroBatcher`: coalesces concurrent requests into
+  one device call under a max_batch_size / max_latency_ms policy, with
+  per-request deadlines and a bounded queue that sheds load (503)
+  instead of growing without limit (TF Serving BatchingSession /
+  Clipper adaptive batching, PAPERS.md).
+- :class:`~.registry.ModelRegistry`: named, versioned multi-model
+  hosting, routed at ``/v1/models/<name>/predict``.
+- :class:`InferenceServer`: the thin stdlib-HTTP front-end over
+  registry + batcher. The legacy single-model constructor
+  (``InferenceServer(model, port=0)``) still works and routes through
+  the full runtime.
 - :func:`export_stablehlo`: serialize a SameDiff (or any jittable
   fn+args) to StableHLO text — the portable compiled-graph artifact
-  replacing the reference's FlatBuffers graph format (SURVEY.md §2.1:
-  "N5 -> StableHLO module serialization").
+  replacing the reference's FlatBuffers graph format (SURVEY.md §2.1).
+
+HTTP surface::
+
+    POST /predict                      default model
+    POST /v1/models/<name>/predict     named model (latest version)
+    GET  /v1/models                    registry listing
+    GET  /stats                        serving metrics per model
+    GET  /health
+
+Status codes: 400 malformed request (client), 404 unknown route/model,
+500 internal failure, 503 load shed (queue full), 504 deadline
+exceeded.
 """
 from __future__ import annotations
 
@@ -25,6 +49,18 @@ from typing import Any, Dict, Optional, Sequence
 
 import jax
 import numpy as np
+
+from .batcher import DeadlineExceededError, MicroBatcher, QueueFullError
+from .engine import ClientError, InferenceEngine, ServingError, next_bucket
+from .metrics import ServingMetrics, profiler_sections
+from .registry import ModelNotFound, ModelRegistry, ServedModel
+
+__all__ = [
+    "InferenceServer", "InferenceEngine", "MicroBatcher", "ModelRegistry",
+    "ModelNotFound", "ServedModel", "ServingMetrics", "ClientError",
+    "ServingError", "QueueFullError", "DeadlineExceededError",
+    "next_bucket", "export_stablehlo",
+]
 
 
 def export_stablehlo(fn_or_samediff, example_args=None,
@@ -50,22 +86,79 @@ def export_stablehlo(fn_or_samediff, example_args=None,
     return lowered.as_text()
 
 
-class InferenceServer:
-    """HTTP JSON inference endpoint (ref role: GraphServer.cpp).
+class _HTTPServer(ThreadingHTTPServer):
+    # the stdlib default backlog of 5 drops SYNs under concurrent-client
+    # load (clients then stall ~1s in retransmit — a fake p99); size it
+    # for the serving queue instead
+    request_queue_size = 128
+    daemon_threads = True
 
-    POST /predict           {"inputs": [[...]]} -> {"outputs": [[...]]}
-    POST /predict (SameDiff) {"inputs": {"x": [[...]]},
-                              "outputs": ["pred"]}
-    GET  /health            {"status": "ok", "model": "..."}
+
+def _status_for(exc: BaseException) -> int:
+    if isinstance(exc, ModelNotFound):
+        return 404
+    if isinstance(exc, QueueFullError):
+        return 503
+    if isinstance(exc, DeadlineExceededError):
+        return 504
+    if isinstance(exc, ClientError):
+        return 400
+    return 500
+
+
+class InferenceServer:
+    """HTTP JSON inference front-end over registry + batcher (ref role:
+    GraphServer.cpp).
+
+    Single-model (legacy, still supported)::
+
+        server = InferenceServer(model, port=0)
+
+    Multi-model::
+
+        server = InferenceServer(port=0)
+        server.register("mnist", model_a)
+        server.register("ranker", model_b, default_outputs=["score"])
+
+    ``host`` defaults to loopback; pass ``host="0.0.0.0"`` to bind
+    externally for multi-host deployments.
     """
 
-    def __init__(self, model, port: int = 0,
-                 default_outputs: Optional[Sequence[str]] = None):
-        self.model = model
-        self.default_outputs = list(default_outputs or [])
+    DEFAULT_MODEL = "default"
+
+    def __init__(self, model=None, port: int = 0,
+                 default_outputs: Optional[Sequence[str]] = None,
+                 host: str = "127.0.0.1",
+                 registry: Optional[ModelRegistry] = None,
+                 batching: bool = True,
+                 max_batch_size: int = 64,
+                 max_latency_ms: float = 5.0,
+                 max_queue: int = 256,
+                 default_timeout_ms: float = 30_000.0,
+                 warmup_buckets: Optional[Sequence[int]] = None,
+                 warmup_example=None,
+                 max_body_bytes: int = 256 * 1024 * 1024):
+        self.max_body_bytes = int(max_body_bytes)
+        self.registry = registry or ModelRegistry()
+        self._owns_registry = registry is None
+        self._opts = dict(batching=batching, max_batch_size=max_batch_size,
+                          max_latency_ms=max_latency_ms,
+                          max_queue=max_queue,
+                          default_timeout_ms=default_timeout_ms)
+        self.model = model  # legacy attribute
+        if model is not None:
+            served = self.register(self.DEFAULT_MODEL, model,
+                                   default_outputs=default_outputs)
+            if warmup_buckets:
+                served.warmup(warmup_buckets, example=warmup_example)
         server = self
 
         class Handler(BaseHTTPRequestHandler):
+            # keep-alive: serving clients send many small requests, and
+            # per-request TCP setup would dominate the batched path
+            # (every response carries Content-Length, so 1.1 is safe)
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *a):
                 pass
 
@@ -78,46 +171,147 @@ class InferenceServer:
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == "/health":
-                    self._json({"status": "ok",
-                                "model": type(server.model).__name__})
-                else:
-                    self._json({"error": "not found"}, 404)
+                try:
+                    if self.path == "/health":
+                        self._json(server._health())
+                    elif self.path == "/stats":
+                        self._json(server.stats())
+                    elif self.path in ("/v1/models", "/v1/models/"):
+                        self._json(server.registry.describe())
+                    else:
+                        self._json({"error": "not found"}, 404)
+                except Exception as e:  # noqa: BLE001
+                    self._json({"error": str(e)}, 500)
 
             def do_POST(self):
-                if self.path != "/predict":
-                    self._json({"error": "not found"}, 404)
+                # drain the body first: on a keep-alive (1.1) connection
+                # an unread body would be parsed as the next request
+                # line, desyncing the socket. Bad/negative lengths are a
+                # 400, never an unhandled exception or an
+                # until-EOF read (a hung handler thread).
+                if self.headers.get("Transfer-Encoding"):
+                    # chunked framing isn't parsed here; without the
+                    # body drained the keep-alive socket would desync
+                    self._json({"error": "Transfer-Encoding not "
+                                "supported; send Content-Length"}, 501)
+                    self.close_connection = True
                     return
                 try:
                     n = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(n))
-                    out = server._predict(req)
+                except (TypeError, ValueError):
+                    n = -1
+                if n < 0:
+                    self._json({"error": "bad Content-Length"}, 400)
+                    self.close_connection = True  # body length unknown
+                    return
+                if n > server.max_body_bytes:
+                    # one oversized request must not OOM the process —
+                    # the queue bounds count rows, this bounds bytes
+                    self._json({"error": "request body too large "
+                                f"(limit {server.max_body_bytes} "
+                                "bytes)"}, 413)
+                    self.close_connection = True  # body left unread
+                    return
+                raw = self.rfile.read(n)
+                name = server._route(self.path)
+                if name is None:
+                    self._json({"error": "not found"}, 404)
+                    return
+                req = None
+                try:
+                    try:
+                        req = json.loads(raw)
+                    except json.JSONDecodeError as e:
+                        raise ClientError(f"malformed JSON: {e}")
+                    out = server._predict(name, req)
                     self._json(out)
-                except Exception as e:  # noqa: BLE001 — surface to client
-                    self._json({"error": str(e)}, 400)
+                except Exception as e:  # noqa: BLE001
+                    code = _status_for(e)
+                    version = (req.get("version")
+                               if isinstance(req, dict) else None)
+                    server._count_error(name, code, version)
+                    self._json({"error": str(e)}, code)
 
-        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.httpd = _HTTPServer((host, port), Handler)
+        self.host = self.httpd.server_address[0]
         self.port = self.httpd.server_address[1]
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
 
-    def _predict(self, req: dict) -> dict:
-        inputs = req["inputs"]
-        from ..autodiff.samediff import SameDiff
-        if isinstance(self.model, SameDiff):
-            feed = {k: np.asarray(v, np.float32)
-                    for k, v in inputs.items()}
-            outs = req.get("outputs") or self.default_outputs
-            if not outs:
-                raise ValueError("SameDiff serving needs 'outputs'")
-            res = self.model.output(feed, outs)
+    # -- model management ----------------------------------------------
+    def register(self, name: str, model, **opts) -> ServedModel:
+        """Register a model under ``name`` (engine + batcher built from
+        the server's batching policy unless overridden in ``opts``)."""
+        merged = dict(self._opts)
+        merged.update(opts)
+        return self.registry.register(name, model, **merged)
+
+    def unregister(self, name: str, version: Optional[int] = None):
+        self.registry.unregister(name, version)
+
+    def served(self, name: str = DEFAULT_MODEL,
+               version: Optional[int] = None) -> ServedModel:
+        return self.registry.get(name, version)
+
+    # -- request handling ----------------------------------------------
+    def _route(self, path: str) -> Optional[str]:
+        """Map a POST path to a model name (None = 404)."""
+        if path == "/predict":
+            return self.DEFAULT_MODEL
+        parts = [p for p in path.split("/") if p]
+        if len(parts) == 4 and parts[:2] == ["v1", "models"] \
+                and parts[3] == "predict":
+            return parts[2]
+        return None
+
+    def _predict(self, name: str, req) -> dict:
+        if not isinstance(req, dict):
+            raise ClientError("request body must be a JSON object")
+        if "inputs" not in req:
+            raise ClientError("missing 'inputs'")
+        version = req.get("version")
+        if version is not None and not isinstance(version, int):
+            raise ClientError("'version' must be an integer")
+        served = self.registry.get(name, version)
+        outputs = req.get("outputs")
+        if outputs is not None and not isinstance(outputs, (list, tuple)):
+            raise ClientError("'outputs' must be a list of names")
+        timeout_ms = req.get("timeout_ms")
+        if timeout_ms is not None and not isinstance(timeout_ms,
+                                                     (int, float)):
+            raise ClientError("'timeout_ms' must be a number")
+        res = served.predict(req["inputs"], outputs, timeout_ms=timeout_ms)
+        if isinstance(res, dict):
             return {"outputs": {k: np.asarray(v).tolist()
                                 for k, v in res.items()}}
-        x = np.asarray(inputs, np.float32)
-        y = np.asarray(self.model.output(x))
-        return {"outputs": y.tolist()}
+        if isinstance(res, list):
+            return {"outputs": [np.asarray(v).tolist() for v in res]}
+        return {"outputs": np.asarray(res).tolist()}
+
+    def _count_error(self, name: str, code: int, version=None):
+        try:
+            m = self.registry.get(
+                name, version if isinstance(version, int) else None).metrics
+        except Exception:  # noqa: BLE001 — unknown model has no metrics
+            return
+        if code == 400:
+            m.inc("client_errors")
+        elif code >= 500 and code not in (503, 504):
+            m.inc("server_errors")
+
+    def _health(self) -> dict:
+        d = {"status": "ok", "models": self.registry.names()}
+        if self.model is not None:
+            d["model"] = type(self.model).__name__  # legacy field
+        return d
+
+    def stats(self) -> dict:
+        return {"models": self.registry.stats(),
+                "profiler": profiler_sections()}
 
     def stop(self):
         self.httpd.shutdown()
         self.httpd.server_close()
+        if self._owns_registry:
+            self.registry.stop()

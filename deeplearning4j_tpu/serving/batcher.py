@@ -1,0 +1,220 @@
+"""Micro-batching scheduler: coalesce concurrent requests into one
+device call.
+
+Ref role: TensorFlow Serving's BatchingSession / Clipper's adaptive
+batching layer (PAPERS.md) — the standard accelerator-serving design:
+a bounded request queue feeds a single scheduler thread that waits up
+to ``max_latency_ms`` for the batch to fill (or ``max_batch_size``
+rows, whichever first), issues ONE padded device call through the
+:class:`~.engine.InferenceEngine`, and scatters the rows back to the
+waiting clients.
+
+Overload semantics are explicit: a full queue SHEDS the request
+(:class:`QueueFullError` → HTTP 503) rather than growing without
+bound, and every request carries a deadline
+(:class:`DeadlineExceededError` → HTTP 504) so a stalled device cannot
+strand clients forever.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from typing import Any, Optional, Sequence
+
+from ..profiler import OpProfiler
+from .engine import (ClientError, InferenceEngine, ServingError,
+                     _concat_results, _slice)
+
+
+class QueueFullError(ServingError):
+    """Load shed: the request queue is at capacity (HTTP 503)."""
+
+
+class DeadlineExceededError(ServingError):
+    """The request's deadline passed before a result was ready
+    (HTTP 504)."""
+
+
+class _Request:
+    __slots__ = ("feed", "n", "sig", "deadline", "event", "result",
+                 "error", "t_submit", "abandoned")
+
+    def __init__(self, feed, n, sig, deadline):
+        self.feed = feed
+        self.n = n
+        self.sig = sig
+        self.deadline = deadline
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.t_submit = time.perf_counter()
+        self.abandoned = False  # submitter gave up; don't execute/count
+
+
+class MicroBatcher:
+    """Thread-based request queue + scheduler over one engine.
+
+    ``submit`` blocks the calling (HTTP handler) thread until its rows
+    come back; the scheduler thread owns all device calls, so requests
+    admitted while one batch executes pile up and ride the next call —
+    that queueing is exactly what produces coalescing under load.
+    """
+
+    def __init__(self, engine: InferenceEngine,
+                 max_batch_size: Optional[int] = None,
+                 max_latency_ms: float = 5.0,
+                 max_queue: int = 256,
+                 default_timeout_ms: float = 30_000.0):
+        self.engine = engine
+        self.max_batch_size = int(max_batch_size or engine.max_batch_size)
+        if self.max_batch_size > engine.max_batch_size:
+            raise ValueError("batcher max_batch_size exceeds the engine's")
+        self.max_latency_ms = float(max_latency_ms)
+        self.default_timeout_ms = float(default_timeout_ms)
+        self.metrics = engine.metrics
+        self.metrics.queue_max = int(max_queue)
+        self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=max_queue)
+        self._held: "deque[_Request]" = deque()  # signature-mismatched
+        self._profiler = OpProfiler.get_instance()
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serving-batcher")
+        self._thread.start()
+
+    # -- client side ---------------------------------------------------
+    def submit(self, inputs, outputs: Optional[Sequence[str]] = None,
+               timeout_ms: Optional[float] = None) -> Any:
+        """Enqueue one request and block until its result. Raises
+        :class:`~.engine.ClientError` on malformed payloads,
+        :class:`QueueFullError` when shedding, and
+        :class:`DeadlineExceededError` past the deadline."""
+        if not self._running:
+            raise ServingError("batcher is stopped")
+        feed, n, sig = self.engine.normalize(inputs, outputs)
+        if n > self.max_batch_size:
+            raise ClientError(
+                f"request batch {n} exceeds max_batch_size="
+                f"{self.max_batch_size}; split the request")
+        timeout = (self.default_timeout_ms if timeout_ms is None
+                   else float(timeout_ms)) / 1000.0
+        req = _Request(feed, n, sig, deadline=time.perf_counter() + timeout)
+        try:
+            self._queue.put_nowait(req)
+        except queue.Full:
+            self.metrics.inc("shed")
+            raise QueueFullError(
+                f"queue full ({self.metrics.queue_max}); shedding load")
+        if not self._running:
+            # raced with stop(): the scheduler may already have drained
+            # the queue — fail fast, don't strand the caller on wait()
+            req.abandoned = True
+            raise ServingError("batcher is stopped")
+        self.metrics.inc("requests")
+        self.metrics.queue_depth = self._queue.qsize()
+        if not req.event.wait(timeout + 1.0):  # grace for the device call
+            req.abandoned = True  # scheduler: skip it, don't recount it
+            self.metrics.inc("timeouts")
+            raise DeadlineExceededError(
+                f"no result within {timeout * 1e3:.0f} ms")
+        if req.error is not None:
+            raise req.error
+        self.metrics.inc("responses")
+        self.metrics.latency_ms.record(
+            (time.perf_counter() - req.t_submit) * 1e3)
+        return req.result
+
+    # -- scheduler side ------------------------------------------------
+    def _next(self, block_s: Optional[float]):
+        if self._held:
+            return self._held.popleft()
+        try:
+            return self._queue.get(timeout=block_s) if block_s else \
+                self._queue.get_nowait()
+        except queue.Empty:
+            return None
+
+    def _expired(self, req) -> bool:
+        """Drop a dead request instead of spending device time on rows
+        nobody will read. Counts the timeout only if the submitter has
+        not already counted it (abandoned)."""
+        if req.abandoned:
+            return True
+        if time.perf_counter() > req.deadline:
+            req.error = DeadlineExceededError("expired in queue")
+            self.metrics.inc("timeouts")
+            req.event.set()
+            return True
+        return False
+
+    def _loop(self):
+        while self._running:
+            head = self._next(0.05)
+            if head is None or self._expired(head):
+                continue
+            batch = [head]
+            rows = head.n
+            flush_at = time.perf_counter() + self.max_latency_ms / 1000.0
+            skipped = []
+            while rows < self.max_batch_size:
+                wait = flush_at - time.perf_counter()
+                nxt = self._next(wait if wait > 0 else None)
+                if nxt is None:
+                    break
+                if self._expired(nxt):
+                    continue
+                if nxt.sig != head.sig:
+                    skipped.append(nxt)  # rides a later batch; keep
+                    continue             # filling this one
+                if rows + nxt.n > self.max_batch_size:
+                    skipped.append(nxt)
+                    break  # same sig but over budget — batch is full
+                batch.append(nxt)
+                rows += nxt.n
+            self._held.extend(skipped)
+            self._execute(batch, rows)
+            self.metrics.queue_depth = self._queue.qsize()
+        # drain on stop: fail fast rather than strand waiters
+        for req in list(self._held):
+            req.error = ServingError("batcher stopped")
+            req.event.set()
+
+    def _execute(self, batch, rows):
+        feeds = [r.feed for r in batch]
+        feed = feeds[0] if len(feeds) == 1 else _concat_results(feeds)
+        self.metrics.inc("batches")
+        self.metrics.batch_hist.record(rows)
+        t0 = time.perf_counter()
+        try:
+            with self._profiler.record("serving.batch"):
+                # rows were normalized in submit(); the sig is shared by
+                # construction — skip re-validating on the hot path
+                res = self.engine.predict_normalized(feed, rows,
+                                                     batch[0].sig)
+        except Exception as e:  # noqa: BLE001 — scatter to all waiters
+            for r in batch:
+                r.error = e
+                r.event.set()
+            return
+        self.metrics.device_ms.record((time.perf_counter() - t0) * 1e3)
+        lo = 0
+        for r in batch:
+            r.result = _slice(res, lo, lo + r.n)
+            lo += r.n
+            r.event.set()
+
+    def stop(self, timeout_s: float = 5.0):
+        self._running = False
+        self._thread.join(timeout=timeout_s)
+        # fail anything still queued
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            req.error = ServingError("batcher stopped")
+            req.event.set()
+
+
+

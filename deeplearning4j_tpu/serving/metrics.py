@@ -1,0 +1,89 @@
+"""Serving metrics: queue depth, batch-size histogram, latency
+percentiles, compile-cache hits/misses.
+
+Built on the profiler's section machinery (`OpProfiler.record` names
+``serving.*`` sections) plus the :class:`Reservoir` /
+:class:`CountHistogram` aggregates it exposes; `GET /stats` on the
+server returns :meth:`ServingMetrics.snapshot` per model.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+from ..profiler import CountHistogram, OpProfiler, Reservoir
+
+
+class ServingMetrics:
+    """Always-on counters for one served model (the reference's
+    PerformanceListener role, serving-side). Scalar counters are
+    mutated from many HTTP handler threads — use :meth:`inc`, not
+    ``+=`` (attribute += is load/add/store and loses updates under
+    preemption)."""
+
+    def __init__(self, latency_window: int = 8192):
+        self._lock = threading.Lock()
+        self.requests = 0          # accepted into the queue/engine
+        self.responses = 0         # successful results returned
+        self.client_errors = 0     # 4xx-class failures
+        self.server_errors = 0     # 5xx-class failures
+        self.shed = 0              # rejected, queue full (503)
+        self.timeouts = 0          # request deadline exceeded (504)
+        self.batches = 0           # device calls issued
+        self.batch_hist = CountHistogram()   # rows per device call
+        self.bucket_hist = CountHistogram()  # padded bucket per call
+        self.latency_ms = Reservoir(latency_window)    # request e2e
+        self.device_ms = Reservoir(latency_window)     # device call
+        self.queue_depth = 0       # gauge, updated by the batcher
+        self.queue_max = 0
+        # engine compile cache
+        self.compiles = 0
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.cache_evictions = 0
+        self.warmed_buckets: List[int] = []
+
+    def inc(self, field: str, n: int = 1):
+        """Thread-safe counter increment."""
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def mean_batch(self) -> float:
+        """Mean number of real rows per device call — the coalescing
+        factor (1.0 means the batcher never merged anything)."""
+        return self.batch_hist.mean()
+
+    def snapshot(self) -> Dict:
+        return {
+            "requests": self.requests,
+            "responses": self.responses,
+            "client_errors": self.client_errors,
+            "server_errors": self.server_errors,
+            "shed": self.shed,
+            "timeouts": self.timeouts,
+            "queue_depth": self.queue_depth,
+            "queue_max": self.queue_max,
+            "batches": self.batches,
+            "mean_batch": round(self.mean_batch(), 3),
+            "batch_hist": self.batch_hist.snapshot(),
+            "bucket_hist": self.bucket_hist.snapshot(),
+            "latency_ms": {k: round(v, 3) for k, v in
+                           self.latency_ms.snapshot().items()},
+            "device_ms": {k: round(v, 3) for k, v in
+                          self.device_ms.snapshot().items()},
+            "compile_cache": {
+                "compiles": self.compiles,
+                "hits": self.cache_hits,
+                "misses": self.cache_misses,
+                "evictions": self.cache_evictions,
+                "warmed_buckets": list(self.warmed_buckets),
+            },
+        }
+
+
+def profiler_sections() -> Dict:
+    """The profiler's own `serving.*` section timings (populated when
+    ProfilingMode is OPERATIONS/ALL), merged into `GET /stats`."""
+    return {name: stats for name, stats in
+            OpProfiler.get_instance().timings().items()
+            if name.startswith("serving.")}

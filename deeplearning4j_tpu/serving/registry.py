@@ -1,0 +1,167 @@
+"""Named, versioned multi-model registry.
+
+Ref role: TF Serving's ServableManager / the reference's model-server
+routing — one server process hosts many models, each addressed as
+``/v1/models/<name>/predict``, with versions so a new model can be
+registered next to the old one and the old one retired atomically.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from .batcher import MicroBatcher
+from .engine import ClientError, InferenceEngine
+from .metrics import ServingMetrics
+
+
+class ModelNotFound(ClientError):
+    """No such model name/version in the registry (HTTP 404)."""
+
+
+class ServedModel:
+    """One (model, version) plus its engine and (optional) batcher."""
+
+    def __init__(self, name: str, version: int, model,
+                 default_outputs: Optional[Sequence[str]] = None,
+                 batching: bool = True, max_batch_size: int = 64,
+                 max_latency_ms: float = 5.0, max_queue: int = 256,
+                 cache_size: int = 16,
+                 default_timeout_ms: float = 30_000.0):
+        self.name = name
+        self.version = int(version)
+        self.model = model
+        self.engine = InferenceEngine(
+            model, default_outputs=default_outputs,
+            max_batch_size=max_batch_size, cache_size=cache_size)
+        self.batcher = MicroBatcher(
+            self.engine, max_batch_size=max_batch_size,
+            max_latency_ms=max_latency_ms, max_queue=max_queue,
+            default_timeout_ms=default_timeout_ms) if batching else None
+
+    @property
+    def metrics(self) -> ServingMetrics:
+        return self.engine.metrics
+
+    def predict(self, inputs, outputs: Optional[Sequence[str]] = None,
+                timeout_ms: Optional[float] = None):
+        if self.batcher is not None:
+            return self.batcher.submit(inputs, outputs,
+                                       timeout_ms=timeout_ms)
+        # direct path (batching=False): synchronous, so timeout_ms has
+        # no queue to bound — but request metrics must still flow
+        m = self.metrics
+        m.inc("requests")
+        t0 = time.perf_counter()
+        res = self.engine.predict(inputs, outputs)
+        m.inc("responses")
+        m.latency_ms.record((time.perf_counter() - t0) * 1e3)
+        return res
+
+    def warmup(self, buckets: Sequence[int], example=None,
+               outputs: Optional[Sequence[str]] = None):
+        return self.engine.warmup(buckets, example=example, outputs=outputs)
+
+    def stop(self):
+        if self.batcher is not None:
+            self.batcher.stop()
+
+    def stats(self) -> Dict:
+        s = self.metrics.snapshot()
+        s["version"] = self.version
+        s["model_class"] = type(self.model).__name__
+        s["batching"] = self.batcher is not None
+        return s
+
+
+class ModelRegistry:
+    """register/get/unregister by name (+ version; default = latest)."""
+
+    def __init__(self):
+        self._models: Dict[str, Dict[int, ServedModel]] = {}
+        self._lock = threading.Lock()
+
+    def register(self, name: str, model,
+                 version: Optional[int] = None, **opts) -> ServedModel:
+        """Create the engine+batcher for ``model`` and route it at
+        ``name``. ``version`` defaults to (latest + 1)."""
+        with self._lock:
+            versions = self._models.setdefault(name, {})
+            try:
+                if version is None:
+                    version = max(versions) + 1 if versions else 1
+                version = int(version)
+                if version in versions:
+                    raise ValueError(f"model {name!r} version {version} "
+                                     "already registered")
+                served = ServedModel(name, version, model, **opts)
+                versions[version] = served
+                return served
+            finally:
+                # a failed construction must not leave an empty version
+                # dict behind (it would break describe()/stats() forever)
+                if not versions:
+                    self._models.pop(name, None)
+
+    def get(self, name: str, version: Optional[int] = None) -> ServedModel:
+        with self._lock:
+            versions = self._models.get(name)
+            if not versions:
+                raise ModelNotFound(f"unknown model {name!r}")
+            if version is None:
+                return versions[max(versions)]
+            if int(version) not in versions:
+                raise ModelNotFound(
+                    f"model {name!r} has no version {version}")
+            return versions[int(version)]
+
+    def unregister(self, name: str, version: Optional[int] = None):
+        """Remove (and stop) one version, or all versions of a name."""
+        with self._lock:
+            versions = self._models.get(name)
+            if not versions:
+                raise ModelNotFound(f"unknown model {name!r}")
+            if version is None:
+                stopped = list(versions.values())
+                del self._models[name]
+            else:
+                if int(version) not in versions:
+                    raise ModelNotFound(
+                        f"model {name!r} has no version {version}")
+                stopped = [versions.pop(int(version))]
+                if not versions:
+                    del self._models[name]
+        for served in stopped:
+            served.stop()
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def describe(self) -> Dict:
+        with self._lock:
+            return {name: {"versions": sorted(vs),
+                           "latest": max(vs)}
+                    for name, vs in self._models.items()}
+
+    def stats(self) -> Dict:
+        """Latest version under the bare name; older versions that are
+        still live (pinnable via request "version") under name@v, so
+        their traffic stays observable."""
+        with self._lock:
+            items = []
+            for name, vs in self._models.items():
+                latest = max(vs)
+                items.append((name, vs[latest]))
+                items.extend((f"{name}@{v}", served)
+                             for v, served in vs.items() if v != latest)
+        return {key: served.stats() for key, served in items}
+
+    def stop(self):
+        with self._lock:
+            stopped = [s for vs in self._models.values()
+                       for s in vs.values()]
+            self._models.clear()
+        for served in stopped:
+            served.stop()

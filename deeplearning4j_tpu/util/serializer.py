@@ -27,7 +27,11 @@ def _flatten_tree(tree) -> Dict[str, np.ndarray]:
     leaves_with_paths = jax.tree_util.tree_flatten_with_path(tree)[0]
     for path, leaf in leaves_with_paths:
         key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
-        flat[key] = np.asarray(leaf)
+        # copy=True, not asarray: on CPU, asarray(jax_array) can be a
+        # ZERO-COPY view of the device buffer, and training steps donate
+        # (alias) those buffers — a checkpoint snapshot must own its
+        # memory, not alias a buffer the next step will overwrite
+        flat[key] = np.array(leaf, copy=True)
     return flat
 
 

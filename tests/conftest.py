@@ -22,11 +22,14 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
-# Persistent compilation cache: caching XLA executables across runs cuts
-# wall-clock on repeat runs.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/.cache/jax_test_cache")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.2")
+# NOTE: the persistent compilation cache (JAX_COMPILATION_CACHE_DIR +
+# MIN_ENTRY_SIZE=-1/MIN_COMPILE_TIME=0.2) is deliberately NOT enabled.
+# On this jaxlib it corrupts the glibc heap when cache-served
+# executables run with donated buffers (donate_argnums step fns):
+# tests/test_attention_elastic.py's checkpoint-resume flow aborted with
+# "corrupted double-linked list", killing the whole suite. Reproduced
+# with an empty cache dir (write path, not stale entries); disappears
+# with the cache env removed. Correctness over rerun speed.
 
 import jax  # noqa: E402
 

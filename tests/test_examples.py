@@ -11,7 +11,7 @@ _COVERED = {"lenet_mnist", "vae_anomaly", "bilstm_text_classification",
             "data_parallel", "dqn_cartpole", "transfer_learning",
             "custom_samediff_layer", "csv_classifier_etl",
             "distributed_transformer_4d", "remote_training_dashboard",
-            "audio_classification_wav"}
+            "audio_classification_wav", "model_serving"}
 
 
 def test_every_example_has_a_test():
@@ -86,3 +86,10 @@ def test_audio_classification_wav():
     import audio_classification_wav
     acc = audio_classification_wav.main(quick=True)
     assert acc > 0.7
+
+
+def test_model_serving():
+    import model_serving
+    m = model_serving.main(quick=True)
+    assert m["responses"] == 24          # 8 clients x 3 requests
+    assert m["compile_cache"]["compiles"] <= 5   # warmup-bounded

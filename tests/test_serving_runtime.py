@@ -1,0 +1,507 @@
+"""Dynamic-batching inference runtime tests: engine (bucketed compile
+cache), micro-batcher (coalescing, deadlines, load shed), registry
+(multi-model routing), serving metrics, and HTTP error-class mapping."""
+import http.client
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.serving import (ClientError, DeadlineExceededError,
+                                        InferenceEngine, InferenceServer,
+                                        MicroBatcher, ModelNotFound,
+                                        ModelRegistry, QueueFullError,
+                                        next_bucket)
+
+
+def _mlp(seed=0, n_in=4, n_out=3):
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=n_out, loss="mcxent",
+                               activation="softmax"))
+            .input_type_feed_forward(n_in).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _post(base, path, payload, timeout=30):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req, timeout=timeout).read())
+
+
+class _Slow:
+    """Duck-typed model: output() sleeps (device stall stand-in)."""
+
+    def __init__(self, delay=0.3):
+        self.delay = delay
+
+    def output(self, x):
+        time.sleep(self.delay)
+        return np.zeros((np.asarray(x).shape[0], 1), np.float32)
+
+
+class _Boom:
+    """Duck-typed model whose forward always fails (internal error)."""
+
+    def output(self, x):
+        raise RuntimeError("boom")
+
+
+class TestBucketing:
+    def test_next_bucket_powers_of_two(self):
+        assert [next_bucket(n) for n in (1, 2, 3, 4, 5, 8, 9, 17)] == \
+            [1, 2, 4, 4, 8, 8, 16, 32]
+
+    def test_next_bucket_clamps(self):
+        assert next_bucket(3, min_bucket=8) == 8
+        assert next_bucket(100, max_bucket=32) == 32
+
+    def test_empty_batch_rejected(self):
+        with pytest.raises(ClientError):
+            next_bucket(0)
+
+
+class TestInferenceEngine:
+    def test_matches_reference_across_sizes(self, np_rng):
+        net = _mlp()
+        eng = InferenceEngine(net, max_batch_size=16)
+        for n in (1, 3, 5, 16):
+            x = np_rng.randn(n, 4).astype(np.float32)
+            np.testing.assert_allclose(eng.predict(x),
+                                       np.asarray(net.output(x)),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_chunking_beyond_max_batch(self, np_rng):
+        net = _mlp()
+        eng = InferenceEngine(net, max_batch_size=8)
+        x = np_rng.randn(21, 4).astype(np.float32)
+        np.testing.assert_allclose(eng.predict(x),
+                                   np.asarray(net.output(x)),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_warmup_then_zero_recompiles(self, np_rng):
+        net = _mlp()
+        eng = InferenceEngine(net, max_batch_size=16)
+        warmed = eng.warmup([1, 2, 4, 8, 16])  # example inferred
+        assert warmed == [1, 2, 4, 8, 16]
+        assert eng.metrics.compiles == 5
+        for n in (1, 2, 3, 5, 7, 11, 16):  # mixed request shapes
+            eng.predict(np_rng.randn(n, 4).astype(np.float32))
+        assert eng.metrics.compiles == 5  # steady state never recompiles
+        assert eng.metrics.cache_hits >= 7
+
+    def test_lru_cache_is_bounded(self, np_rng):
+        net = _mlp()
+        eng = InferenceEngine(net, max_batch_size=16, cache_size=2)
+        for n in (1, 2, 4, 8):  # four buckets through a 2-slot cache
+            eng.predict(np_rng.randn(n, 4).astype(np.float32))
+        assert len(eng._cache) <= 2
+        assert eng.metrics.cache_evictions >= 2
+        # evicted bucket recompiles (correctly, not wrongly served)
+        x = np_rng.randn(1, 4).astype(np.float32)
+        np.testing.assert_allclose(eng.predict(x),
+                                   np.asarray(net.output(x)), rtol=1e-5)
+
+    def test_samediff_named_feed(self, np_rng):
+        from deeplearning4j_tpu.autodiff import SameDiff
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (None, 3))
+        w = sd.var("w", value=np_rng.randn(3, 2).astype(np.float32))
+        (x @ w).rename("out")
+        eng = InferenceEngine(sd, default_outputs=["out"], max_batch_size=8)
+        eng.warmup([1, 4])  # example inferred from placeholder shapes
+        xs = np_rng.randn(3, 3).astype(np.float32)
+        res = eng.predict({"x": xs})
+        np.testing.assert_allclose(res["out"], xs @ np.asarray(sd._values["w"]),
+                                   rtol=1e-5, atol=1e-6)
+        with pytest.raises(ClientError):
+            eng.predict({"x": xs}, outputs=["nope"])
+        with pytest.raises(ClientError):
+            eng.predict({"y": xs})
+
+    def test_computation_graph_bare_and_named(self, np_rng):
+        from deeplearning4j_tpu.learning import Adam
+        from deeplearning4j_tpu.nn import (ComputationGraph,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf import InputType
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+                .weight_init("xavier")
+                .graph_builder()
+                .add_inputs("in")
+                .set_input_types(InputType.feed_forward(4))
+                .add_layer("d", DenseLayer(n_out=8, activation="relu"), "in")
+                .add_layer("out", OutputLayer(n_out=3, loss="mcxent",
+                                              activation="softmax"), "d")
+                .set_outputs("out").build())
+        g = ComputationGraph(conf).init()
+        eng = InferenceEngine(g, max_batch_size=8)
+        x = np_rng.randn(3, 4).astype(np.float32)
+        want = np.asarray(g.output(x))
+        np.testing.assert_allclose(eng.predict(x), want, rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(eng.predict({"in": x}), want, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_duck_model_fallback(self):
+        eng = InferenceEngine(_Slow(delay=0.0), max_batch_size=8)
+        out = eng.predict(np.ones((3, 2), np.float32))
+        assert out.shape == (3, 1)
+
+    def test_serves_live_weights_after_fit(self, np_rng):
+        # weights are executable ARGUMENTS, not baked constants: a fit()
+        # after warmup must be visible on the next request, with no
+        # recompile
+        net = _mlp()
+        eng = InferenceEngine(net, max_batch_size=8)
+        eng.warmup([1, 2, 4, 8])
+        x = np_rng.randn(3, 4).astype(np.float32)
+        before = eng.predict(x)
+        xt = np_rng.randn(64, 4).astype(np.float32)
+        yt = np.eye(3, dtype=np.float32)[np_rng.randint(0, 3, 64)]
+        net.fit([(xt, yt)], epochs=3)
+        after = eng.predict(x)
+        assert np.abs(before - np.asarray(after)).max() > 1e-6
+        np.testing.assert_allclose(after, np.asarray(net.output(x)),
+                                   rtol=1e-5, atol=1e-6)
+        assert eng.metrics.compiles == 4  # still only the warmups
+
+    def test_unknown_outputs_rejected_for_array_models(self, np_rng):
+        eng = InferenceEngine(_mlp(), max_batch_size=8)
+        with pytest.raises(ClientError):
+            eng.predict(np_rng.randn(1, 4).astype(np.float32),
+                        outputs=["embedding"])
+
+    def test_batch_reducing_output_fails_loudly(self, np_rng):
+        # a head that reduces over the batch would silently fold the
+        # zero padding rows (and other clients' rows) into every answer
+        from deeplearning4j_tpu.autodiff import SameDiff
+        from deeplearning4j_tpu.serving import ServingError
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (None, 2))
+        w = sd.var("w", value=np.eye(2, dtype=np.float32))
+        (x @ w).reduce_mean().rename("m")
+        eng = InferenceEngine(sd, default_outputs=["m"], max_batch_size=8)
+        with pytest.raises(ServingError, match="row-aligned"):
+            eng.predict({"x": np_rng.randn(3, 2).astype(np.float32)})
+
+
+class TestMicroBatcher:
+    def test_concurrent_clients_coalesce_and_match(self, np_rng):
+        net = _mlp()
+        eng = InferenceEngine(net, max_batch_size=16)
+        eng.warmup([1, 2, 4, 8, 16])
+        batcher = MicroBatcher(eng, max_latency_ms=10.0)
+        xs = [np_rng.randn(1 + (i % 3), 4).astype(np.float32)
+              for i in range(32)]
+        wants = [np.asarray(net.output(x)) for x in xs]
+        errs = []
+
+        def client(i):
+            try:
+                got = batcher.submit(xs[i])
+                np.testing.assert_allclose(got, wants[i], rtol=1e-4,
+                                           atol=1e-6)
+            except Exception as e:  # noqa: BLE001
+                errs.append((i, e))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(32)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        batcher.stop()
+        assert not errs
+        assert eng.metrics.responses == 32
+        assert eng.metrics.mean_batch() > 1.0  # actually coalesced
+        assert eng.metrics.compiles == 5       # still only the warmups
+
+    def test_deadline_exceeded_in_queue(self):
+        eng = InferenceEngine(_Slow(delay=0.4), max_batch_size=4)
+        batcher = MicroBatcher(eng, max_latency_ms=1.0)
+        t = threading.Thread(
+            target=lambda: batcher.submit(np.ones((1, 2), np.float32)))
+        t.start()
+        time.sleep(0.1)  # worker is now inside the slow device call
+        with pytest.raises(DeadlineExceededError):
+            batcher.submit(np.ones((1, 2), np.float32), timeout_ms=50)
+        t.join()
+        batcher.stop()
+        assert eng.metrics.timeouts >= 1
+
+    def test_queue_full_sheds(self):
+        eng = InferenceEngine(_Slow(delay=0.4), max_batch_size=1)
+        batcher = MicroBatcher(eng, max_latency_ms=1.0, max_queue=1)
+        results = []
+
+        def client():
+            try:
+                batcher.submit(np.ones((1, 2), np.float32))
+                results.append("ok")
+            except QueueFullError:
+                results.append("shed")
+
+        threads = [threading.Thread(target=client) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        batcher.stop()
+        assert "shed" in results          # bounded queue dropped load
+        assert eng.metrics.shed >= 1
+
+    def test_oversize_request_rejected(self, np_rng):
+        eng = InferenceEngine(_mlp(), max_batch_size=4)
+        batcher = MicroBatcher(eng)
+        with pytest.raises(ClientError):
+            batcher.submit(np_rng.randn(5, 4).astype(np.float32))
+        batcher.stop()
+
+
+class TestModelRegistry:
+    def test_register_get_versions(self, np_rng):
+        reg = ModelRegistry()
+        a1 = reg.register("m", _mlp(seed=1), batching=False)
+        a2 = reg.register("m", _mlp(seed=2), batching=False)
+        assert (a1.version, a2.version) == (1, 2)
+        assert reg.get("m").version == 2           # latest wins
+        assert reg.get("m", version=1) is a1
+        with pytest.raises(ModelNotFound):
+            reg.get("m", version=9)
+        with pytest.raises(ModelNotFound):
+            reg.get("ghost")
+        reg.unregister("m", version=2)
+        assert reg.get("m").version == 1
+        reg.stop()
+
+    def test_stats_keyed_by_name(self, np_rng):
+        reg = ModelRegistry()
+        reg.register("a", _mlp(), batching=False)
+        reg.register("b", _mlp(n_in=6), batching=False)
+        assert sorted(reg.stats()) == ["a", "b"]
+        assert reg.describe()["a"]["latest"] == 1
+        reg.stop()
+
+
+class TestInferenceServerHTTP:
+    def test_32_concurrent_clients_end_to_end(self, np_rng):
+        """ISSUE acceptance: correctness under concurrency, real
+        coalescing, and zero recompiles across mixed request shapes."""
+        net = _mlp()
+        server = InferenceServer(net, port=0, max_batch_size=16,
+                                 max_latency_ms=10.0)
+        served = server.served()
+        served.warmup([1, 2, 4, 8, 16])
+        base = f"http://127.0.0.1:{server.port}"
+        errs = []
+
+        def client(i):
+            try:
+                rs = np.random.RandomState(i)
+                for _ in range(3):
+                    x = rs.randn(1 + (i % 4), 4).astype(np.float32)
+                    out = _post(base, "/predict", {"inputs": x.tolist()})
+                    want = np.asarray(net.output(x))
+                    np.testing.assert_allclose(np.asarray(out["outputs"]),
+                                               want, rtol=1e-4, atol=1e-6)
+            except Exception as e:  # noqa: BLE001
+                errs.append((i, e))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(32)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        try:
+            assert not errs, errs[:3]
+            stats = json.loads(urllib.request.urlopen(
+                base + "/stats", timeout=5).read())
+            m = stats["models"]["default"]
+            assert m["responses"] == 96
+            assert m["mean_batch"] > 1.0          # batcher coalesced
+            cc = m["compile_cache"]
+            # compilations stay <= number of warmed buckets
+            assert cc["compiles"] <= len(cc["warmed_buckets"])
+            assert m["batch_hist"]                 # histogram populated
+            assert m["latency_ms"]["count"] == 96  # latency histogram
+            assert m["latency_ms"]["p99"] >= m["latency_ms"]["p50"]
+        finally:
+            server.stop()
+
+    def test_multi_model_routing(self, np_rng):
+        server = InferenceServer(port=0)
+        net_a, net_b = _mlp(seed=1), _mlp(seed=2, n_in=6, n_out=2)
+        server.register("alpha", net_a)
+        server.register("beta", net_b)
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            xa = np_rng.randn(2, 4).astype(np.float32)
+            xb = np_rng.randn(3, 6).astype(np.float32)
+            oa = _post(base, "/v1/models/alpha/predict",
+                       {"inputs": xa.tolist()})
+            ob = _post(base, "/v1/models/beta/predict",
+                       {"inputs": xb.tolist()})
+            np.testing.assert_allclose(np.asarray(oa["outputs"]),
+                                       np.asarray(net_a.output(xa)),
+                                       rtol=1e-4, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(ob["outputs"]),
+                                       np.asarray(net_b.output(xb)),
+                                       rtol=1e-4, atol=1e-6)
+            listing = json.loads(urllib.request.urlopen(
+                base + "/v1/models", timeout=5).read())
+            assert sorted(listing) == ["alpha", "beta"]
+        finally:
+            server.stop()
+
+    def test_error_code_mapping(self, np_rng):
+        server = InferenceServer(_mlp(), port=0)
+        server.register("boom", _Boom())
+        base = f"http://127.0.0.1:{server.port}"
+
+        def code_of(path, data):
+            req = urllib.request.Request(base + path, data=data)
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=10)
+            return e.value.code
+
+        try:
+            # client errors -> 400
+            assert code_of("/predict", b"not json") == 400
+            assert code_of("/predict", b"{}") == 400
+            assert code_of("/predict", json.dumps(
+                {"inputs": [["a", "b"]]}).encode() ) == 400
+            assert code_of("/predict", json.dumps(
+                {"inputs": [[1.0]], "outputs": "x"}).encode()) == 400
+            # unknown model / route -> 404
+            assert code_of("/v1/models/ghost/predict", json.dumps(
+                {"inputs": [[1.0]]}).encode()) == 404
+            assert code_of("/nope", b"{}") == 404
+            # internal failure -> 500, distinguishable by load balancers
+            assert code_of("/v1/models/boom/predict", json.dumps(
+                {"inputs": [[1.0, 2.0]]}).encode()) == 500
+            stats = json.loads(urllib.request.urlopen(
+                base + "/stats", timeout=5).read())
+            assert stats["models"]["default"]["client_errors"] >= 4
+            assert stats["models"]["boom"]["server_errors"] >= 1
+        finally:
+            server.stop()
+
+    def test_shed_and_timeout_codes(self):
+        server = InferenceServer(_Slow(delay=0.4), port=0,
+                                 max_batch_size=1, max_latency_ms=1.0,
+                                 max_queue=1)
+        base = f"http://127.0.0.1:{server.port}"
+        codes = []
+
+        def client(timeout_ms=None):
+            body = {"inputs": [[1.0, 2.0]]}
+            if timeout_ms is not None:
+                body["timeout_ms"] = timeout_ms
+            try:
+                _post(base, "/predict", body)
+                codes.append(200)
+            except urllib.error.HTTPError as e:
+                codes.append(e.code)
+
+        try:
+            threads = [threading.Thread(target=client) for _ in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert 503 in codes          # bounded queue shed load
+            # deadline: worker is busy, a tight-deadline request expires
+            t = threading.Thread(target=client)
+            t.start()
+            time.sleep(0.1)
+            client(timeout_ms=50)
+            t.join()
+            assert 504 in codes
+        finally:
+            server.stop()
+
+    def test_bad_content_length_is_400(self, np_rng):
+        server = InferenceServer(_mlp(), port=0)
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                              timeout=10)
+            conn.putrequest("POST", "/predict")
+            conn.putheader("Content-Length", "abc")
+            conn.endheaders()
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 400
+            conn.close()
+        finally:
+            server.stop()
+
+    def test_host_parameter(self, np_rng):
+        # default binds loopback; host= opens external binding for
+        # multi-host deployments (0.0.0.0 is reachable via loopback too)
+        server = InferenceServer(_mlp(), port=0, host="0.0.0.0")
+        try:
+            assert server.host == "0.0.0.0"
+            x = np_rng.randn(1, 4).astype(np.float32)
+            out = _post(f"http://127.0.0.1:{server.port}", "/predict",
+                        {"inputs": x.tolist()})
+            assert np.asarray(out["outputs"]).shape == (1, 3)
+        finally:
+            server.stop()
+
+    def test_keep_alive_connection_reuse(self, np_rng):
+        net = _mlp()
+        server = InferenceServer(net, port=0)
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                              timeout=10)
+            x = np_rng.randn(2, 4).astype(np.float32)
+            for _ in range(3):  # same socket, three requests
+                conn.request("POST", "/predict",
+                             body=json.dumps({"inputs": x.tolist()}))
+                resp = conn.getresponse()
+                out = json.loads(resp.read())
+                assert resp.status == 200
+                np.testing.assert_allclose(np.asarray(out["outputs"]),
+                                           np.asarray(net.output(x)),
+                                           rtol=1e-4, atol=1e-6)
+            # a 404 with a body must drain the body, or the next
+            # request on this keep-alive socket reads garbage
+            conn.request("POST", "/v1/models/ghost/predict",
+                         body=json.dumps({"inputs": x.tolist()}))
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 404
+            conn.request("POST", "/predict",
+                         body=json.dumps({"inputs": x.tolist()}))
+            resp = conn.getresponse()
+            assert resp.status == 200
+            resp.read()
+            conn.close()
+        finally:
+            server.stop()
+
+    def test_samediff_default_outputs_over_http(self, np_rng):
+        from deeplearning4j_tpu.autodiff import SameDiff
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (None, 2))
+        w = sd.var("w", value=np.eye(2, dtype=np.float32))
+        (x @ w).rename("out")
+        server = InferenceServer(sd, port=0, default_outputs=["out"])
+        try:
+            out = _post(f"http://127.0.0.1:{server.port}", "/predict",
+                        {"inputs": {"x": [[3.0, 4.0]]}})
+            np.testing.assert_allclose(out["outputs"]["out"], [[3.0, 4.0]])
+        finally:
+            server.stop()
