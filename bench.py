@@ -466,6 +466,142 @@ emit(f"Serving MLP-{HIDDEN} dynamic batching ({N_CLIENTS} clients)",
      synthetic_data=True)
 """
 
+GENERATION_CODE = _COMMON + r"""
+# Continuous-batching generation scenario (ISSUE 2 acceptance): >=16
+# concurrent mixed-length generate requests through the slot-based
+# decode engine vs SEQUENTIAL PER-REQUEST DECODE — the pre-subsystem
+# path: one request at a time, each token re-running the full prefix
+# through the model (the only generation the repo supported before the
+# KV-cache slots existed), bucket-padded to power-of-two lengths with
+# each bucket AOT-compiled once, so the baseline pays zero mid-run
+# compiles — the same courtesy PR 1's serving bench gave the seed
+# handler. The subsystem's two wins compose against it: the static-
+# slot KV cache (O(prefix) -> O(1) work per token) and iteration-level
+# scheduling (per-step host/dispatch overhead amortized across slots).
+# A second reference — the SAME engine at num_slots=1 — isolates the
+# scheduling win alone and keeps the cache win honest.
+import threading
+from deeplearning4j_tpu.serving import GenerationEngine, next_bucket
+from deeplearning4j_tpu.serving.generation import _sample_one
+from deeplearning4j_tpu.zoo.transformer_lm import CausalTransformerLM
+
+VOCAB, DM, NL, NH, TMAX = 256, 64, 2, 4, 192
+N_REQ = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+N_SLOTS = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+BUCKETS = [8, 16, 32, 64, 128, 192]
+lm = CausalTransformerLM(vocab_size=VOCAB, d_model=DM, n_layers=NL,
+                         n_heads=NH, max_seq_len=TMAX, seed=0,
+                         implementation="plain").init()
+rs = np.random.RandomState(0)
+reqs = []
+for i in range(N_REQ):
+    plen = int(rs.choice([4, 8, 16, 32, 64]))
+    n_gen = int(rs.choice([16, 32, 64, 96]))
+    reqs.append((rs.randint(0, VOCAB, plen).tolist(), n_gen))
+
+# -- baseline: uncached sequential per-request decode (pre-subsystem).
+# Same sampler and same per-request PRNG stream (fold_in(seed, i) for
+# token i), so its outputs are comparable token-for-token.
+def build_uncached(bucket):
+    def f(params, tokens, length, seed, temp, topk, step):
+        mask = (jnp.arange(bucket)[None] < length).astype(jnp.float32)
+        logits, _, _ = lm.forward_prefill(params, tokens, mask)
+        last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
+                                            axis=0, keepdims=False)
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+        return _sample_one(last, temp, topk, key)
+    return jax.jit(f).lower(
+        lm._params, np.zeros((1, bucket), np.int32), np.int32(1),
+        np.uint32(0), np.float32(0.0), np.int32(0), np.int32(0)).compile()
+
+uncached = {b: build_uncached(b) for b in BUCKETS}
+
+def uncached_generate(prompt, max_tokens, seed, temp=0.8, topk=32):
+    toks = list(prompt)
+    out = []
+    for i in range(min(max_tokens, TMAX - len(prompt))):
+        L = len(toks)
+        b = next_bucket(L, BUCKETS[0], TMAX)
+        arr = np.zeros((1, b), np.int32)
+        arr[0, :L] = toks
+        t = int(np.asarray(uncached[b](
+            lm._params, arr, np.int32(L), np.uint32(seed),
+            np.float32(temp), np.int32(topk), np.int32(i))))
+        out.append(t)
+        toks.append(t)
+    return out
+
+def run_uncached():
+    t0 = time.perf_counter()
+    outs = [uncached_generate(p, n, seed=i)
+            for i, (p, n) in enumerate(reqs)]
+    dt = time.perf_counter() - t0
+    return dt, sum(len(t) for t in outs), outs
+
+def run_all(eng, concurrent):
+    '''Returns (wall_s, total_tokens, [token lists]).'''
+    results = [None] * N_REQ
+
+    def go(i):
+        p, n = reqs[i]
+        results[i] = eng.generate(p, max_tokens=n, temperature=0.8,
+                                  top_k=32, seed=i, timeout_ms=600_000)
+    t0 = time.perf_counter()
+    if concurrent:
+        ts = [threading.Thread(target=go, args=(i,))
+              for i in range(N_REQ)]
+        for t in ts: t.start()
+        for t in ts: t.join()
+    else:
+        for i in range(N_REQ):
+            go(i)
+    dt = time.perf_counter() - t0
+    toks = [r["tokens"] for r in results]
+    return dt, sum(len(t) for t in toks), toks
+
+run_uncached()                              # warmup pass
+seq_dt, seq_tok, seq_out = run_uncached()
+
+# cached sequential reference: same engine, one slot, one at a time
+cseq_eng = GenerationEngine(lm, num_slots=1, max_queue=N_REQ + 8)
+cseq_eng.warmup()
+run_all(cseq_eng, concurrent=False)         # warmup pass (caches hot)
+cseq_dt, cseq_tok, cseq_out = run_all(cseq_eng, concurrent=False)
+cseq_eng.stop()
+
+# continuous batching: N_SLOTS slots, all requests in flight
+eng = GenerationEngine(lm, num_slots=N_SLOTS, max_queue=N_REQ * 2)
+eng.warmup()
+run_all(eng, concurrent=True)               # warmup pass
+compiles_before = eng.metrics.compiles
+cb_dt, cb_tok, cb_out = run_all(eng, concurrent=True)
+recompiles = eng.metrics.compiles - compiles_before
+stats = eng.stats()
+eng.stop()
+d = jax.devices()[0]
+print(json.dumps({
+    "model": f"CausalTransformerLM d{DM}xL{NL} generation "
+             f"({N_REQ} mixed-length requests, {N_SLOTS} slots)",
+    "platform": d.platform, "device_kind": d.device_kind,
+    "tokens_per_sec": round(cb_tok / cb_dt, 1),
+    "sequential_tokens_per_sec": round(seq_tok / seq_dt, 1),
+    "speedup_vs_sequential": round((cb_tok / cb_dt)
+                                   / (seq_tok / seq_dt), 2),
+    "cached_sequential_tokens_per_sec": round(cseq_tok / cseq_dt, 1),
+    "speedup_vs_cached_sequential": round((cb_tok / cb_dt)
+                                          / (cseq_tok / cseq_dt), 2),
+    "tokens_identical_to_cached_sequential": cb_out == cseq_out,
+    "total_tokens": cb_tok,
+    "recompiles_post_warmup": recompiles,
+    "mean_slot_occupancy": stats["slots"]["mean_occupancy"],
+    "slot_utilization": stats["slots"]["utilization"],
+    "ttft_ms_p50": stats["ttft_ms"]["p50"],
+    "ttft_ms_p99": stats["ttft_ms"]["p99"],
+    "itl_ms_p50": stats["itl_ms"]["p50"],
+    "itl_ms_p99": stats["itl_ms"]["p99"],
+    "synthetic_data": True}))
+"""
+
 WORD2VEC_CODE = _COMMON + r"""
 # BASELINE config 4: Word2Vec throughput at benchmark scale. text8 is
 # 100MB of wiki text; no egress here, so a labeled synthetic corpus with
@@ -668,6 +804,24 @@ def main():
                                   "mean_device_batch", "batch_hist",
                                   "compiles", "recompiles_post_warmup")
                                  if k in srv}
+        # continuous-batching generation vs sequential per-request
+        # decode (CPU-JAX by design — the acceptance regime)
+        gen = _run(GENERATION_CODE, _CPU_ENV, timeout=900)
+        if gen:
+            extras["generation"] = {k: gen[k] for k in
+                                    ("model", "tokens_per_sec",
+                                     "sequential_tokens_per_sec",
+                                     "speedup_vs_sequential",
+                                     "cached_sequential_tokens_per_sec",
+                                     "speedup_vs_cached_sequential",
+                                     "tokens_identical_to_cached_sequential",
+                                     "total_tokens",
+                                     "recompiles_post_warmup",
+                                     "mean_slot_occupancy",
+                                     "slot_utilization",
+                                     "ttft_ms_p50", "ttft_ms_p99",
+                                     "itl_ms_p50", "itl_ms_p99")
+                                    if k in gen}
     # static cost model (tools/perf_audit.py — chip-independent): the
     # roofline predictions the measured numbers are judged against
     # (VERDICT r4 #2). Committed JSON, so this costs no compile time.

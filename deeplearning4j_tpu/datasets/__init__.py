@@ -317,7 +317,11 @@ class MnistDataSetIterator(ArrayDataSetIterator):
             try:
                 imgs, labels = _load_real_digits(train)
                 self.source = "real-digits-8x8"
-            except Exception:
+            except FileNotFoundError:
+                # only a MISSING fixture falls back to synthetic data;
+                # a present-but-corrupt fixture raises its checksum
+                # IOError — silently training on synthetic data would
+                # mask the corruption
                 n = num_examples or (10000 if train else 2000)
                 imgs, labels = _synthetic_mnist(n, seed=1 if train else 2)
                 self.source = "synthetic"

@@ -6,6 +6,7 @@ reserved for ops where explicit VMEM scheduling beats the fusion
 autoscheduler — attention being the canonical case (per
 /opt/skills/guides/pallas_guide.md).
 """
+from .decode_attention import decode_attention
 from .flash_attention import flash_attention
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "decode_attention"]

@@ -1,0 +1,160 @@
+"""Single-token KV-cache decode attention (Pallas TPU + XLA fallback).
+
+The autoregressive decode hot-op: one query row per sequence attends
+over that sequence's cached K/V prefix. Unlike training attention the
+arithmetic intensity is O(1) FLOPs per byte — the op is HBM-bandwidth
+bound on streaming the KV cache — so the kernel's job is pure
+streaming: pull K/V blocks HBM→VMEM once, keep the online-softmax
+running state (m, l, acc) in VMEM scratch, and never materialize the
+[T] score row in HBM (the vLLM/PagedAttention decode regime, PAPERS.md;
+same construction as `flash_attention`'s forward with blk_q == 1).
+
+Layout: q [S, H, D], k/v caches [S, H, T_max, D], lengths [S] (valid
+prefix per slot, i.e. pos + 1). The cache keeps T contiguous per head
+— decode attention is then a batched matvec over contiguous [T, D]
+panels (measured ~2x over the [S, T, H, D] layout on CPU, and the
+kernel's [S*H, T, D] flatten becomes a free reshape instead of a
+transpose). Inactive or short slots mask via the per-slot validity
+column — the executable shape never changes, which is what keeps the
+serving decode loop at zero recompiles.
+
+On TPU this runs the Pallas kernel; elsewhere the fused-XLA einsum path
+is the default (the Pallas interpreter is for parity tests only).
+Matmuls use preferred_element_type=f32 (pallas guide: pitfalls #5);
+masks use the validity-column idiom from `flash_attention`.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import _NEG_INF, _cdiv, default_platform
+
+
+def decode_attention_xla(q, k, v, lengths):
+    """Fused-XLA decode attention (the CPU/GPU and reference path).
+
+    q: [S, H, D]; k/v: [S, H, T, D]; lengths: [S] — keys at positions
+    >= lengths[s] (unwritten cache tail) are masked out. Fully static
+    shapes: T is the cache capacity, not the live length.
+    """
+    S, H, T, D = k.shape
+    scale = 1.0 / jnp.sqrt(jnp.float32(D))
+    s = jnp.einsum("shd,shtd->sht", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    valid = jnp.arange(T)[None, None, :] < lengths[:, None, None]
+    s = jnp.where(valid, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    # fully-masked rows (length 0: a free slot riding the batch) would
+    # softmax to uniform and read garbage V — zero them instead
+    p = jnp.where(valid, p, 0.0)
+    return jnp.einsum("sht,shtd->shd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel
+# ---------------------------------------------------------------------------
+def _decode_kernel(q_ref, k_ref, v_ref, vm_ref, o_ref, m_s, l_s, acc_s, *,
+                   blk_k: int, scale: float, precision):
+    ki = pl.program_id(1)
+    num_kb = pl.num_programs(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, _NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    q = q_ref[0].astype(jnp.float32)                  # [1, D]
+    k_blk = k_ref[0].astype(jnp.float32)              # [blk_k, D]
+    v_blk = v_ref[0].astype(jnp.float32)
+    s = jnp.dot(q, k_blk.T, precision=precision,
+                preferred_element_type=jnp.float32) * scale   # [1, blk_k]
+    mask = (vm_ref[0][:, 0] > 0)[None, :]
+    s = jnp.where(mask, s, _NEG_INF)
+    m_prev = m_s[:, 0]
+    l_prev = l_s[:, 0]
+    m_new = jnp.maximum(m_prev, s.max(axis=1))
+    # where-guard keeps fully-masked rows at p=0 (exp(-inf - -inf) = 1
+    # would fabricate uniform attention for an empty slot)
+    p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
+    corr = jnp.exp(m_prev - m_new)
+    m_s[:, 0] = m_new
+    l_s[:, 0] = l_prev * corr + p.sum(axis=1)
+    acc_s[:] = acc_s[:] * corr[:, None] + jnp.dot(
+        p, v_blk, precision=precision, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_kb - 1)
+    def _finalize():
+        l = jnp.maximum(l_s[:, 0], 1e-30)
+        o_ref[0] = (acc_s[:] / l[:, None]).astype(o_ref.dtype)
+
+
+def decode_attention_pallas(q, k, v, lengths, block_k: int = 128,
+                            precision=lax.Precision.DEFAULT,
+                            interpret: Optional[bool] = None):
+    """Pallas decode attention. Same contract as
+    :func:`decode_attention_xla`; grid (S*H, k-blocks) with the
+    per-slot validity column shared across heads via the ``sh // H``
+    index map (the `flash_attention` mask idiom)."""
+    if interpret is None:
+        interpret = default_platform() != "tpu"
+    S, H, T, D = k.shape
+    blk_k = min(block_k, max(8, T))
+    t_pad = _cdiv(T, blk_k) * blk_k
+    # [S, H, T, D] -> [S*H, T_pad, D]: a free reshape, T is contiguous
+    kf = k.reshape(S * H, T, D)
+    vf = v.reshape(S * H, T, D)
+    qf = q.reshape(S * H, 1, D)
+    vm = (jnp.arange(T)[None, :] < lengths[:, None]).astype(
+        jnp.float32)[:, :, None]                       # [S, T, 1]
+    if t_pad != T:
+        pad = ((0, 0), (0, t_pad - T), (0, 0))
+        kf, vf, vm = jnp.pad(kf, pad), jnp.pad(vf, pad), jnp.pad(vm, pad)
+    kernel = functools.partial(_decode_kernel, blk_k=blk_k,
+                               scale=1.0 / (D ** 0.5), precision=precision)
+    out = pl.pallas_call(
+        kernel,
+        grid=(S * H, t_pad // blk_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, D), lambda sh, ki: (sh, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk_k, D), lambda sh, ki: (sh, ki, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk_k, D), lambda sh, ki: (sh, ki, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk_k, 1), lambda sh, ki: (sh // H, ki, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, D), lambda sh, ki: (sh, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((S * H, 1, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((1, 1), jnp.float32),   # running max
+            pltpu.VMEM((1, 1), jnp.float32),   # running sum
+            pltpu.VMEM((1, D), jnp.float32),   # output accumulator
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, vm)
+    return out.reshape(S, H, D)
+
+
+def decode_attention(q, k, v, lengths, impl: str = "auto", **kw):
+    """Dispatch: ``auto`` runs the Pallas kernel on TPU (KV streaming
+    with VMEM-resident softmax state), fused XLA elsewhere. ``pallas``
+    / ``xla`` force a path (parity tests run pallas in interpret mode
+    on CPU so one kernel is tested everywhere)."""
+    if impl == "auto":
+        impl = "pallas" if default_platform() == "tpu" else "xla"
+    if impl == "pallas":
+        return decode_attention_pallas(q, k, v, lengths, **kw)
+    if impl == "xla":
+        return decode_attention_xla(q, k, v, lengths)
+    raise ValueError(f"unknown decode attention impl {impl!r}")

@@ -94,7 +94,6 @@ def _check_masking_semantics_graph(layer_cfgs, mapped):
       mask here lives in the forward pass only — pass an explicit label
       mask to fit() instead."""
     from ..nn.layers import MaskingLayer
-    from ..nn.layers.recurrent import LastTimeStep
     masking_nodes = {nm for nm, l in mapped.items()
                      if isinstance(l, MaskingLayer)}
     if not masking_nodes:
@@ -102,7 +101,6 @@ def _check_masking_semantics_graph(layer_cfgs, mapped):
     # transitive downstream closure of the masking nodes
     downstream = set(masking_nodes)
     changed = True
-    by_name = {lc["config"].get("name"): lc for lc in layer_cfgs}
     while changed:
         changed = False
         for lc in layer_cfgs:
@@ -128,9 +126,12 @@ def _check_masking_semantics_graph(layer_cfgs, mapped):
                     "masks; the DL4J MergeVertex OR rule applies here) "
                     "— import with enforce_training_config=False to "
                     "accept the divergence")
-    # per-timestep outputs: the derived mask does not reach the loss
-    out_like = [l for l in mapped.values()
-                if getattr(l, "kind", "") in ("rnnoutput", "rnnloss")]
+    # per-timestep outputs: the derived mask does not reach the loss —
+    # but only outputs DOWNSTREAM of a Masking node see a derived mask;
+    # unrelated unmasked branches are exact and must not be rejected
+    out_like = [nm for nm, l in mapped.items()
+                if nm in downstream
+                and getattr(l, "kind", "") in ("rnnoutput", "rnnloss")]
     if out_like:
         raise ValueError(
             "keras Masking with a per-timestep output is not mapped "

@@ -888,6 +888,11 @@ class ComputationGraph:
              carries) = self._tbptt_step(
                 self._params, self._opt_state, self._net_state,
                 jnp.asarray(self._step), ic, lc, mc, sub, carries)
+            # per-chunk optimizer step (ref: doTruncatedBPTT runs
+            # solver.optimize per segment, advancing the iteration
+            # count each chunk — Adam-family bias correction and LR
+            # schedules must see the same t as the moments)
+            self._step += 1
         return loss
 
     # -- public API ----------------------------------------------------
@@ -934,6 +939,7 @@ class ComputationGraph:
                 if tbptt and seq_T and max(seq_T) > tbptt:
                     # ref: ComputationGraph.doTruncatedBPTT — chunk the
                     # time axis, carry RNN state across chunks
+                    # (_fit_tbptt advances _step once per chunk)
                     loss = self._fit_tbptt(inputs, labels, masks, tbptt)
                 else:
                     self._rng, sub = jax.random.split(self._rng)
@@ -942,7 +948,7 @@ class ComputationGraph:
                         self._params, self._opt_state, self._net_state,
                         jnp.asarray(self._step), inputs, labels, masks,
                         sub)
-                self._step += 1
+                    self._step += 1
                 self._last_loss = loss
                 dur = time.perf_counter() - t0
                 for lst in self.listeners:

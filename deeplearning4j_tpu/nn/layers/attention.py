@@ -121,6 +121,64 @@ class SelfAttentionLayer(Layer):
                                     None)
         return out, st
 
+    # -- cached autoregressive decode (serving/generation) -------------
+    def cache_shape(self, max_seq_len: int):
+        """Per-sequence K (== V) cache shape for this layer:
+        [n_heads, max_seq_len, head_dim] — T contiguous per head, so
+        decode attention streams contiguous [T, Dh] panels."""
+        return (self.n_heads, int(max_seq_len), self.n_out // self.n_heads)
+
+    def apply_prefill(self, params, x, key_mask=None):
+        """Prompt pass that also returns per-position K/V for the decode
+        cache. Inference-only (no dropout); requires ``causal=True`` —
+        an acausal prefix would make the cached continuation attend to
+        tokens that didn't exist when the cache row was written.
+
+        x: [B, T, C]; key_mask: optional [B, T] validity.
+        Returns (out [B, T, n_out], k [B, H, T, Dh], v [B, H, T, Dh])
+        — K/V already in cache layout.
+        """
+        if not self.causal:
+            raise ValueError("cached decode needs causal=True attention")
+        B, T, _ = x.shape
+        H = self.n_heads
+        Dh = self.n_out // H
+        q = (x @ params["Wq"]).reshape(B, T, H, Dh)
+        k = (x @ params["Wk"]).reshape(B, T, H, Dh)
+        v = (x @ params["Wv"]).reshape(B, T, H, Dh)
+        att = self._attend(q, k, v, key_mask)
+        out = att.reshape(B, T, self.n_out) @ params["Wo"] + params["b"]
+        if key_mask is not None:
+            out = out * key_mask[..., None]
+        return (self.activation(out), jnp.swapaxes(k, 1, 2),
+                jnp.swapaxes(v, 1, 2))
+
+    def apply_decode(self, params, x, k_cache, v_cache, pos,
+                     impl: str = "auto"):
+        """One cached decode step: project the current token, write its
+        K/V at ``pos``, attend over positions 0..pos. All shapes are
+        static in the cache CAPACITY, so one compiled program serves
+        every step of every sequence.
+
+        x: [B, C] current-token activations; k_cache/v_cache:
+        [B, H, T_max, Dh]; pos: [B] int32 write position per row.
+        Returns (out [B, n_out], k_cache, v_cache).
+        """
+        from ...kernels.decode_attention import decode_attention
+        B = x.shape[0]
+        H = self.n_heads
+        Dh = self.n_out // H
+        q = (x @ params["Wq"]).reshape(B, H, Dh)
+        k_t = (x @ params["Wk"]).reshape(B, H, Dh)
+        v_t = (x @ params["Wv"]).reshape(B, H, Dh)
+        rows = jnp.arange(B)[:, None]
+        heads = jnp.arange(H)[None, :]
+        k_cache = k_cache.at[rows, heads, pos[:, None]].set(k_t)
+        v_cache = v_cache.at[rows, heads, pos[:, None]].set(v_t)
+        att = decode_attention(q, k_cache, v_cache, pos + 1, impl=impl)
+        out = att.reshape(B, self.n_out) @ params["Wo"] + params["b"]
+        return self.activation(out), k_cache, v_cache
+
     def init_carry(self, batch, dtype=jnp.float32):
         return ()
 
@@ -209,6 +267,41 @@ class TransformerEncoderLayer(Layer):
         out, st, _ = self.apply_seq(params, x, state, train, rng, None,
                                     None)
         return out, st
+
+    # -- cached autoregressive decode (serving/generation) -------------
+    def cache_shape(self, max_seq_len: int):
+        return self.attn.cache_shape(max_seq_len)
+
+    def _attn_params(self, params):
+        return {k[len("attn_"):]: v for k, v in params.items()
+                if k.startswith("attn_")}
+
+    def _mlp(self, params, x):
+        from ..functional import layer_norm as _ln
+        h = _ln(x, params["ln2_g"], params["ln2_b"])
+        h = jax.nn.gelu(h @ params["W1"] + params["b1"])
+        return x + (h @ params["W2"] + params["b2"])
+
+    def apply_prefill(self, params, x, key_mask=None):
+        """Block prefill: the apply_seq math without dropout, also
+        returning this block's K/V rows for the decode cache."""
+        from ..functional import layer_norm as _ln
+        h = _ln(x, params["ln1_g"], params["ln1_b"])
+        att, k, v = self.attn.apply_prefill(self._attn_params(params), h,
+                                            key_mask)
+        x = self._mlp(params, x + att)
+        if key_mask is not None:
+            x = x * key_mask[..., None]
+        return x, k, v
+
+    def apply_decode(self, params, x, k_cache, v_cache, pos,
+                     impl: str = "auto"):
+        """One cached decode step through the full block (x: [B, C])."""
+        from ..functional import layer_norm as _ln
+        h = _ln(x, params["ln1_g"], params["ln1_b"])
+        att, k_cache, v_cache = self.attn.apply_decode(
+            self._attn_params(params), h, k_cache, v_cache, pos, impl)
+        return self._mlp(params, x + att), k_cache, v_cache
 
     def init_carry(self, batch, dtype=jnp.float32):
         return ()

@@ -15,7 +15,7 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from enum import Enum
 from typing import Any, Dict, Optional
 
@@ -103,11 +103,13 @@ class OpProfiler:
             check_for_inf(tree, label)
 
     def timings(self) -> Dict[str, Dict[str, float]]:
-        return {name: {"total_s": self._totals[name],
-                       "count": self._counts[name],
-                       "mean_s": self._totals[name]
-                       / max(1, self._counts[name])}
-                for name in self._totals}
+        with self._rec_lock:  # record() inserts from serving threads
+            items = [(n, self._totals[n], self._counts[n])
+                     for n in self._totals]
+        return {name: {"total_s": total,
+                       "count": count,
+                       "mean_s": total / max(1, count)}
+                for name, total, count in items}
 
     def reset(self):
         self._totals.clear()
@@ -139,6 +141,15 @@ class Reservoir:
             self._buf[self._n % self._size] = float(value)
             self._n += 1
 
+    def record_many(self, values):
+        """Record a batch under ONE lock acquisition — the generation
+        scheduler emits one sample per active slot per decode step, and
+        per-sample locking would be measurable at step cadence."""
+        with self._lock:
+            for v in values:
+                self._buf[self._n % self._size] = float(v)
+                self._n += 1
+
     def count(self) -> int:
         return self._n
 
@@ -168,6 +179,47 @@ class Reservoir:
                 "p90": self._nearest_rank(s, 90),
                 "p99": self._nearest_rank(s, 99),
                 "max": s[-1]}
+
+
+class RateMeter:
+    """Sliding-window event-rate meter (tokens/sec, requests/sec).
+    Keeps (timestamp, count) pairs inside ``window_s`` and reports
+    events/sec over the observed span — the serving dashboards want
+    the CURRENT rate, not the all-time mean. Thread-safe."""
+
+    def __init__(self, window_s: float = 30.0):
+        self._window = float(window_s)
+        self._events: "deque[tuple]" = deque()
+        self._total = 0
+        self._lock = threading.Lock()
+
+    def record(self, n: int = 1):
+        now = time.perf_counter()
+        with self._lock:
+            self._events.append((now, int(n)))
+            self._total += int(n)
+            self._prune(now)
+
+    def _prune(self, now: float):
+        cutoff = now - self._window
+        while self._events and self._events[0][0] < cutoff:
+            self._events.popleft()
+
+    def total(self) -> int:
+        return self._total
+
+    def rate(self) -> float:
+        """Events/sec over the retained window (0 with <2 data points —
+        a single burst has no measurable span)."""
+        now = time.perf_counter()
+        with self._lock:
+            self._prune(now)
+            if len(self._events) < 2:
+                return 0.0
+            span = now - self._events[0][0]
+            if span <= 0:
+                return 0.0
+            return sum(n for _, n in self._events) / span
 
 
 class CountHistogram:

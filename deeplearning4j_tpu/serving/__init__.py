@@ -32,6 +32,10 @@ HTTP surface::
 
     POST /predict                      default model
     POST /v1/models/<name>/predict     named model (latest version)
+    POST /generate                     default generator
+    POST /v1/models/<name>/generate    continuous-batching generation
+                                       ({"stream": true} -> chunked
+                                       newline-delimited JSON tokens)
     GET  /v1/models                    registry listing
     GET  /stats                        serving metrics per model
     GET  /health
@@ -39,6 +43,12 @@ HTTP surface::
 Status codes: 400 malformed request (client), 404 unknown route/model,
 500 internal failure, 503 load shed (queue full), 504 deadline
 exceeded.
+
+Generation (see :mod:`.generation`): causal LMs registered via
+``register_generator`` decode token-by-token under iteration-level
+scheduling against a static-shape slot KV cache — requests join and
+leave the device batch every decode step, so short generations never
+wait on long ones and the compiled executables never change shape.
 """
 from __future__ import annotations
 
@@ -52,14 +62,18 @@ import numpy as np
 
 from .batcher import DeadlineExceededError, MicroBatcher, QueueFullError
 from .engine import ClientError, InferenceEngine, ServingError, next_bucket
-from .metrics import ServingMetrics, profiler_sections
-from .registry import ModelNotFound, ModelRegistry, ServedModel
+from .generation import GenerationEngine
+from .kvcache import KVCache, SlotTable
+from .metrics import GenerationMetrics, ServingMetrics, profiler_sections
+from .registry import (ModelNotFound, ModelRegistry, ServedGenerator,
+                       ServedModel)
 
 __all__ = [
     "InferenceServer", "InferenceEngine", "MicroBatcher", "ModelRegistry",
-    "ModelNotFound", "ServedModel", "ServingMetrics", "ClientError",
-    "ServingError", "QueueFullError", "DeadlineExceededError",
-    "next_bucket", "export_stablehlo",
+    "ModelNotFound", "ServedModel", "ServedGenerator", "GenerationEngine",
+    "GenerationMetrics", "KVCache", "SlotTable", "ServingMetrics",
+    "ClientError", "ServingError", "QueueFullError",
+    "DeadlineExceededError", "next_bucket", "export_stablehlo",
 ]
 
 
@@ -213,24 +227,84 @@ class InferenceServer:
                     self.close_connection = True  # body left unread
                     return
                 raw = self.rfile.read(n)
-                name = server._route(self.path)
-                if name is None:
+                route = server._route(self.path)
+                if route is None:
                     self._json({"error": "not found"}, 404)
                     return
+                name, action = route
                 req = None
                 try:
                     try:
                         req = json.loads(raw)
                     except json.JSONDecodeError as e:
                         raise ClientError(f"malformed JSON: {e}")
-                    out = server._predict(name, req)
-                    self._json(out)
+                    if action == "generate":
+                        if isinstance(req, dict) and req.get("stream"):
+                            # admission errors raise HERE (before any
+                            # header goes out), so they still map to
+                            # real status codes; mid-stream failures
+                            # become a terminal error chunk instead
+                            it = server._generate_stream(name, req)
+                            self._stream_ndjson(it)
+                        else:
+                            self._json(server._generate(name, req))
+                    else:
+                        self._json(server._predict(name, req))
                 except Exception as e:  # noqa: BLE001
                     code = _status_for(e)
                     version = (req.get("version")
                                if isinstance(req, dict) else None)
                     server._count_error(name, code, version)
                     self._json({"error": str(e)}, code)
+
+            def _stream_ndjson(self, it):
+                """Chunked transfer-encoded newline-delimited JSON: one
+                object per generated token as the scheduler emits it,
+                a terminal ``{"done": true, ...}`` object, then the
+                zero chunk. The keep-alive socket stays in sync —
+                chunked framing is self-delimiting."""
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/x-ndjson")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                except OSError:
+                    # client vanished before the headers went out:
+                    # abandon the generation (frees its slot) and do
+                    # NOT fall through to a second response attempt
+                    if hasattr(it, "close"):
+                        it.close()
+                    self.close_connection = True
+                    return
+
+                def chunk(obj):
+                    data = (json.dumps(obj) + "\n").encode()
+                    self.wfile.write(f"{len(data):X}\r\n".encode()
+                                     + data + b"\r\n")
+                    self.wfile.flush()
+                try:
+                    try:
+                        for item in it:
+                            chunk(item)
+                    except OSError:
+                        # client went away mid-stream: routine, not a
+                        # server error — close the iterator NOW (its
+                        # cleanup abandons the request, freeing its
+                        # cache slot) and drop the connection quietly
+                        if hasattr(it, "close"):
+                            it.close()
+                        self.close_connection = True
+                        return
+                    except Exception as e:  # noqa: BLE001 — headers
+                        # are already on the wire; deliver in-band
+                        chunk({"error": str(e),
+                               "status": _status_for(e), "done": True})
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    # the error/terminal chunk hit the dead socket too;
+                    # never fall through to a second HTTP response
+                    self.close_connection = True
 
         self.httpd = _HTTPServer((host, port), Handler)
         self.host = self.httpd.server_address[0]
@@ -254,15 +328,27 @@ class InferenceServer:
                version: Optional[int] = None) -> ServedModel:
         return self.registry.get(name, version)
 
+    def register_generator(self, name: str, model, **opts) -> ServedGenerator:
+        """Register a causal LM for continuous-batching generation at
+        ``/v1/models/<name>/generate`` (queue bound and default
+        timeout inherit the server's batching policy unless
+        overridden)."""
+        merged = {"max_queue": self._opts["max_queue"],
+                  "default_timeout_ms": self._opts["default_timeout_ms"]}
+        merged.update(opts)
+        return self.registry.register_generator(name, model, **merged)
+
     # -- request handling ----------------------------------------------
-    def _route(self, path: str) -> Optional[str]:
-        """Map a POST path to a model name (None = 404)."""
+    def _route(self, path: str):
+        """Map a POST path to (model name, action); None = 404."""
         if path == "/predict":
-            return self.DEFAULT_MODEL
+            return self.DEFAULT_MODEL, "predict"
+        if path == "/generate":
+            return self.DEFAULT_MODEL, "generate"
         parts = [p for p in path.split("/") if p]
         if len(parts) == 4 and parts[:2] == ["v1", "models"] \
-                and parts[3] == "predict":
-            return parts[2]
+                and parts[3] in ("predict", "generate"):
+            return parts[2], parts[3]
         return None
 
     def _predict(self, name: str, req) -> dict:
@@ -271,15 +357,21 @@ class InferenceServer:
         if "inputs" not in req:
             raise ClientError("missing 'inputs'")
         version = req.get("version")
-        if version is not None and not isinstance(version, int):
+        if version is not None and (not isinstance(version, int)
+                                    or isinstance(version, bool)):
             raise ClientError("'version' must be an integer")
         served = self.registry.get(name, version)
+        if not hasattr(served, "predict"):
+            raise ClientError(
+                f"model {name!r} is a generation model — POST to "
+                f"/v1/models/{name}/generate instead")
         outputs = req.get("outputs")
         if outputs is not None and not isinstance(outputs, (list, tuple)):
             raise ClientError("'outputs' must be a list of names")
         timeout_ms = req.get("timeout_ms")
-        if timeout_ms is not None and not isinstance(timeout_ms,
-                                                     (int, float)):
+        if timeout_ms is not None and (
+                not isinstance(timeout_ms, (int, float))
+                or isinstance(timeout_ms, bool)):
             raise ClientError("'timeout_ms' must be a number")
         res = served.predict(req["inputs"], outputs, timeout_ms=timeout_ms)
         if isinstance(res, dict):
@@ -289,6 +381,42 @@ class InferenceServer:
             return {"outputs": [np.asarray(v).tolist() for v in res]}
         return {"outputs": np.asarray(res).tolist()}
 
+    def _gen_opts(self, name: str, req):
+        """Parse + validate a generate payload into (served, prompt,
+        engine kwargs). Raises :class:`ClientError` on bad fields."""
+        if not isinstance(req, dict):
+            raise ClientError("request body must be a JSON object")
+        if "prompt" not in req:
+            raise ClientError("missing 'prompt' (a list of token ids)")
+        version = req.get("version")
+        if version is not None and not isinstance(version, int):
+            raise ClientError("'version' must be an integer")
+        served = self.registry.get(name, version)
+        if not hasattr(served, "generate"):
+            raise ClientError(
+                f"model {name!r} is a predict model — POST to "
+                f"/v1/models/{name}/predict instead")
+        opts = {}
+        for key, types in (("max_tokens", int), ("temperature",
+                                                 (int, float)),
+                           ("top_k", int), ("seed", int),
+                           ("eos_id", int),
+                           ("timeout_ms", (int, float))):
+            if key in req and req[key] is not None:
+                if not isinstance(req[key], types) or isinstance(
+                        req[key], bool):
+                    raise ClientError(f"{key!r} must be a number")
+                opts[key] = req[key]
+        return served, req["prompt"], opts
+
+    def _generate(self, name: str, req) -> dict:
+        served, prompt, opts = self._gen_opts(name, req)
+        return served.generate(prompt, **opts)
+
+    def _generate_stream(self, name: str, req):
+        served, prompt, opts = self._gen_opts(name, req)
+        return served.stream(prompt, **opts)
+
     def _count_error(self, name: str, code: int, version=None):
         try:
             m = self.registry.get(
@@ -297,7 +425,11 @@ class InferenceServer:
             return
         if code == 400:
             m.inc("client_errors")
-        elif code >= 500 and code not in (503, 504):
+        elif code >= 500 and code not in (503, 504) \
+                and not isinstance(m, GenerationMetrics):
+            # generation 5xx are already counted at the engine
+            # (GenerationEngine._fail) so direct-API users see them
+            # too; counting here as well would double them
             m.inc("server_errors")
 
     def _health(self) -> dict:

@@ -39,7 +39,8 @@ class DeadlineExceededError(ServingError):
 
 class _Request:
     __slots__ = ("feed", "n", "sig", "deadline", "event", "result",
-                 "error", "t_submit", "abandoned")
+                 "error", "t_submit", "abandoned", "_lock",
+                 "_timeout_counted")
 
     def __init__(self, feed, n, sig, deadline):
         self.feed = feed
@@ -51,6 +52,17 @@ class _Request:
         self.error: Optional[BaseException] = None
         self.t_submit = time.perf_counter()
         self.abandoned = False  # submitter gave up; don't execute/count
+        self._lock = threading.Lock()
+        self._timeout_counted = False
+
+    def count_timeout_once(self, metrics) -> None:
+        """Waiter and scheduler can both observe the deadline expiring
+        at the same instant; the counter must move once per request."""
+        with self._lock:
+            if self._timeout_counted:
+                return
+            self._timeout_counted = True
+        metrics.inc("timeouts")
 
 
 class MicroBatcher:
@@ -114,8 +126,8 @@ class MicroBatcher:
         self.metrics.inc("requests")
         self.metrics.queue_depth = self._queue.qsize()
         if not req.event.wait(timeout + 1.0):  # grace for the device call
-            req.abandoned = True  # scheduler: skip it, don't recount it
-            self.metrics.inc("timeouts")
+            req.abandoned = True  # scheduler: skip it, don't re-execute
+            req.count_timeout_once(self.metrics)
             raise DeadlineExceededError(
                 f"no result within {timeout * 1e3:.0f} ms")
         if req.error is not None:
@@ -137,13 +149,13 @@ class MicroBatcher:
 
     def _expired(self, req) -> bool:
         """Drop a dead request instead of spending device time on rows
-        nobody will read. Counts the timeout only if the submitter has
-        not already counted it (abandoned)."""
+        nobody will read. The timeout count is a per-request CAS — the
+        waiter may be counting the same expiry concurrently."""
         if req.abandoned:
             return True
         if time.perf_counter() > req.deadline:
             req.error = DeadlineExceededError("expired in queue")
-            self.metrics.inc("timeouts")
+            req.count_timeout_once(self.metrics)
             req.event.set()
             return True
         return False

@@ -1,0 +1,99 @@
+"""Static-shape slot-managed KV cache for continuous-batching decode.
+
+The vLLM/PagedAttention insight (PAPERS.md), applied at slot rather
+than block granularity: preallocate the cache ONCE as per-layer
+``[num_slots, n_heads, max_seq_len, head_dim]`` arrays, and let
+sequences claim/release SLOTS while the array shapes — and therefore
+the compiled decode executable — never change. A sequence that
+finishes frees its slot immediately; the next queued request's prefill
+overwrites the slot's prefix and the unwritten tail stays masked by
+the per-slot length, so no zeroing pass is ever needed between
+occupants.
+
+Host-side bookkeeping (which slot belongs to which request, each
+slot's write position, sampling params) lives in :class:`SlotTable` as
+small numpy arrays that ship to the device once per decode step — the
+device never sees request identity, only the dense slot batch.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class KVCache:
+    """Per-layer K/V slot arrays, held as a pytree the compiled
+    prefill/decode executables thread through (functionally: each call
+    returns the updated arrays, which replace these)."""
+
+    def __init__(self, layer_shapes: Sequence[Tuple[int, int, int]],
+                 num_slots: int, dtype=jnp.float32):
+        self.num_slots = int(num_slots)
+        self.layer_shapes = [tuple(s) for s in layer_shapes]
+        self.dtype = dtype
+        self.ks: List[jnp.ndarray] = [
+            jnp.zeros((self.num_slots,) + s, dtype) for s in self.layer_shapes]
+        self.vs: List[jnp.ndarray] = [
+            jnp.zeros((self.num_slots,) + s, dtype) for s in self.layer_shapes]
+
+    def nbytes(self) -> int:
+        """Device bytes the cache pins — the number to budget
+        num_slots * max_seq_len against HBM."""
+        return int(sum(2 * int(np.prod((self.num_slots,) + s))
+                       * jnp.dtype(self.dtype).itemsize
+                       for s in self.layer_shapes))
+
+
+class SlotTable:
+    """Host-side slot bookkeeping: free-list allocation plus the dense
+    per-slot arrays (current token, write position, sampling params)
+    that feed the decode executable each step. Inactive slots carry
+    benign values (pos 0, temp 0) — they ride the batch as masked
+    lanes and their lanes' outputs are simply never read."""
+
+    def __init__(self, num_slots: int):
+        self.num_slots = int(num_slots)
+        self._free = list(range(self.num_slots - 1, -1, -1))
+        self.requests: List[Optional[object]] = [None] * self.num_slots
+        self.token = np.zeros(self.num_slots, np.int32)
+        self.pos = np.zeros(self.num_slots, np.int32)
+        self.step = np.zeros(self.num_slots, np.int32)
+        self.seed = np.zeros(self.num_slots, np.uint32)
+        self.temp = np.zeros(self.num_slots, np.float32)
+        self.top_k = np.zeros(self.num_slots, np.int32)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_count(self) -> int:
+        return self.num_slots - len(self._free)
+
+    def active_slots(self) -> List[int]:
+        return [s for s in range(self.num_slots)
+                if self.requests[s] is not None]
+
+    def alloc(self, request) -> Optional[int]:
+        """Claim a free slot for ``request`` (None when full)."""
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self.requests[slot] = request
+        return slot
+
+    def free(self, slot: int):
+        """Release a slot. No cache zeroing: the next occupant's
+        prefill overwrites the prefix and its length masks the tail."""
+        if self.requests[slot] is None:
+            raise ValueError(f"slot {slot} is already free")
+        self.requests[slot] = None
+        self.token[slot] = 0
+        self.pos[slot] = 0
+        self.step[slot] = 0
+        self.seed[slot] = 0
+        self.temp[slot] = 0.0
+        self.top_k[slot] = 0
+        self._free.append(slot)

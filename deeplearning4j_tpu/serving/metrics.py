@@ -11,7 +11,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List
 
-from ..profiler import CountHistogram, OpProfiler, Reservoir
+from ..profiler import CountHistogram, OpProfiler, RateMeter, Reservoir
 
 
 class ServingMetrics:
@@ -76,6 +76,86 @@ class ServingMetrics:
                 "hits": self.cache_hits,
                 "misses": self.cache_misses,
                 "evictions": self.cache_evictions,
+                "warmed_buckets": list(self.warmed_buckets),
+            },
+        }
+
+
+class GenerationMetrics:
+    """Always-on counters for one continuous-batching generation
+    engine. Same threading discipline as :class:`ServingMetrics`
+    (scalar counters via :meth:`inc`, never ``+=``): the HTTP handler
+    threads and the scheduler thread both write here."""
+
+    def __init__(self, latency_window: int = 8192,
+                 rate_window_s: float = 30.0):
+        self._lock = threading.Lock()
+        self.requests = 0          # accepted into the queue
+        self.responses = 0         # finished generations returned
+        self.client_errors = 0     # 4xx-class failures
+        self.server_errors = 0     # 5xx-class failures
+        self.shed = 0              # rejected, queue full (503)
+        self.timeouts = 0          # deadline exceeded (504)
+        self.prefills = 0          # prefill device calls
+        self.decode_steps = 0      # decode device calls (all slots)
+        self.tokens = RateMeter(rate_window_s)   # generated tokens
+        self.occupancy_hist = CountHistogram()   # active slots per step
+        self.prompt_bucket_hist = CountHistogram()  # padded prefill len
+        self.ttft_ms = Reservoir(latency_window)    # submit -> 1st token
+        self.itl_ms = Reservoir(latency_window)     # inter-token gap
+        self.prefill_ms = Reservoir(latency_window)
+        self.decode_step_ms = Reservoir(latency_window)
+        self.queue_depth = 0       # gauge, updated by the scheduler
+        self.queue_max = 0
+        self.active_slots = 0      # gauge
+        self.num_slots = 0
+        self.cache_bytes = 0
+        # compile cache: decode + one prefill executable per bucket
+        self.compiles = 0
+        self.warmed_buckets: List[int] = []
+
+    def inc(self, field: str, n: int = 1):
+        """Thread-safe counter increment."""
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def snapshot(self) -> Dict:
+        occ = self.occupancy_hist
+        steps = occ.total()
+        return {
+            "requests": self.requests,
+            "responses": self.responses,
+            "client_errors": self.client_errors,
+            "server_errors": self.server_errors,
+            "shed": self.shed,
+            "timeouts": self.timeouts,
+            "queue_depth": self.queue_depth,
+            "queue_max": self.queue_max,
+            "prefills": self.prefills,
+            "decode_steps": self.decode_steps,
+            "tokens_generated": self.tokens.total(),
+            "tokens_per_sec": round(self.tokens.rate(), 3),
+            "slots": {
+                "num_slots": self.num_slots,
+                "active": self.active_slots,
+                "mean_occupancy": round(occ.mean(), 3),
+                "utilization": round(
+                    occ.mean() / self.num_slots, 4) if (
+                        self.num_slots and steps) else 0.0,
+                "occupancy_hist": occ.snapshot(),
+            },
+            "prompt_bucket_hist": self.prompt_bucket_hist.snapshot(),
+            "ttft_ms": {k: round(v, 3) for k, v in
+                        self.ttft_ms.snapshot().items()},
+            "itl_ms": {k: round(v, 3) for k, v in
+                       self.itl_ms.snapshot().items()},
+            "prefill_ms": {k: round(v, 3) for k, v in
+                           self.prefill_ms.snapshot().items()},
+            "decode_step_ms": {k: round(v, 3) for k, v in
+                               self.decode_step_ms.snapshot().items()},
+            "kv_cache_bytes": self.cache_bytes,
+            "compile_cache": {
+                "compiles": self.compiles,
                 "warmed_buckets": list(self.warmed_buckets),
             },
         }

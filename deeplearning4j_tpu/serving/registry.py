@@ -13,7 +13,8 @@ from typing import Dict, List, Optional, Sequence
 
 from .batcher import MicroBatcher
 from .engine import ClientError, InferenceEngine
-from .metrics import ServingMetrics
+from .generation import GenerationEngine
+from .metrics import GenerationMetrics, ServingMetrics
 
 
 class ModelNotFound(ClientError):
@@ -75,6 +76,48 @@ class ServedModel:
         return s
 
 
+class ServedGenerator:
+    """One (causal LM, version) plus its continuous-batching generation
+    engine — the token-by-token sibling of :class:`ServedModel`,
+    routed at ``/v1/models/<name>/generate``."""
+
+    def __init__(self, name: str, version: int, model,
+                 num_slots: int = 8, max_queue: int = 256,
+                 default_timeout_ms: float = 60_000.0, **engine_opts):
+        # remaining GenerationEngine tuning (max_seq_len,
+        # prompt_buckets, min_prompt_bucket, decode_impl, ...) passes
+        # through verbatim; unknown keys fail loudly in the engine
+        self.name = name
+        self.version = int(version)
+        self.model = model
+        self.engine = GenerationEngine(
+            model, num_slots=num_slots, max_queue=max_queue,
+            default_timeout_ms=default_timeout_ms, **engine_opts)
+
+    @property
+    def metrics(self) -> GenerationMetrics:
+        return self.engine.metrics
+
+    def generate(self, prompt, **opts):
+        return self.engine.generate(prompt, **opts)
+
+    def stream(self, prompt, **opts):
+        return self.engine.stream(prompt, **opts)
+
+    def warmup(self, buckets: Optional[Sequence[int]] = None):
+        return self.engine.warmup(buckets)
+
+    def stop(self):
+        self.engine.stop()
+
+    def stats(self) -> Dict:
+        s = self.metrics.snapshot()
+        s["version"] = self.version
+        s["model_class"] = type(self.model).__name__
+        s["serving_mode"] = "generation"
+        return s
+
+
 class ModelRegistry:
     """register/get/unregister by name (+ version; default = latest)."""
 
@@ -86,16 +129,45 @@ class ModelRegistry:
                  version: Optional[int] = None, **opts) -> ServedModel:
         """Create the engine+batcher for ``model`` and route it at
         ``name``. ``version`` defaults to (latest + 1)."""
+        return self._register(ServedModel, name, model, version, **opts)
+
+    def register_generator(self, name: str, model,
+                           version: Optional[int] = None,
+                           **opts) -> ServedGenerator:
+        """Create a continuous-batching generation engine for a causal
+        LM and route it at ``/v1/models/<name>/generate``. Same
+        name/version space as predict models — one name serves either
+        mode, not both."""
+        return self._register(ServedGenerator, name, model, version,
+                              **opts)
+
+    def _register(self, cls, name: str, model,
+                  version: Optional[int] = None, **opts):
+        if not name or not isinstance(name, str) or "/" in name \
+                or "@" in name:
+            # '/' breaks /v1/models/<name>/... routing (silent 404s);
+            # '@' collides with the name@version keys stats() emits
+            raise ValueError(f"invalid model name {name!r}: must be a "
+                             "non-empty string without '/' or '@'")
         with self._lock:
             versions = self._models.setdefault(name, {})
             try:
+                # one name serves ONE mode: silently flipping the
+                # latest version from predict to generate (or back)
+                # would 400 every existing client of the other route
+                for existing in versions.values():
+                    if type(existing) is not cls:
+                        raise ValueError(
+                            f"model {name!r} is already registered for "
+                            f"{type(existing).__name__} serving — use a "
+                            "different name for the other mode")
                 if version is None:
                     version = max(versions) + 1 if versions else 1
                 version = int(version)
                 if version in versions:
                     raise ValueError(f"model {name!r} version {version} "
                                      "already registered")
-                served = ServedModel(name, version, model, **opts)
+                served = cls(name, version, model, **opts)
                 versions[version] = served
                 return served
             finally:

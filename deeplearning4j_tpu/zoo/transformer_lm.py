@@ -1,0 +1,137 @@
+"""Decoder-only causal transformer LM — the generation-serving workload.
+
+Ref role: `zoo/model/TextGenerationLSTM.java` is the reference's
+autoregressive text model (LSTM char-level, sampled token by token in
+the GravesLSTM example loop). TPU-native, the same capability is a
+causal transformer built from the layer DSL's attention blocks
+(`nn/layers/attention.py`), with an explicit CACHED decode path so the
+serving runtime (`serving/generation.py`) can run token-by-token
+generation against a static-shape KV cache instead of re-running the
+full prefix every step (O(T) per token instead of O(T^2) per sequence).
+
+Two forward surfaces, both pure functions over an explicit params
+pytree (so the serving engine can AOT-compile them with the weights as
+executable ARGUMENTS, never baked-in constants):
+
+- :meth:`forward_prefill`: full-prompt causal pass → per-position
+  logits plus each block's K/V rows for the cache.
+- :meth:`forward_decode`: one token per sequence against the cache
+  (write K/V at ``pos``, attend over the prefix) → next-token logits.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.functional import layer_norm
+from ..nn.layers.attention import TransformerEncoderLayer
+
+
+class CausalTransformerLM:
+    """Token-in/logits-out causal LM with a cached decode path.
+
+    Learned token + position embeddings, ``n_layers`` pre-LN
+    transformer blocks (causal self-attention), final LayerNorm, and a
+    linear head to vocab logits. ``max_seq_len`` bounds the position
+    table AND the decode cache capacity — the static shape everything
+    downstream compiles against.
+    """
+
+    def __init__(self, vocab_size: int, d_model: int = 128,
+                 n_layers: int = 2, n_heads: int = 4,
+                 d_ff: Optional[int] = None, max_seq_len: int = 256,
+                 eos_id: Optional[int] = None, seed: int = 0,
+                 implementation: str = "auto"):
+        self.vocab_size = int(vocab_size)
+        self.d_model = int(d_model)
+        self.n_layers = int(n_layers)
+        self.n_heads = int(n_heads)
+        self.max_seq_len = int(max_seq_len)
+        self.eos_id = eos_id
+        self.seed = int(seed)
+        self.blocks: List[TransformerEncoderLayer] = []
+        for _ in range(self.n_layers):
+            blk = TransformerEncoderLayer(n_heads=n_heads, d_ff=d_ff,
+                                          causal=True,
+                                          implementation=implementation)
+            blk.build((self.max_seq_len, self.d_model))
+            self.blocks.append(blk)
+        self._params = None
+
+    # -- lifecycle -----------------------------------------------------
+    def init(self) -> "CausalTransformerLM":
+        rng = jax.random.PRNGKey(self.seed)
+        k_tok, k_pos, k_head, k_blocks = jax.random.split(rng, 4)
+        V, D = self.vocab_size, self.d_model
+        params = {
+            "tok": jax.random.normal(k_tok, (V, D)) * 0.02,
+            "pos": jax.random.normal(k_pos, (self.max_seq_len, D)) * 0.02,
+            "lnf_g": jnp.ones((D,)), "lnf_b": jnp.zeros((D,)),
+            "head": jax.random.normal(k_head, (D, V)) * 0.02,
+            "blocks": [blk.init_params(k)
+                       for blk, k in zip(self.blocks,
+                                         jax.random.split(k_blocks,
+                                                          self.n_layers))],
+        }
+        self._params = params
+        return self
+
+    def cache_shapes(self,
+                     max_seq_len: Optional[int] = None
+                     ) -> List[Tuple[int, int, int]]:
+        """Per-layer per-sequence K (== V) cache shape:
+        [n_heads, max_seq_len, head_dim]. Pass a smaller
+        ``max_seq_len`` to size a cache below the model's position
+        table (the serving engine does — decode cost scans the full
+        cache capacity every step, so capacity should match the
+        configured sequence bound, not the architectural one)."""
+        n = self.max_seq_len if max_seq_len is None else int(max_seq_len)
+        if n > self.max_seq_len:
+            raise ValueError(f"cache length {n} exceeds the position "
+                             f"table ({self.max_seq_len})")
+        return [blk.cache_shape(n) for blk in self.blocks]
+
+    # -- pure forwards -------------------------------------------------
+    def forward_prefill(self, params, tokens, key_mask=None):
+        """Full-prompt causal pass. tokens: [B, T] int32 (T <= the
+        compiled bucket); key_mask: optional [B, T] validity for padded
+        prompts. Returns (logits [B, T, V], ks, vs) where ks/vs are
+        per-layer [B, H, T, Dh] slabs in decode-cache layout."""
+        B, T = tokens.shape
+        x = params["tok"][tokens] + params["pos"][jnp.arange(T)][None]
+        if key_mask is not None:
+            x = x * key_mask[..., None]
+        ks, vs = [], []
+        for blk, bp in zip(self.blocks, params["blocks"]):
+            x, k, v = blk.apply_prefill(bp, x, key_mask)
+            ks.append(k)
+            vs.append(v)
+        x = layer_norm(x, params["lnf_g"], params["lnf_b"])
+        return x @ params["head"], ks, vs
+
+    def forward_decode(self, params, tokens, pos, k_caches, v_caches,
+                       impl: str = "auto"):
+        """One cached decode step for a batch of sequences (slots).
+        tokens: [S] int32 current token per slot; pos: [S] int32 its
+        position; k_caches/v_caches: per-layer [S, H, T_max, Dh].
+        Returns (logits [S, V], k_caches, v_caches) with each layer's
+        K/V written at ``pos``."""
+        x = params["tok"][tokens] + params["pos"][pos]
+        new_k, new_v = [], []
+        for blk, bp, kc, vc in zip(self.blocks, params["blocks"],
+                                   k_caches, v_caches):
+            x, kc, vc = blk.apply_decode(bp, x, kc, vc, pos, impl)
+            new_k.append(kc)
+            new_v.append(vc)
+        x = layer_norm(x, params["lnf_g"], params["lnf_b"])
+        return x @ params["head"], new_k, new_v
+
+    def logits(self, tokens) -> jnp.ndarray:
+        """Convenience uncached full-sequence logits (tests/training
+        harnesses; the serving path never calls this)."""
+        if self._params is None:
+            self.init()
+        return self.forward_prefill(self._params,
+                                    jnp.asarray(tokens, jnp.int32))[0]

@@ -40,3 +40,59 @@ def test_probe_code_is_platform_gated():
     src = open(os.path.join(ROOT, "bench.py")).read()
     probe_fn = src.split("def _probe_tpu", 1)[1].split("\n\n", 1)[0]
     assert 'p.get("platform") in ("tpu", "axon")' in probe_fn, probe_fn
+
+
+def test_generation_scenario_harness_runs_on_cpu():
+    """The continuous-batching generation scenario at tiny scale: every
+    code path (uncached baseline, cached-sequential reference, the
+    concurrent engine, JSON emission) must complete, outputs must be
+    token-identical across engine configurations, and the measured
+    window must be compile-free."""
+    import bench
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    # argv: N_REQ=8 requests, 4 slots — small enough for CI cadence
+    r = subprocess.run([sys.executable, "-c", bench.GENERATION_CODE,
+                        "8", "4"],
+                       capture_output=True, text=True, timeout=600,
+                       env=env, cwd=ROOT)
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = [l for l in r.stdout.splitlines() if l.startswith("{")][-1]
+    res = json.loads(line)
+    assert res["total_tokens"] > 0
+    assert res["tokens_per_sec"] > 0
+    assert res["sequential_tokens_per_sec"] > 0
+    # identity across DIFFERENT batch shapes rests on cross-shape XLA
+    # reduction determinism — report-only in the bench, so here just
+    # require the field to exist (engine-level reproducibility is
+    # asserted exactly in tests/test_generation.py, same shapes)
+    assert isinstance(res["tokens_identical_to_cached_sequential"],
+                      bool)
+    assert res["recompiles_post_warmup"] == 0
+    assert res["mean_slot_occupancy"] > 1.0  # it actually batched
+
+
+def test_check_bench_regression_comparator():
+    """tools/check_bench_regression.py: >20% drops fail, equal or
+    missing metrics don't (missing is reported as skipped)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "cbr", os.path.join(ROOT, "tools", "check_bench_regression.py"))
+    cbr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cbr)
+    rec = {"value": 100.0,
+           "extra": {"word2vec": {"tokens_per_sec": 1000.0},
+                     "generation": {"tokens_per_sec": 500.0,
+                                    "speedup_vs_sequential": 4.0}}}
+    same = json.loads(json.dumps(rec))
+    r = cbr.compare(rec, same, 0.2)
+    assert not r["regressions"] and len(r["ok"]) == 4
+    bad = json.loads(json.dumps(rec))
+    bad["extra"]["generation"]["tokens_per_sec"] = 350.0   # -30%
+    r = cbr.compare(rec, bad, 0.2)
+    assert [e["metric"] for e in r["regressions"]] == \
+        ["generation_tokens_per_sec"]
+    partial = {"value": 95.0, "extra": {}}                 # -5%: fine
+    r = cbr.compare(rec, partial, 0.2)
+    assert not r["regressions"]
+    assert len(r["skipped"]) == 3  # the extras didn't run

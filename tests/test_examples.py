@@ -11,7 +11,8 @@ _COVERED = {"lenet_mnist", "vae_anomaly", "bilstm_text_classification",
             "data_parallel", "dqn_cartpole", "transfer_learning",
             "custom_samediff_layer", "csv_classifier_etl",
             "distributed_transformer_4d", "remote_training_dashboard",
-            "audio_classification_wav", "model_serving"}
+            "audio_classification_wav", "model_serving",
+            "text_generation"}
 
 
 def test_every_example_has_a_test():
@@ -93,3 +94,15 @@ def test_model_serving():
     m = model_serving.main(quick=True)
     assert m["responses"] == 24          # 8 clients x 3 requests
     assert m["compile_cache"]["compiles"] <= 5   # warmup-bounded
+
+
+def test_text_generation():
+    import text_generation
+    n_tokens, n_streamed, m = text_generation.main(quick=True)
+    # the example model has eos_id=0, so greedy decode may legitimately
+    # stop early — require progress, not an exact count
+    assert n_tokens > 0 and 1 <= n_streamed <= 6
+    assert m["tokens_generated"] >= n_tokens + n_streamed
+    # warmup covered every bucket: traffic compiled nothing extra
+    assert m["compile_cache"]["compiles"] == \
+        1 + len(m["compile_cache"]["warmed_buckets"])

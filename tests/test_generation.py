@@ -1,0 +1,536 @@
+"""Continuous-batching generation runtime tests: decode-attention
+kernels, cached-decode layer parity, slot KV cache, the iteration-level
+scheduler (mixed-length concurrency, slot reuse, EOS/max_tokens
+retirement, zero post-warmup recompiles), sampling reproducibility,
+HTTP generate endpoint (JSON + chunked streaming), and error-path
+metrics (503 shed / 504 deadline) for both the generation queue and the
+micro-batcher."""
+import http.client
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.kernels.decode_attention import (
+    decode_attention_pallas, decode_attention_xla)
+from deeplearning4j_tpu.nn.layers.attention import (SelfAttentionLayer,
+                                                    TransformerEncoderLayer)
+from deeplearning4j_tpu.serving import (ClientError, DeadlineExceededError,
+                                        GenerationEngine, InferenceServer,
+                                        KVCache, QueueFullError, SlotTable)
+from deeplearning4j_tpu.zoo.transformer_lm import CausalTransformerLM
+
+
+def _lm(vocab=64, d_model=32, n_layers=2, n_heads=4, max_seq_len=32,
+        seed=0):
+    return CausalTransformerLM(vocab_size=vocab, d_model=d_model,
+                               n_layers=n_layers, n_heads=n_heads,
+                               max_seq_len=max_seq_len, seed=seed,
+                               implementation="plain").init()
+
+
+def _ref_greedy(lm, prompt, n):
+    """Uncached full-prefix greedy decode — the correctness oracle the
+    cached slot path must reproduce exactly."""
+    toks = list(prompt)
+    out = []
+    for _ in range(n):
+        logits = np.asarray(lm.logits(np.asarray(toks)[None]))[0, -1]
+        t = int(logits.argmax())
+        out.append(t)
+        toks.append(t)
+    return out
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return _lm()
+
+
+@pytest.fixture(scope="module")
+def engine(lm):
+    eng = GenerationEngine(lm, num_slots=4, max_queue=64,
+                           min_prompt_bucket=4)
+    eng.warmup()
+    yield eng
+    eng.stop()
+
+
+class TestDecodeAttentionKernel:
+    def test_pallas_matches_xla(self):
+        rng = jax.random.PRNGKey(0)
+        ks = jax.random.split(rng, 3)
+        S, T, H, D = 3, 16, 4, 8
+        q = jax.random.normal(ks[0], (S, H, D))
+        k = jax.random.normal(ks[1], (S, H, T, D))
+        v = jax.random.normal(ks[2], (S, H, T, D))
+        lens = jnp.array([1, 7, 16], jnp.int32)
+        a = np.asarray(decode_attention_xla(q, k, v, lens))
+        b = np.asarray(decode_attention_pallas(q, k, v, lens,
+                                               interpret=True))
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+    def test_empty_slot_is_zero_not_nan(self):
+        """A freed slot rides the decode batch with length 0 — its lane
+        must stay finite (and zero), never poison the step."""
+        S, T, H, D = 2, 8, 2, 4
+        q = jnp.ones((S, H, D))
+        k = jnp.ones((S, H, T, D))
+        v = jnp.ones((S, H, T, D))
+        lens = jnp.array([0, 8], jnp.int32)
+        for impl in (decode_attention_xla,
+                     lambda *a: decode_attention_pallas(*a,
+                                                        interpret=True)):
+            out = np.asarray(impl(q, k, v, lens))
+            assert np.isfinite(out).all()
+            assert np.abs(out[0]).max() == 0.0
+
+    def test_masked_tail_ignored(self):
+        """Keys past the live length must not influence the output."""
+        S, T, H, D = 1, 8, 2, 4
+        rng = jax.random.PRNGKey(1)
+        ks = jax.random.split(rng, 3)
+        q = jax.random.normal(ks[0], (S, H, D))
+        k = jax.random.normal(ks[1], (S, H, T, D))
+        v = jax.random.normal(ks[2], (S, H, T, D))
+        lens = jnp.array([5], jnp.int32)
+        base = np.asarray(decode_attention_xla(q, k, v, lens))
+        k2 = k.at[:, :, 5:].set(99.0)
+        v2 = v.at[:, :, 5:].set(-99.0)
+        poisoned = np.asarray(decode_attention_xla(q, k2, v2, lens))
+        np.testing.assert_allclose(base, poisoned, rtol=1e-6)
+
+
+class TestCachedDecodeLayers:
+    def test_block_prefill_and_decode_match_full_forward(self):
+        B, T, C, Tmax = 2, 6, 16, 8
+        lay = TransformerEncoderLayer(n_heads=4, causal=True,
+                                      implementation="plain")
+        lay.build((T, C))
+        p = lay.init_params(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (B, T, C))
+        y_full, _, _ = lay.apply_seq(p, x, None, False, None, (), None)
+        H, Dh = 4, 4
+        kc = jnp.zeros((B, H, Tmax, Dh))
+        vc = jnp.zeros((B, H, Tmax, Dh))
+        y_pre, k, v = lay.apply_prefill(p, x[:, :4])
+        np.testing.assert_allclose(np.asarray(y_pre),
+                                   np.asarray(y_full[:, :4]), atol=1e-5)
+        kc = kc.at[:, :, :4].set(k)
+        vc = vc.at[:, :, :4].set(v)
+        for t in range(4, T):
+            o, kc, vc = lay.apply_decode(
+                p, x[:, t], kc, vc, jnp.full((B,), t, jnp.int32))
+            np.testing.assert_allclose(np.asarray(o),
+                                       np.asarray(y_full[:, t]),
+                                       atol=1e-5)
+
+    def test_acausal_prefill_rejected(self):
+        lay = SelfAttentionLayer(n_heads=2, causal=False)
+        lay.build((4, 8))
+        p = lay.init_params(jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="causal"):
+            lay.apply_prefill(p, jnp.zeros((1, 4, 8)))
+
+    def test_cache_shape(self):
+        lay = SelfAttentionLayer(n_heads=2, n_out=8, causal=True)
+        lay.build((4, 8))
+        assert lay.cache_shape(16) == (2, 16, 4)
+
+
+class TestKVCacheSlots:
+    def test_alloc_free_cycle(self):
+        st = SlotTable(3)
+        slots = [st.alloc(object()) for _ in range(3)]
+        assert sorted(slots) == [0, 1, 2]
+        assert st.alloc(object()) is None        # full
+        st.free(slots[1])
+        assert st.free_count == 1
+        assert st.alloc(object()) == slots[1]    # reused
+        st.free(0)
+        with pytest.raises(ValueError):
+            st.free(0)                           # double-free guard
+
+    def test_cache_bytes(self):
+        cache = KVCache([(2, 8, 4), (2, 8, 4)], num_slots=4)
+        # 2 layers * K+V * 4 slots * 2*8*4 f32
+        assert cache.nbytes() == 2 * 2 * 4 * 2 * 8 * 4 * 4
+
+
+class TestGenerationEngine:
+    def test_greedy_matches_uncached_reference(self, lm, engine):
+        r = engine.generate([1, 2, 3], max_tokens=6)
+        assert r["tokens"] == _ref_greedy(lm, [1, 2, 3], 6)
+        assert r["finish_reason"] == "length"
+        assert r["prompt_tokens"] == 3
+
+    def test_concurrent_mixed_lengths_all_exact(self, lm, engine):
+        """More requests than slots, different prompt lengths and
+        generation lengths — every result must still match the
+        sequential oracle (continuous batching must not leak state
+        across slots or steps)."""
+        cases = [(list(range(1, 2 + i)), 3 + i) for i in range(6)]
+        results = {}
+
+        def go(i, prompt, n):
+            results[i] = engine.generate(prompt, max_tokens=n)
+
+        threads = [threading.Thread(target=go, args=(i, p, n))
+                   for i, (p, n) in enumerate(cases)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, (prompt, n) in enumerate(cases):
+            assert results[i]["tokens"] == _ref_greedy(lm, prompt, n), \
+                f"request {i} diverged"
+        # all slots were exercised and freed
+        assert engine._slots.free_count == engine.num_slots
+        occ = engine.metrics.occupancy_hist.snapshot()
+        assert any(int(k) > 1 for k in occ), \
+            f"no step ever ran >1 slot: {occ}"
+
+    def test_zero_recompiles_after_warmup(self, engine):
+        before = engine.metrics.compiles
+        threads = [threading.Thread(
+            target=lambda i=i: engine.generate([1 + i, 2], max_tokens=4,
+                                               temperature=0.5, seed=i))
+            for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert engine.metrics.compiles == before
+
+    def test_seeded_sampling_reproducible(self, engine):
+        a = engine.generate([5, 6], max_tokens=8, temperature=0.9,
+                            top_k=8, seed=42)
+        b = engine.generate([5, 6], max_tokens=8, temperature=0.9,
+                            top_k=8, seed=42)
+        c = engine.generate([5, 6], max_tokens=8, temperature=0.9,
+                            top_k=8, seed=7)
+        assert a["tokens"] == b["tokens"]       # same seed, same tokens
+        assert a["tokens"] != c["tokens"]       # different seed differs
+
+    def test_eos_retires_immediately(self, engine):
+        probe = engine.generate([5, 6], max_tokens=8, temperature=0.9,
+                                top_k=8, seed=42)
+        eos = probe["tokens"][2]
+        r = engine.generate([5, 6], max_tokens=8, temperature=0.9,
+                            top_k=8, seed=42, eos_id=eos)
+        assert r["finish_reason"] == "eos"
+        assert r["tokens"] == probe["tokens"][:3]
+        assert engine._slots.free_count == engine.num_slots
+
+    def test_max_tokens_clamped_to_cache_capacity(self, lm, engine):
+        prompt = list(range(1, 30))                   # max_seq_len=32
+        r = engine.generate(prompt, max_tokens=1000)
+        assert len(r["tokens"]) == engine.max_seq_len - len(prompt)
+
+    def test_client_errors(self, engine):
+        with pytest.raises(ClientError):
+            engine.generate([], max_tokens=4)         # empty prompt
+        with pytest.raises(ClientError):
+            engine.generate([1, 999999], max_tokens=4)  # out of vocab
+        with pytest.raises(ClientError):
+            engine.generate([[1, 2]], max_tokens=4)   # not 1-D
+        with pytest.raises(ClientError):
+            engine.generate(list(range(1, 33)))       # no room to gen
+        with pytest.raises(ClientError):
+            engine.generate([1], max_tokens=0)
+
+    def test_streaming_matches_blocking(self, engine):
+        kw = dict(max_tokens=5, temperature=0.7, top_k=4, seed=11)
+        blocking = engine.generate([3, 4], **kw)
+        chunks = list(engine.stream([3, 4], **kw))
+        tokens = [c["token"] for c in chunks if "token" in c]
+        assert tokens == blocking["tokens"]
+        assert chunks[-1]["done"] is True
+        assert chunks[-1]["finish_reason"] == blocking["finish_reason"]
+
+    def test_extreme_top_k_is_normalized_not_poisonous(self, lm, engine):
+        """top_k >= vocab (any magnitude, incl. > int32) is the
+        documented 'no filter' spelling — it must sample normally, not
+        overflow np.int32 in the scheduler and poison the batch."""
+        r = engine.generate([4, 5], max_tokens=4, temperature=0.8,
+                            top_k=2**31, seed=9)
+        u = engine.generate([4, 5], max_tokens=4, temperature=0.8,
+                            top_k=0, seed=9)
+        assert r["tokens"] == u["tokens"]   # same as unfiltered
+        r2 = engine.generate([4, 5], max_tokens=4, temperature=0.8,
+                             top_k=-2**40, seed=9)
+        assert r2["tokens"] == u["tokens"]
+        with pytest.raises(ClientError, match="top-k cap"):
+            # between the cap and vocab would silently mis-filter
+            from deeplearning4j_tpu.serving.generation import TOP_K_CAP
+            eng2 = GenerationEngine(
+                _lm(vocab=TOP_K_CAP + 10), num_slots=1)
+            try:
+                eng2.generate([1], max_tokens=2, top_k=TOP_K_CAP + 1)
+            finally:
+                eng2.stop()
+
+    def test_misconfiguration_rejected_at_construction(self, lm):
+        with pytest.raises(ValueError, match="num_slots"):
+            GenerationEngine(lm, num_slots=0)
+        with pytest.raises(ValueError, match="prompt_buckets"):
+            GenerationEngine(lm, num_slots=1, prompt_buckets=[4096])
+
+    def test_registry_rejects_mode_flip(self, lm):
+        """One name serves ONE mode: registering a generator over a
+        predict name (or vice versa) must fail loudly, not silently
+        flip the route for existing clients."""
+        from deeplearning4j_tpu.serving import ModelRegistry
+
+        class _Duck:
+            def output(self, x):
+                return x
+        reg = ModelRegistry()
+        reg.register("m", _Duck(), batching=False)
+        with pytest.raises(ValueError, match="serving"):
+            reg.register_generator("m", lm, num_slots=1)
+        reg.register_generator("g", lm, num_slots=1)
+        with pytest.raises(ValueError, match="serving"):
+            reg.register("g", _Duck(), batching=False)
+        reg.stop()
+
+    def test_engine_max_seq_len_sizes_cache(self, lm):
+        """An engine bound below the model's position table must
+        allocate (and scan) a cache of ITS capacity, not the model's."""
+        full = GenerationEngine(lm, num_slots=2)            # 32
+        half = GenerationEngine(lm, num_slots=2, max_seq_len=16)
+        assert half.metrics.cache_bytes * 2 == full.metrics.cache_bytes
+        half.warmup()
+        r = half.generate([1, 2], max_tokens=3)
+        assert r["tokens"] == _ref_greedy(lm, [1, 2], 3)
+        full.stop()
+        half.stop()
+
+    def test_never_started_stream_is_abandoned(self, lm):
+        """Dropping a stream WITHOUT iterating (crashed caller, client
+        gone before headers) must still release the request."""
+        eng = GenerationEngine(lm, num_slots=1, max_queue=8,
+                               min_prompt_bucket=4)
+        eng.warmup([4])
+        it = eng.stream([1, 2], max_tokens=25, temperature=0.5)
+        it.close()          # consumer never called next()
+        deadline = time.time() + 5.0
+        while eng._slots.free_count == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        assert eng._slots.free_count == eng.num_slots
+        r = eng.generate([1, 2, 3], max_tokens=3)
+        assert r["tokens"] == _ref_greedy(lm, [1, 2, 3], 3)
+        eng.stop()
+
+    def test_dropped_stream_frees_its_slot(self, lm):
+        """A consumer that abandons a streaming iterator mid-generate
+        (client disconnect) must not pin its KV-cache slot until
+        max_tokens — the scheduler frees it on the next step."""
+        eng = GenerationEngine(lm, num_slots=1, max_queue=8,
+                               min_prompt_bucket=4)
+        eng.warmup([4])
+        it = eng.stream([1, 2], max_tokens=25, temperature=0.5)
+        next(it)            # take one token...
+        it.close()          # ...then hang up
+        deadline = time.time() + 5.0
+        while eng._slots.free_count == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        assert eng._slots.free_count == eng.num_slots
+        # the engine is still fully servable afterwards
+        r = eng.generate([1, 2, 3], max_tokens=3)
+        assert r["tokens"] == _ref_greedy(lm, [1, 2, 3], 3)
+        assert eng.metrics.server_errors == 0
+        eng.stop()
+
+    def test_queue_expiry_is_504_and_counted(self, lm):
+        eng = GenerationEngine(lm, num_slots=1, max_queue=8,
+                               min_prompt_bucket=4)
+        eng.warmup([4])
+        before = eng.metrics.timeouts
+        with pytest.raises(DeadlineExceededError):
+            eng.generate([1, 2], max_tokens=4, timeout_ms=0)
+        assert eng.metrics.timeouts > before
+        eng.stop()
+
+    def test_queue_full_is_503_and_counted(self, lm):
+        eng = GenerationEngine(lm, num_slots=1, max_queue=1,
+                               min_prompt_bucket=4)
+        eng.warmup([4])
+        results = []
+
+        def client(i):
+            try:
+                results.append(
+                    ("ok", eng.generate([1 + i % 8], max_tokens=24)))
+            except QueueFullError:
+                results.append(("shed", None))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert any(kind == "shed" for kind, _ in results)
+        assert eng.metrics.shed >= 1
+        eng.stop()
+
+    def test_custom_prompt_buckets_route_without_compiles(self, lm):
+        """A custom (gappy) bucket list must route prompts UP to the
+        next configured bucket — never to an unwarmed pow2 size that
+        would compile under traffic — and max_seq_len is always a
+        bucket so every admissible prompt has a compiled home."""
+        eng = GenerationEngine(lm, num_slots=2, prompt_buckets=[16])
+        assert eng.prompt_buckets == [16, 32]   # max_seq_len appended
+        eng.warmup()
+        before = eng.metrics.compiles
+        r = eng.generate([1, 2, 3], max_tokens=3)        # 3 -> 16
+        assert r["tokens"] == _ref_greedy(lm, [1, 2, 3], 3)
+        r = eng.generate(list(range(1, 21)), max_tokens=3)  # 20 -> 32
+        assert r["tokens"] == _ref_greedy(lm, list(range(1, 21)), 3)
+        assert eng.metrics.compiles == before
+        assert set(eng.metrics.prompt_bucket_hist.snapshot()) == \
+            {"16", "32"}
+        eng.stop()
+
+    def test_stats_surface(self, engine):
+        engine.generate([1, 2], max_tokens=4)
+        s = engine.stats()
+        assert s["tokens_generated"] > 0
+        assert s["tokens_per_sec"] >= 0
+        assert s["ttft_ms"]["count"] > 0
+        assert s["itl_ms"]["count"] > 0
+        assert s["slots"]["num_slots"] == engine.num_slots
+        assert s["slots"]["occupancy_hist"]
+        assert s["prompt_bucket_hist"]
+        assert s["kv_cache_bytes"] > 0
+        assert set(s["compile_cache"]["warmed_buckets"]) == set(
+            engine.prompt_buckets)
+
+
+class TestGenerationHTTP:
+    @pytest.fixture(scope="class")
+    def server(self):
+        srv = InferenceServer(port=0)
+        g = srv.register_generator("lm", _lm(), num_slots=4)
+        g.warmup()
+        yield srv
+        srv.stop()
+
+    def _post(self, srv, path, payload, timeout=60):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}{path}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        return json.loads(urllib.request.urlopen(req,
+                                                 timeout=timeout).read())
+
+    def test_generate_roundtrip(self, server):
+        r = self._post(server, "/v1/models/lm/generate",
+                       {"prompt": [1, 2, 3], "max_tokens": 5})
+        assert len(r["tokens"]) == 5
+        assert r["finish_reason"] in ("length", "eos")
+
+    def test_streaming_chunked(self, server):
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=60)
+        body = json.dumps({"prompt": [1, 2, 3], "max_tokens": 5,
+                           "stream": True}).encode()
+        conn.request("POST", "/v1/models/lm/generate", body=body)
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Transfer-Encoding") == "chunked"
+        items = [json.loads(line) for line in
+                 resp.read().decode().strip().splitlines()]
+        conn.close()
+        tokens = [c["token"] for c in items if "token" in c]
+        assert len(tokens) == 5
+        assert items[-1]["done"] is True
+        # streamed tokens match the final result object
+        assert items[-1]["tokens"] == tokens
+
+    def test_keepalive_socket_survives_stream(self, server):
+        """Chunked framing is self-delimiting: the same connection must
+        serve a normal request after a streamed one."""
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=60)
+        conn.request("POST", "/v1/models/lm/generate",
+                     body=json.dumps({"prompt": [2], "max_tokens": 3,
+                                      "stream": True}).encode())
+        conn.getresponse().read()
+        conn.request("POST", "/v1/models/lm/generate",
+                     body=json.dumps({"prompt": [2],
+                                      "max_tokens": 3}).encode())
+        r2 = json.loads(conn.getresponse().read())
+        conn.close()
+        assert len(r2["tokens"]) == 3
+
+    def test_error_codes(self, server):
+        for payload, want in ((["list"], 400),
+                              ({"prompt": []}, 400),
+                              ({"no_prompt": 1}, 400),
+                              ({"prompt": [1], "max_tokens": "x"}, 400)):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                self._post(server, "/v1/models/lm/generate", payload)
+            assert e.value.code == want
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._post(server, "/v1/models/ghost/generate",
+                       {"prompt": [1]})
+        assert e.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._post(server, "/v1/models/lm/predict",
+                       {"inputs": [[1.0]]})
+        assert e.value.code == 400   # generator can't predict
+
+    def test_stats_exposes_generation_metrics(self, server):
+        self._post(server, "/v1/models/lm/generate",
+                   {"prompt": [4, 5], "max_tokens": 4})
+        stats = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/stats", timeout=10).read())
+        m = stats["models"]["lm"]
+        assert m["serving_mode"] == "generation"
+        assert m["tokens_generated"] > 0
+        assert m["ttft_ms"]["count"] > 0
+        assert m["slots"]["occupancy_hist"]
+        assert "tokens_per_sec" in m
+
+    def test_shed_and_timeout_counted_in_stats(self):
+        """ISSUE satellite: 503/504 from the generation queue appear in
+        GET /stats."""
+        srv = InferenceServer(port=0)
+        g = srv.register_generator("g", _lm(), num_slots=1, max_queue=1)
+        g.warmup([8])
+        base = f"http://127.0.0.1:{srv.port}"
+        codes = []
+
+        def client(i, timeout_ms):
+            try:
+                self._post(srv, "/v1/models/g/generate",
+                           {"prompt": [1 + i], "max_tokens": 24,
+                            "timeout_ms": timeout_ms})
+                codes.append(200)
+            except urllib.error.HTTPError as e:
+                codes.append(e.code)
+
+        threads = [threading.Thread(target=client, args=(i, 60_000))
+                   for i in range(6)]
+        threads.append(threading.Thread(target=client, args=(9, 0)))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stats = json.loads(urllib.request.urlopen(
+            base + "/stats", timeout=10).read())["models"]["g"]
+        assert 503 in codes or 504 in codes
+        assert stats["shed"] + stats["timeouts"] >= 1
+        if 503 in codes:
+            assert stats["shed"] >= 1
+        if 504 in codes:
+            assert stats["timeouts"] >= 1
+        srv.stop()

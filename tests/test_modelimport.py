@@ -356,6 +356,59 @@ class TestPerOutputLossDict:
         assert KerasModelImport.import_keras_model_and_weights(p) is not None
 
 
+class TestMaskingGuardScope:
+    """ISSUE satellite: the per-timestep-output Masking guard must only
+    fire for outputs in the DOWNSTREAM CLOSURE of a Masking node —
+    an unrelated unmasked branch with a sequence output is exact and
+    must import."""
+
+    @staticmethod
+    def _cfg(name, cls, inbound):
+        return {"class_name": cls, "config": {"name": name},
+                "inbound_nodes": [[[i, 0, 0, {}] for i in inbound]]}
+
+    @staticmethod
+    def _graph(cfgs, mapped):
+        from deeplearning4j_tpu.modelimport.keras import \
+            _check_masking_semantics_graph
+        return _check_masking_semantics_graph(cfgs, mapped)
+
+    def test_masked_seq_output_still_rejected(self):
+        from deeplearning4j_tpu.nn.layers import MaskingLayer
+
+        class _K:
+            def __init__(self, kind):
+                self.kind = kind
+        cfgs = [self._cfg("in", "InputLayer", []),
+                self._cfg("m", "Masking", ["in"]),
+                self._cfg("l", "LSTM", ["m"]),
+                self._cfg("o", "Dense", ["l"])]
+        mapped = {"m": MaskingLayer(mask_value=0.0), "l": _K("lstm"),
+                  "o": _K("rnnoutput")}
+        with pytest.raises(ValueError, match="per-timestep"):
+            self._graph(cfgs, mapped)
+
+    def test_unrelated_branch_seq_output_accepted(self):
+        from deeplearning4j_tpu.nn.layers import MaskingLayer
+
+        class _K:
+            def __init__(self, kind):
+                self.kind = kind
+        # masked branch ends in a pooled (non-sequence) head; a
+        # SEPARATE unmasked branch has the per-timestep output
+        cfgs = [self._cfg("in1", "InputLayer", []),
+                self._cfg("m", "Masking", ["in1"]),
+                self._cfg("l1", "LSTM", ["m"]),
+                self._cfg("pool", "Dense", ["l1"]),
+                self._cfg("in2", "InputLayer", []),
+                self._cfg("l2", "LSTM", ["in2"]),
+                self._cfg("o2", "Dense", ["l2"])]
+        mapped = {"m": MaskingLayer(mask_value=0.0), "l1": _K("lstm"),
+                  "pool": _K("output"), "l2": _K("lstm"),
+                  "o2": _K("rnnoutput")}
+        self._graph(cfgs, mapped)  # must NOT raise
+
+
 class TestKerasMasking:
     """keras Masking -> MaskZeroLayer wrap on the following RNN (ref:
     KerasMasking.java) — oracle parity against real keras with padded

@@ -37,6 +37,36 @@ class TestFixtureIntegrity:
         with pytest.raises(IOError, match="checksum"):
             _load_real_digits(train=False)
 
+    def test_iterator_surfaces_corruption_not_synthetic(self, tmp_path,
+                                                        monkeypatch):
+        """ISSUE satellite: the iterator's fallback catches only a
+        MISSING fixture (FileNotFoundError). A present-but-corrupt
+        fixture must raise its checksum IOError instead of silently
+        training on synthetic data."""
+        import shutil
+        import deeplearning4j_tpu.datasets as D
+        if D._find_mnist() is not None:
+            pytest.skip("real MNIST present locally; fixture not used")
+        bad = tmp_path / "real_digits"
+        shutil.copytree(_REAL_DIGITS_DIR, bad)
+        p = bad / "train-images-idx3-ubyte.gz"
+        data = bytearray(p.read_bytes())
+        data[-1] ^= 0xFF
+        p.write_bytes(bytes(data))
+        monkeypatch.setattr(D, "_REAL_DIGITS_DIR", str(bad))
+        with pytest.raises(IOError, match="checksum"):
+            MnistDataSetIterator(batch=8, train=True)
+
+    def test_iterator_missing_fixture_falls_back(self, tmp_path,
+                                                 monkeypatch):
+        import deeplearning4j_tpu.datasets as D
+        if D._find_mnist() is not None:
+            pytest.skip("real MNIST present locally; fixture not used")
+        monkeypatch.setattr(D, "_REAL_DIGITS_DIR",
+                            str(tmp_path / "nothing_here"))
+        it = MnistDataSetIterator(batch=8, train=True, num_examples=64)
+        assert it.source == "synthetic"
+
     def test_iterator_reports_real_provenance(self):
         it = MnistDataSetIterator(batch=32, train=True, flatten=False)
         if it.source == "mnist":

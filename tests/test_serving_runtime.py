@@ -429,6 +429,13 @@ class TestInferenceServerHTTP:
             client(timeout_ms=50)
             t.join()
             assert 504 in codes
+            # ISSUE satellite: the sheds and deadline expiries the
+            # clients saw must be visible as counters in GET /stats
+            stats = json.loads(urllib.request.urlopen(
+                base + "/stats", timeout=5).read())
+            m = stats["models"]["default"]
+            assert m["shed"] >= codes.count(503)
+            assert m["timeouts"] >= 1
         finally:
             server.stop()
 

@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""CI gate: diff a fresh `bench.py` run against the latest recorded
+``BENCH_*.json`` and fail (exit 1) on a >20% regression in any
+recorded scenario metric.
+
+Scenario metrics are the higher-is-better throughput numbers the bench
+emits (headline samples/sec plus the per-scenario extras). Only
+metrics present in BOTH the recorded and the fresh run are compared —
+a scenario that didn't run (TPU tunnel down, timeout) is reported as
+"skipped", never failed, so the gate can't be dodged by deleting a
+scenario silently either: removed metrics are listed in the output.
+
+Usage::
+
+    python tools/check_bench_regression.py             # runs bench.py
+    python tools/check_bench_regression.py --fresh out.json
+    python tools/check_bench_regression.py --threshold 0.3
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: (path into the bench JSON) -> short metric name. All higher-is-better.
+METRICS = {
+    ("value",): "headline_samples_per_sec",
+    ("extra", "serving", "requests_per_sec"): "serving_requests_per_sec",
+    ("extra", "serving", "speedup_vs_unbatched"): "serving_speedup",
+    ("extra", "generation", "tokens_per_sec"): "generation_tokens_per_sec",
+    ("extra", "generation", "speedup_vs_sequential"): "generation_speedup",
+    ("extra", "word2vec", "tokens_per_sec"): "word2vec_tokens_per_sec",
+    ("extra", "etl_pipeline", "rows_per_sec"): "etl_rows_per_sec",
+}
+
+
+def _dig(d, path):
+    for p in path:
+        if not isinstance(d, dict) or p not in d:
+            return None
+        d = d[p]
+    return d if isinstance(d, (int, float)) and not isinstance(
+        d, bool) else None
+
+
+def _parse_record(rec: dict, origin: str) -> dict:
+    """Unwrap any of the recording formats into the bench line: the
+    driver's {"parsed": {...}} or {"tail": "<json line>"}, or a bare
+    bench line. Used for BOTH the baseline and --fresh inputs — a
+    format mismatch must error, never degrade to 'all skipped'."""
+    parsed = rec.get("parsed")
+    if parsed is None and "tail" in rec:
+        parsed = json.loads(rec["tail"].strip().splitlines()[-1])
+    if parsed is None and "value" in rec:
+        parsed = rec
+    if parsed is None:
+        raise SystemExit(f"{origin}: no parsable bench line")
+    return parsed
+
+
+def latest_recorded() -> tuple:
+    """(path, parsed bench line) of the newest BENCH_r*.json."""
+    paths = glob.glob(os.path.join(REPO, "BENCH_*.json"))
+    if not paths:
+        raise SystemExit("no recorded BENCH_*.json to compare against")
+
+    def round_no(p):
+        m = re.search(r"BENCH_r(\d+)", os.path.basename(p))
+        return int(m.group(1)) if m else -1
+    path = max(paths, key=round_no)
+    with open(path) as f:
+        rec = json.load(f)
+    return path, _parse_record(rec, path)
+
+
+def run_fresh(timeout_s: int) -> dict:
+    """Run bench.py and parse its final JSON line."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=timeout_s, cwd=REPO)
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    raise SystemExit(f"bench.py produced no JSON line "
+                     f"(rc={out.returncode}):\n{out.stderr[-2000:]}")
+
+
+def compare(recorded: dict, fresh: dict, threshold: float) -> dict:
+    """Returns {"regressions": [...], "ok": [...], "skipped": [...]}."""
+    regressions, ok, skipped = [], [], []
+    for path, name in METRICS.items():
+        old = _dig(recorded, path)
+        new = _dig(fresh, path)
+        if old is None or old <= 0:
+            continue  # never recorded — nothing to hold the line on
+        if new is None:
+            skipped.append({"metric": name, "recorded": old,
+                            "note": "missing from fresh run"})
+            continue
+        ratio = new / old
+        entry = {"metric": name, "recorded": round(old, 3),
+                 "fresh": round(new, 3), "ratio": round(ratio, 3)}
+        if ratio < 1.0 - threshold:
+            regressions.append(entry)
+        else:
+            ok.append(entry)
+    return {"regressions": regressions, "ok": ok, "skipped": skipped}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--fresh", help="path to a pre-existing fresh bench "
+                    "JSON (skips running bench.py)")
+    ap.add_argument("--threshold", type=float, default=0.20,
+                    help="allowed fractional drop (default 0.20)")
+    ap.add_argument("--timeout", type=int, default=7200,
+                    help="bench.py timeout in seconds")
+    args = ap.parse_args(argv)
+    rec_path, recorded = latest_recorded()
+    if args.fresh:
+        with open(args.fresh) as f:
+            fresh = _parse_record(json.load(f), args.fresh)
+    else:
+        fresh = run_fresh(args.timeout)
+    result = compare(recorded, fresh, args.threshold)
+    result["baseline_file"] = os.path.basename(rec_path)
+    result["threshold"] = args.threshold
+    result["fail"] = bool(result["regressions"])
+    print(json.dumps(result, indent=2))
+    return 1 if result["fail"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
