@@ -8,5 +8,6 @@ autoscheduler — attention being the canonical case (per
 """
 from .decode_attention import decode_attention
 from .flash_attention import flash_attention
+from .paged_attention import paged_attention
 
-__all__ = ["flash_attention", "decode_attention"]
+__all__ = ["flash_attention", "decode_attention", "paged_attention"]

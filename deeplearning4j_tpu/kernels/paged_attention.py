@@ -1,0 +1,172 @@
+"""Paged KV-cache decode attention (Pallas TPU + XLA fallback).
+
+The paged sibling of :mod:`.decode_attention`: one query row per
+sequence attends over a prefix whose K/V lives in POOL BLOCKS
+(``[num_blocks, H, block_size, D]``, `serving/paging.py`) addressed
+through a per-sequence block table, instead of a contiguous per-slot
+panel. The op stays HBM-bandwidth bound, so the kernel's job is
+unchanged — stream K/V once, keep online-softmax state in VMEM — with
+one addition: the block table drives WHICH pool block each grid step
+pulls. On TPU that is scalar prefetch (`pltpu.PrefetchScalarGridSpec`,
+pallas guide §12): the int32 tables land in SMEM before the kernel
+body runs, and the K/V BlockSpec index maps read them to aim the
+HBM→VMEM DMA at the right pool block — the gather costs no extra pass
+over memory.
+
+Layout: q [S, H, D]; pools [N, H, Bs, D] (positions contiguous per
+head inside a block, same reasoning as the slot cache's [S, H, T, D]);
+block_tables [S, B] int32 pool indices (NULL_BLOCK-padded); lengths
+[S]. Key position ``j`` of sequence ``s`` lives at
+``pool[block_tables[s, j // Bs], :, j % Bs]``; positions >= lengths[s]
+are masked, so padded table entries are never READ into the result —
+they only keep the gather shape static.
+
+Elsewhere the fused-XLA path gathers the blocks with ``jnp.take`` and
+reuses :func:`~.decode_attention.decode_attention_xla` — the gathered
+[S, H, B*Bs, D] view is bit-identical to a slot cache holding the same
+prefix, which is what makes paged-vs-slot token parity testable.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .decode_attention import decode_attention_xla
+from .flash_attention import _NEG_INF, default_platform
+
+
+def gather_blocks(pool, block_tables):
+    """[N, H, Bs, D] pool + [S, B] tables -> [S, H, B*Bs, D] dense
+    per-sequence panels (the slot-cache layout), via one fused gather."""
+    S, B = block_tables.shape
+    N, H, Bs, D = pool.shape
+    g = jnp.take(pool, block_tables.reshape(-1), axis=0)   # [S*B,H,Bs,D]
+    g = g.reshape(S, B, H, Bs, D).transpose(0, 2, 1, 3, 4)
+    return g.reshape(S, H, B * Bs, D)
+
+
+def paged_attention_xla(q, k_pool, v_pool, block_tables, lengths):
+    """Fused-XLA paged decode attention (CPU/GPU and reference path).
+
+    q: [S, H, D]; k_pool/v_pool: [N, H, Bs, D]; block_tables: [S, B];
+    lengths: [S] — positions >= lengths[s] (stale block tails, padded
+    table entries) are masked out. Shapes depend only on (S, B, Bs),
+    never on live lengths or which blocks a request owns.
+    """
+    return decode_attention_xla(q, gather_blocks(k_pool, block_tables),
+                                gather_blocks(v_pool, block_tables),
+                                lengths)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel
+# ---------------------------------------------------------------------------
+def _paged_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_s, l_s, acc_s, *, block_size: int, scale: float,
+                  precision):
+    s = pl.program_id(0)
+    bi = pl.program_id(2)
+    num_b = pl.num_programs(2)
+
+    @pl.when(bi == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, _NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    q = q_ref[0].astype(jnp.float32)                      # [1, D]
+    k_blk = k_ref[0, 0].astype(jnp.float32)               # [Bs, D]
+    v_blk = v_ref[0, 0].astype(jnp.float32)
+    sc = jnp.dot(q, k_blk.T, precision=precision,
+                 preferred_element_type=jnp.float32) * scale   # [1, Bs]
+    # validity from the global key position, computed in-kernel: the
+    # tables already steered the DMA, so the only per-position fact
+    # left is "is j < length" (covers stale tails AND padded entries)
+    key_pos = bi * block_size + lax.broadcasted_iota(
+        jnp.int32, (1, block_size), 1)
+    mask = key_pos < len_ref[s]
+    sc = jnp.where(mask, sc, _NEG_INF)
+    m_prev = m_s[:, 0]
+    l_prev = l_s[:, 0]
+    m_new = jnp.maximum(m_prev, sc.max(axis=1))
+    # where-guard keeps fully-masked rows at p=0 (exp(-inf - -inf) = 1
+    # would fabricate uniform attention for an empty sequence)
+    p = jnp.where(mask, jnp.exp(sc - m_new[:, None]), 0.0)
+    corr = jnp.exp(m_prev - m_new)
+    m_s[:, 0] = m_new
+    l_s[:, 0] = l_prev * corr + p.sum(axis=1)
+    acc_s[:] = acc_s[:] * corr[:, None] + jnp.dot(
+        p, v_blk, precision=precision,
+        preferred_element_type=jnp.float32)
+
+    @pl.when(bi == num_b - 1)
+    def _finalize():
+        l = jnp.maximum(l_s[:, 0], 1e-30)
+        o_ref[0] = (acc_s[:] / l[:, None]).astype(o_ref.dtype)
+
+
+def paged_attention_pallas(q, k_pool, v_pool, block_tables, lengths,
+                           precision=lax.Precision.DEFAULT,
+                           interpret: Optional[bool] = None):
+    """Pallas paged decode attention. Same contract as
+    :func:`paged_attention_xla`; grid (S, H, blocks-per-seq) with the
+    block tables scalar-prefetched so the K/V index maps aim each grid
+    step's DMA at ``pool[tbl[s, bi]]`` directly — no materialized
+    gather."""
+    if interpret is None:
+        interpret = default_platform() != "tpu"
+    S, H, D = q.shape
+    N, _, Bs, _ = k_pool.shape
+    B = block_tables.shape[1]
+    kernel = functools.partial(_paged_kernel, block_size=Bs,
+                               scale=1.0 / (D ** 0.5),
+                               precision=precision)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,          # block_tables, lengths
+        grid=(S, H, B),
+        in_specs=[
+            pl.BlockSpec((1, 1, D), lambda s, h, bi, tbl, lens:
+                         (s, h, 0)),
+            pl.BlockSpec((1, 1, Bs, D), lambda s, h, bi, tbl, lens:
+                         (tbl[s, bi], h, 0, 0)),
+            pl.BlockSpec((1, 1, Bs, D), lambda s, h, bi, tbl, lens:
+                         (tbl[s, bi], h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, D), lambda s, h, bi, tbl, lens:
+                               (s, h, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, 1), jnp.float32),   # running max
+            pltpu.VMEM((1, 1), jnp.float32),   # running sum
+            pltpu.VMEM((1, D), jnp.float32),   # output accumulator
+        ],
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, D), q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(block_tables, jnp.int32),
+      jnp.asarray(lengths, jnp.int32), q, k_pool, v_pool)
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, lengths,
+                    impl: str = "auto", **kw):
+    """Dispatch: ``auto`` runs the Pallas kernel on TPU (scalar-
+    prefetched block gather + VMEM-resident softmax state), fused XLA
+    elsewhere. ``pallas`` / ``xla`` force a path (parity tests run
+    pallas in interpret mode on CPU so one kernel is tested
+    everywhere)."""
+    if impl == "auto":
+        impl = "pallas" if default_platform() == "tpu" else "xla"
+    if impl == "pallas":
+        return paged_attention_pallas(q, k_pool, v_pool, block_tables,
+                                      lengths, **kw)
+    if impl == "xla":
+        return paged_attention_xla(q, k_pool, v_pool, block_tables,
+                                   lengths)
+    raise ValueError(f"unknown paged attention impl {impl!r}")

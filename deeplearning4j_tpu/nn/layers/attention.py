@@ -179,6 +179,97 @@ class SelfAttentionLayer(Layer):
         out = att.reshape(B, self.n_out) @ params["Wo"] + params["b"]
         return self.activation(out), k_cache, v_cache
 
+    # -- paged KV cache (serving/paging) --------------------------------
+    def apply_decode_paged(self, params, x, k_pool, v_pool, block_tables,
+                           pos, impl: str = "auto"):
+        """One cached decode step against the PAGED pool: write the
+        current token's K/V at ``pool[table[pos // Bs], :, pos % Bs]``,
+        attend over the prefix through the block table. Same contract
+        as :meth:`apply_decode` with the per-slot panels replaced by
+        shared pool blocks.
+
+        x: [B, C]; k_pool/v_pool: [N, H, Bs, Dh]; block_tables:
+        [B, n_blocks] int32 (NULL_BLOCK-padded); pos: [B] int32.
+        Inactive rows must carry NULL_BLOCK tables — their writes then
+        land in the reserved null block instead of live memory.
+        """
+        from ...kernels.paged_attention import paged_attention
+        B = x.shape[0]
+        H = self.n_heads
+        Dh = self.n_out // H
+        Bs = k_pool.shape[2]
+        q = (x @ params["Wq"]).reshape(B, H, Dh)
+        k_t = (x @ params["Wk"]).reshape(B, H, Dh)
+        v_t = (x @ params["Wv"]).reshape(B, H, Dh)
+        blk = jnp.take_along_axis(block_tables, (pos // Bs)[:, None],
+                                  axis=1)[:, 0]
+        off = pos % Bs
+        heads = jnp.arange(H)[None, :]
+        k_pool = k_pool.at[blk[:, None], heads, off[:, None]].set(k_t)
+        v_pool = v_pool.at[blk[:, None], heads, off[:, None]].set(v_t)
+        att = paged_attention(q, k_pool, v_pool, block_tables, pos + 1,
+                              impl=impl)
+        out = att.reshape(B, self.n_out) @ params["Wo"] + params["b"]
+        return self.activation(out), k_pool, v_pool
+
+    def apply_prefill_paged(self, params, x, k_pool, v_pool, block_table,
+                            p0, chunk_len):
+        """One prefill CHUNK against the paged pool: project the chunk,
+        scatter its K/V into the owning blocks, and attend each chunk
+        query causally over the gathered prefix (earlier chunks + this
+        one). Chunked prefill is what keeps a long prompt from
+        monopolizing the decode loop — the scheduler interleaves these
+        with decode steps (Sarathi-Serve, OSDI '24; PAPERS.md).
+
+        x: [1, C, Cin] chunk activations (C is the chunk bucket);
+        block_table: [n_blocks] int32, sized by the CALLER so that
+        ``n_blocks * Bs >= p0 + C``; p0: scalar int32 global start;
+        chunk_len: scalar int32 valid rows. Padded rows (>= chunk_len)
+        write junk K/V, harmlessly: rows inside the sequence's
+        allocation land at positions beyond its live length — masked
+        by every reader, and overwritten by the decode step's write at
+        ``pos`` before that position is ever unmasked — and rows past
+        the allocation land on NULL-padded table entries, i.e. the
+        reserved null block. An UNDERSIZED table is the one fatal
+        case: position ``p0 + C - 1`` would alias into another
+        sequence's block, which is why the size contract above is the
+        caller's to uphold.
+        Returns (out [1, C, n_out], k_pool, v_pool).
+        """
+        if not self.causal:
+            raise ValueError("cached decode needs causal=True attention")
+        C = x.shape[1]
+        H = self.n_heads
+        Dh = self.n_out // H
+        Bs = k_pool.shape[2]
+        xx = x[0]
+        q = (xx @ params["Wq"]).reshape(C, H, Dh)
+        k_t = (xx @ params["Wk"]).reshape(C, H, Dh)
+        v_t = (xx @ params["Wv"]).reshape(C, H, Dh)
+        gpos = p0 + jnp.arange(C)
+        blk = block_table[gpos // Bs]
+        off = gpos % Bs
+        heads = jnp.arange(H)[None, :]
+        k_pool = k_pool.at[blk[:, None], heads, off[:, None]].set(k_t)
+        v_pool = v_pool.at[blk[:, None], heads, off[:, None]].set(v_t)
+        # gather the sequence's whole table span and attend causally:
+        # chunk query c (global position p0+c) sees keys j <= p0+c —
+        # earlier chunks' K/V comes back out of the pool it went into
+        kk = jnp.swapaxes(k_pool[block_table], 0, 1).reshape(H, -1, Dh)
+        vv = jnp.swapaxes(v_pool[block_table], 0, 1).reshape(H, -1, Dh)
+        scale = 1.0 / jnp.sqrt(jnp.float32(Dh))
+        s = jnp.einsum("chd,htd->hct", q.astype(jnp.float32),
+                       kk.astype(jnp.float32)) * scale
+        T = kk.shape[1]
+        valid = jnp.arange(T)[None, None, :] <= gpos[None, :, None]
+        s = jnp.where(valid, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        p = jnp.where(valid, p, 0.0)
+        att = jnp.einsum("hct,htd->chd", p,
+                         vv.astype(jnp.float32)).astype(x.dtype)
+        out = att.reshape(C, self.n_out) @ params["Wo"] + params["b"]
+        return self.activation(out)[None], k_pool, v_pool
+
     def init_carry(self, batch, dtype=jnp.float32):
         return ()
 
@@ -302,6 +393,29 @@ class TransformerEncoderLayer(Layer):
         att, k_cache, v_cache = self.attn.apply_decode(
             self._attn_params(params), h, k_cache, v_cache, pos, impl)
         return self._mlp(params, x + att), k_cache, v_cache
+
+    # -- paged KV cache (serving/paging) --------------------------------
+    def apply_decode_paged(self, params, x, k_pool, v_pool, block_tables,
+                           pos, impl: str = "auto"):
+        """One cached decode step through the full block against the
+        paged pool (see :meth:`SelfAttentionLayer.apply_decode_paged`)."""
+        from ..functional import layer_norm as _ln
+        h = _ln(x, params["ln1_g"], params["ln1_b"])
+        att, k_pool, v_pool = self.attn.apply_decode_paged(
+            self._attn_params(params), h, k_pool, v_pool, block_tables,
+            pos, impl)
+        return self._mlp(params, x + att), k_pool, v_pool
+
+    def apply_prefill_paged(self, params, x, k_pool, v_pool, block_table,
+                            p0, chunk_len):
+        """One prefill chunk through the full block against the paged
+        pool (see :meth:`SelfAttentionLayer.apply_prefill_paged`)."""
+        from ..functional import layer_norm as _ln
+        h = _ln(x, params["ln1_g"], params["ln1_b"])
+        att, k_pool, v_pool = self.attn.apply_prefill_paged(
+            self._attn_params(params), h, k_pool, v_pool, block_table,
+            p0, chunk_len)
+        return self._mlp(params, x + att), k_pool, v_pool
 
     def init_carry(self, batch, dtype=jnp.float32):
         return ()

@@ -136,13 +136,15 @@ class GradientSharingAccumulator:
     checkpointable opt_state EVERY step, so mid-fit preemption
     checkpoints resume correctly).
 
-    Two modes (``mode=``):
+    Two modes (``mode=``; the reference-faithful ``"update"`` is the
+    DEFAULT, so parity with the reference pipeline is what you get
+    unless you opt into the redesign — ADVICE r5):
 
-    - ``"update"`` — the reference-faithful pipeline above: per-worker
-      updater, then sign*threshold quantization of the UPDATE. Wire
-      format parity: index + sign, magnitude fixed at the threshold
-      (`EncodingHandler.java:51`).
-    - ``"gradient"`` (default) — TPU-native redesign: quantize the
+    - ``"update"`` (default) — the reference-faithful pipeline above:
+      per-worker updater, then sign*threshold quantization of the
+      UPDATE. Wire format parity: index + sign, magnitude fixed at the
+      threshold (`EncodingHandler.java:51`).
+    - ``"gradient"`` (opt-in) — TPU-native redesign: quantize the
       GRADIENT, transmitting the TRUE value of each fired entry
       (index + value on the wire, ~2x the sign stream, still
       sparsity-bounded), pmean the decoded gradients, and run ONE
@@ -162,7 +164,7 @@ class GradientSharingAccumulator:
 
     def __init__(self, threshold: float = 1e-3, adaptive: bool = True,
                  min_sparsity: float = 1e-4, max_sparsity: float = 1e-2,
-                 adapt_factor: float = 1.2, mode: str = "gradient"):
+                 adapt_factor: float = 1.2, mode: str = "update"):
         if mode not in ("update", "gradient"):
             raise ValueError(f"mode must be 'update' or 'gradient': {mode}")
         self.initial_threshold = float(threshold)

@@ -46,9 +46,14 @@ exceeded.
 
 Generation (see :mod:`.generation`): causal LMs registered via
 ``register_generator`` decode token-by-token under iteration-level
-scheduling against a static-shape slot KV cache — requests join and
-leave the device batch every decode step, so short generations never
-wait on long ones and the compiled executables never change shape.
+scheduling against a static-shape KV cache — requests join and leave
+the device batch every decode step, so short generations never wait
+on long ones and the compiled executables never change shape. Two
+cache backends: dense per-slot panels (``cache="slots"``) or the
+paged block pool (``cache="paged"``, :mod:`.paging`) with
+all-or-nothing block admission and chunked prefill, so memory scales
+with ACTUAL sequence lengths and long prompts never stall the decode
+loop for more than one chunk.
 """
 from __future__ import annotations
 
@@ -65,13 +70,15 @@ from .engine import ClientError, InferenceEngine, ServingError, next_bucket
 from .generation import GenerationEngine
 from .kvcache import KVCache, SlotTable
 from .metrics import GenerationMetrics, ServingMetrics, profiler_sections
+from .paging import BlockAllocator, BlockTable, PagedKVCache
 from .registry import (ModelNotFound, ModelRegistry, ServedGenerator,
                        ServedModel)
 
 __all__ = [
     "InferenceServer", "InferenceEngine", "MicroBatcher", "ModelRegistry",
     "ModelNotFound", "ServedModel", "ServedGenerator", "GenerationEngine",
-    "GenerationMetrics", "KVCache", "SlotTable", "ServingMetrics",
+    "GenerationMetrics", "KVCache", "SlotTable", "PagedKVCache",
+    "BlockAllocator", "BlockTable", "ServingMetrics",
     "ClientError", "ServingError", "QueueFullError",
     "DeadlineExceededError", "next_bucket", "export_stablehlo",
 ]

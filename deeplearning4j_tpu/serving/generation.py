@@ -29,10 +29,11 @@ the queue and mid-generation.
 """
 from __future__ import annotations
 
+import collections
 import queue
 import threading
 import time
-from typing import Any, Dict, Iterator, List, Optional, Sequence
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -43,6 +44,8 @@ from .batcher import DeadlineExceededError, QueueFullError
 from .engine import ClientError, ServingError
 from .kvcache import KVCache, SlotTable
 from .metrics import GenerationMetrics
+from .paging import (NULL_BLOCK, BlockAllocator, BlockTable, PagedKVCache,
+                     blocks_for, pow2_bucket)
 
 _NEG_INF = -1e30
 
@@ -213,6 +216,30 @@ class _TokenStream:
             pass
 
 
+class _ChunkState:
+    """One request mid-prefill on the paged backend: its slot, its
+    block table, and the chunk plan with a cursor. The scheduler
+    processes ONE chunk per loop iteration, interleaved with decode
+    steps, so a long prompt's prefill never stalls the decode loop for
+    longer than one chunk (Sarathi-Serve, PAPERS.md)."""
+
+    __slots__ = ("req", "slot", "table", "tbl_bucket", "plan", "idx")
+
+    def __init__(self, req: "_GenRequest", slot: int, table: BlockTable,
+                 tbl_bucket: int, plan: List[Tuple[int, int, int]]):
+        self.req = req
+        self.slot = slot
+        self.table = table
+        self.tbl_bucket = tbl_bucket
+        self.plan = plan                  # [(p0, chunk_bucket, len)]
+        self.idx = 0
+
+    @property
+    def done_tokens(self) -> int:
+        return self.plan[self.idx - 1][0] + self.plan[self.idx - 1][2] \
+            if self.idx else 0
+
+
 # ---------------------------------------------------------------------------
 # engine
 # ---------------------------------------------------------------------------
@@ -226,6 +253,24 @@ class GenerationEngine:
     batch of every decode step); ``max_seq_len`` bounds prompt +
     generated tokens per sequence and sizes the KV cache. Both are
     STATIC — admission control handles everything dynamic.
+
+    Two cache backends (``cache=``):
+
+    - ``"slots"`` (default) — dense per-slot panels
+      ``[num_slots, H, max_seq_len, Dh]``: memory scales with the
+      WORST-CASE sequence length per slot.
+    - ``"paged"`` — a shared block pool
+      ``[num_blocks, H, block_size, Dh]`` (`serving/paging.py`): a
+      request claims ``ceil((prompt + max_tokens) / block_size)``
+      blocks at admission (all-or-nothing — when blocks run out the
+      request WAITS at the queue head instead of over-committing), so
+      at equal pool bytes the engine holds as many more concurrent
+      sequences as real lengths are shorter than ``max_seq_len``.
+      Prefill runs in CHUNKS of at most ``prefill_chunk_tokens``
+      interleaved with decode steps, so a long prompt admitted
+      mid-stream cannot stall every other request's inter-token
+      latency for more than one chunk. Token outputs are identical to
+      the slot backend (test-asserted).
     """
 
     def __init__(self, model, num_slots: int = 8,
@@ -235,6 +280,10 @@ class GenerationEngine:
                  max_queue: int = 256,
                  default_timeout_ms: float = 60_000.0,
                  decode_impl: str = "auto",
+                 cache: str = "slots",
+                 block_size: int = 16,
+                 num_blocks: Optional[int] = None,
+                 prefill_chunk_tokens: Optional[int] = None,
                  metrics: Optional[GenerationMetrics] = None):
         if getattr(model, "_params", None) is None:
             model.init()
@@ -268,14 +317,71 @@ class GenerationEngine:
                 self.prompt_buckets[-1] > self.max_seq_len:
             raise ValueError(f"prompt_buckets {self.prompt_buckets} "
                              f"outside [1, max_seq_len]")
+        if cache not in ("slots", "paged"):
+            raise ValueError(f"cache must be 'slots' or 'paged', "
+                             f"got {cache!r}")
+        self.cache_backend = cache
+        if cache == "paged":
+            self.block_size = int(block_size)
+            if not 1 <= self.block_size <= self.max_seq_len:
+                raise ValueError(f"block_size {block_size} outside "
+                                 f"[1, max_seq_len]")
+            # dense decode-table width: every position < max_seq_len
+            # has a table entry, so one decode executable serves all
+            self._blocks_per_seq = blocks_for(self.max_seq_len,
+                                              self.block_size)
+            if num_blocks is None:
+                # dense-equivalent capacity (+1 for the null block);
+                # shrink it to realize the memory win, or keep it and
+                # raise num_slots to realize the concurrency win
+                num_blocks = self.num_slots * self._blocks_per_seq + 1
+            self.num_blocks = int(num_blocks)
+            # chunk ladder: the prompt buckets capped at the chunk
+            # size; prefill_chunk_tokens=None means whole-prompt
+            # single-chunk prefill (chunking off, paging still on)
+            cap = self.prompt_buckets[-1]
+            if prefill_chunk_tokens is not None:
+                if int(prefill_chunk_tokens) < 1:
+                    raise ValueError("prefill_chunk_tokens must be >= 1")
+                cap = min(pow2_bucket(int(prefill_chunk_tokens)), cap)
+            self.prefill_chunk_tokens = (
+                cap if prefill_chunk_tokens is not None else None)
+            self._chunk_cap = cap
+            self.chunk_buckets = sorted(
+                set(b for b in self.prompt_buckets if b < cap) | {cap})
+            # largest per-request table bucket: the last chunk's
+            # bucket can overshoot the allocation by < chunk_cap
+            self._tbl_top = pow2_bucket(
+                blocks_for(self.max_seq_len + cap, self.block_size))
+            self._tbl_buckets = []
+            b = 1
+            while b <= self._tbl_top:
+                self._tbl_buckets.append(b)
+                b <<= 1
+            self._allocator = BlockAllocator(self.num_blocks)
+            self._tables = np.full(
+                (self.num_slots, self._blocks_per_seq), NULL_BLOCK,
+                np.int32)
+            self._slot_blocks: List[Optional[BlockTable]] = \
+                [None] * self.num_slots
+            self._prefilling: "collections.deque[_ChunkState]" = \
+                collections.deque()
+            self._held: Optional[_GenRequest] = None
+        else:
+            self.prefill_chunk_tokens = None
         self.metrics = metrics or GenerationMetrics()
         self.metrics.queue_max = int(max_queue)
         self.metrics.num_slots = self.num_slots
+        self.metrics.cache_backend = self.cache_backend
         self._cache = self._fresh_cache()
         self.metrics.cache_bytes = self._cache.nbytes()
         self._kcs = self._cache.ks
         self._vcs = self._cache.vs
         self._slots = SlotTable(self.num_slots)
+        if self.cache_backend == "paged":
+            self.metrics.block_size = self.block_size
+            self.metrics.blocks_total = self._allocator.capacity
+            self._update_block_gauges()
         self._profiler = OpProfiler.get_instance()
         # exactly two executable kinds: decode (one) + prefill (per
         # prompt bucket). Compiled lazily or via warmup(); the dict is
@@ -297,18 +403,44 @@ class GenerationEngine:
                                         name="generation-scheduler")
         self._thread.start()
 
-    def _fresh_cache(self) -> KVCache:
+    def _fresh_cache(self):
         """Cache sized to the ENGINE's max_seq_len (which may be below
         the model's position table) — decode attention scans the full
         cache capacity every step, so capacity must match the
-        configured bound, not the architectural one."""
+        configured bound, not the architectural one. Paged: the pool's
+        per-block layer shapes come from the same model surface."""
+        if self.cache_backend == "paged":
+            return PagedKVCache(self.model.cache_shapes(self.block_size),
+                                self.num_blocks)
         return KVCache(self.model.cache_shapes(self.max_seq_len),
                        self.num_slots)
+
+    def _update_block_gauges(self):
+        """Push allocator + liveness gauges into the metrics object
+        (snapshot() reads them lock-free from the stats thread)."""
+        a = self._allocator
+        self.metrics.blocks_free = a.free_count
+        self.metrics.blocks_peak_used = a.peak_used
+        st = self._slots
+        live = int(sum(int(st.pos[s]) + 1 for s in range(self.num_slots)
+                       if st.requests[s] is not None and st.step[s] > 0))
+        live += sum(c.done_tokens for c in self._prefilling)
+        self.metrics.kv_tokens_live = live
+        self.metrics.kv_tokens_allocated = a.used_count * self.block_size
 
     # -- executables ---------------------------------------------------
     def _decode_fn(self):
         model = self.model
         impl = self.decode_impl
+
+        if self.cache_backend == "paged":
+            def step(params, kcs, vcs, tokens, pos, tables, seeds,
+                     steps, temps, top_ks):
+                logits, kcs, vcs = model.forward_decode_paged(
+                    params, tokens, pos, kcs, vcs, tables, impl)
+                nxt = _sample_batch(logits, temps, top_ks, seeds, steps)
+                return nxt, kcs, vcs
+            return step
 
         def step(params, kcs, vcs, tokens, pos, seeds, steps, temps,
                  top_ks):
@@ -317,6 +449,22 @@ class GenerationEngine:
             nxt = _sample_batch(logits, temps, top_ks, seeds, steps)
             return nxt, kcs, vcs
         return step
+
+    def _chunk_fn(self):
+        model = self.model
+
+        def chunk(params, kcs, vcs, tokens, p0, chunk_len, table, seed,
+                  temp, top_k):
+            logits, kcs, vcs = model.forward_prefill_chunk(
+                params, tokens, p0, chunk_len, kcs, vcs, table)
+            last = jax.lax.dynamic_index_in_dim(
+                logits, chunk_len - 1, axis=0, keepdims=False)
+            # same step-0 fold as the slot prefill — the first token's
+            # sample is bit-identical across backends
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), 0)
+            first = _sample_one(last, temp, top_k, key)
+            return first, kcs, vcs
+        return chunk
 
     def _prefill_fn(self):
         model = self.model
@@ -349,16 +497,49 @@ class GenerationEngine:
             if self._decode_exe is not None:
                 return self._decode_exe
             S = self.num_slots
-            args = (self.model._params, self._kcs, self._vcs,
-                    np.zeros(S, np.int32), np.zeros(S, np.int32),
-                    np.zeros(S, np.uint32), np.zeros(S, np.int32),
-                    np.zeros(S, np.float32), np.zeros(S, np.int32))
+            if self.cache_backend == "paged":
+                args = (self.model._params, self._kcs, self._vcs,
+                        np.zeros(S, np.int32), np.zeros(S, np.int32),
+                        np.full((S, self._blocks_per_seq), NULL_BLOCK,
+                                np.int32),
+                        np.zeros(S, np.uint32), np.zeros(S, np.int32),
+                        np.zeros(S, np.float32), np.zeros(S, np.int32))
+            else:
+                args = (self.model._params, self._kcs, self._vcs,
+                        np.zeros(S, np.int32), np.zeros(S, np.int32),
+                        np.zeros(S, np.uint32), np.zeros(S, np.int32),
+                        np.zeros(S, np.float32), np.zeros(S, np.int32))
             with self._profiler.record("generation.compile"):
                 exe = jax.jit(
                     self._decode_fn(),
                     donate_argnums=self._donate).lower(*args).compile()
             self.metrics.inc("compiles")
             self._decode_exe = exe
+            return exe
+
+    def _get_chunk_exe(self, chunk_bucket: int, tbl_bucket: int):
+        """Paged prefill executable for one (chunk bucket, table
+        bucket) pair — the bounded grid replacing the slot backend's
+        per-prompt-bucket prefill set."""
+        key = (chunk_bucket, tbl_bucket)
+        exe = self._prefill_exe.get(key)
+        if exe is not None:
+            return exe
+        with self._exe_lock:
+            exe = self._prefill_exe.get(key)
+            if exe is not None:
+                return exe
+            args = (self.model._params, self._kcs, self._vcs,
+                    np.zeros((1, chunk_bucket), np.int32), np.int32(0),
+                    np.int32(1),
+                    np.full(tbl_bucket, NULL_BLOCK, np.int32),
+                    np.uint32(0), np.float32(0.0), np.int32(0))
+            with self._profiler.record("generation.compile"):
+                exe = jax.jit(
+                    self._chunk_fn(),
+                    donate_argnums=self._donate).lower(*args).compile()
+            self.metrics.inc("compiles")
+            self._prefill_exe[key] = exe
             return exe
 
     def _get_prefill_exe(self, bucket: int):
@@ -382,18 +563,33 @@ class GenerationEngine:
             return exe
 
     def warmup(self, buckets: Optional[Sequence[int]] = None) -> List[int]:
-        """AOT-compile the decode executable plus prefill at every
-        prompt bucket (default: all of ``prompt_buckets``), so traffic
-        never compiles. Returns the warmed bucket list."""
+        """AOT-compile the decode executable plus every prefill
+        executable, so traffic never compiles. Slots: one prefill per
+        prompt bucket (default: all of ``prompt_buckets``). Paged: one
+        per (chunk bucket, table bucket) pair — only pairs where the
+        table can actually hold the chunk (``tbl * block_size >=
+        chunk``) exist in traffic, so only those are compiled.
+        Returns the warmed (chunk-)bucket list."""
         self._get_decode_exe()
         warmed = []
-        for b in sorted(set(int(x) for x in (buckets
-                                             or self.prompt_buckets))):
-            if b not in self.prompt_buckets:
-                raise ValueError(f"bucket {b} not in prompt_buckets "
-                                 f"{self.prompt_buckets}")
-            self._get_prefill_exe(b)
-            warmed.append(b)
+        if self.cache_backend == "paged":
+            for c in sorted(set(int(x) for x in (buckets
+                                                 or self.chunk_buckets))):
+                if c not in self.chunk_buckets:
+                    raise ValueError(f"bucket {c} not in chunk_buckets "
+                                     f"{self.chunk_buckets}")
+                for t in self._tbl_buckets:
+                    if t * self.block_size >= c:
+                        self._get_chunk_exe(c, t)
+                warmed.append(c)
+        else:
+            for b in sorted(set(int(x) for x in (buckets
+                                                 or self.prompt_buckets))):
+                if b not in self.prompt_buckets:
+                    raise ValueError(f"bucket {b} not in prompt_buckets "
+                                     f"{self.prompt_buckets}")
+                self._get_prefill_exe(b)
+                warmed.append(b)
         self.metrics.warmed_buckets = sorted(
             set(self.metrics.warmed_buckets) | set(warmed))
         return warmed
@@ -445,6 +641,13 @@ class GenerationEngine:
                 "unfiltered sampling")
         # the cache slot is the hard budget: prompt + generation fit it
         max_tokens = min(max_tokens, self.max_seq_len - len(prompt))
+        if self.cache_backend == "paged":
+            need = blocks_for(len(prompt) + max_tokens, self.block_size)
+            if need > self._allocator.capacity:
+                raise ClientError(
+                    f"request needs {need} KV blocks but the pool has "
+                    f"{self._allocator.capacity}; lower max_tokens or "
+                    "grow num_blocks")
         if eos_id is None:
             eos_id = getattr(self.model, "eos_id", None)
         timeout = (self.default_timeout_ms if timeout_ms is None
@@ -549,10 +752,24 @@ class GenerationEngine:
         if req.stream_q is not None:
             req.stream_q.put(("token", token))
 
+    def _release_slot(self, slot: int):
+        """Free a slot AND (paged) its blocks + decode-table row. No
+        zeroing either way: the next occupant's writes overwrite what
+        it uses and lengths mask the rest (`serving/paging.py`
+        invariants)."""
+        self._slots.free(slot)
+        if self.cache_backend == "paged":
+            table = self._slot_blocks[slot]
+            if table is not None:
+                self._allocator.free(table.blocks)
+                self._slot_blocks[slot] = None
+            self._tables[slot] = NULL_BLOCK
+            self._update_block_gauges()
+        self.metrics.active_slots = self._slots.active_count
+
     def _finish(self, slot: int, req: _GenRequest, reason: str):
         req.finish_reason = reason
-        self._slots.free(slot)
-        self.metrics.active_slots = self._slots.active_count
+        self._release_slot(slot)
         if req.stream_q is not None:
             req.stream_q.put(("done", reason))
         req.event.set()
@@ -564,8 +781,7 @@ class GenerationEngine:
         if req.abandoned:
             # the waiter gave up (and counted its own timeout): free
             # the slot now instead of decoding tokens nobody will read
-            self._slots.free(slot)
-            self.metrics.active_slots = self._slots.active_count
+            self._release_slot(slot)
             return True
         if req.eos_id is not None and token == req.eos_id:
             self._finish(slot, req, "eos")
@@ -574,8 +790,7 @@ class GenerationEngine:
             self._finish(slot, req, "length")
             return True
         if (time.perf_counter() if now is None else now) > req.deadline:
-            self._slots.free(slot)
-            self.metrics.active_slots = self._slots.active_count
+            self._release_slot(slot)
             self._fail(req, DeadlineExceededError(
                 "deadline exceeded mid-generation "
                 f"({len(req.tokens)} tokens emitted)"))
@@ -586,6 +801,8 @@ class GenerationEngine:
         """Fill free slots from the queue. Blocks briefly only when the
         engine is fully idle — with active slots the decode loop must
         keep stepping, so admission is non-blocking."""
+        if self.cache_backend == "paged":
+            return self._admit_paged()
         while self._running and self._slots.free_count:
             try:
                 if self._slots.active_count:
@@ -606,6 +823,148 @@ class GenerationEngine:
             except Exception as e:  # noqa: BLE001 — fail one request
                 self._fail(req, e)
 
+    def _chunk_plan(self, prompt_len: int) -> List[Tuple[int, int, int]]:
+        """Split a prompt into (start, chunk bucket, valid length)
+        pieces: full ``_chunk_cap`` chunks, then the remainder routed
+        to the smallest configured chunk bucket that holds it."""
+        plan = []
+        p0 = 0
+        while p0 < prompt_len:
+            rem = prompt_len - p0
+            if rem >= self._chunk_cap:
+                bucket = clen = self._chunk_cap
+            else:
+                bucket = next(c for c in self.chunk_buckets if c >= rem)
+                clen = rem
+            plan.append((p0, bucket, clen))
+            p0 += clen
+        return plan
+
+    def _admit_paged(self):
+        """Paged admission: claim a slot AND the request's full
+        worst-case block count, all-or-nothing. When blocks run out
+        the request is HELD at the queue head (FIFO — admitting later
+        arrivals first would starve it) until retirements free blocks;
+        the engine never admits work it could fail to finish.
+        Admission only STARTS the prefill — chunks run interleaved
+        with decode steps in the scheduler loop."""
+        while self._running and self._slots.free_count:
+            if self._held is not None:
+                req, self._held = self._held, None
+            else:
+                try:
+                    if self._slots.active_count or self._prefilling:
+                        req = self._queue.get_nowait()
+                    else:
+                        req = self._queue.get(timeout=0.05)
+                except queue.Empty:
+                    return
+                self.metrics.queue_depth = self._queue.qsize()
+            if req.abandoned:
+                continue
+            if time.perf_counter() > req.deadline:
+                self._fail(req, DeadlineExceededError(
+                    "expired in the generation queue"))
+                continue
+            L = len(req.prompt)
+            plan = self._chunk_plan(L)
+            need = blocks_for(L + req.max_tokens, self.block_size)
+            blocks = self._allocator.alloc(need)
+            if blocks is None:
+                self._held = req
+                return
+            table = BlockTable(blocks, self.block_size)
+            # the table bucket must also cover the LAST chunk's padded
+            # tail. Its junk writes stay harmless two ways: rows inside
+            # the allocation hit positions beyond the live length of
+            # THIS request's own blocks (masked until decode overwrites
+            # them at pos before ever unmasking), and rows past the
+            # allocation hit padded NULL entries -> the null block.
+            # Either way, never another request's blocks — which is
+            # exactly what an undersized table would break.
+            span = max(L + req.max_tokens, plan[-1][0] + plan[-1][1])
+            tbl_bucket = pow2_bucket(
+                blocks_for(span, self.block_size), cap=self._tbl_top)
+            slot = self._slots.alloc(req)
+            assert slot is not None  # guarded by free_count
+            self._slot_blocks[slot] = table
+            self._prefilling.append(
+                _ChunkState(req, slot, table, tbl_bucket, plan))
+            self.metrics.active_slots = self._slots.active_count
+            self._update_block_gauges()
+
+    def _prefill_chunk_step(self):
+        """Run ONE prefill chunk for the oldest mid-prefill request —
+        the scheduler interleaves these with decode steps, so the
+        decode loop's stall per iteration is bounded by one chunk's
+        compute regardless of prompt length."""
+        st = self._prefilling[0]
+        req = st.req
+        if req.abandoned:
+            self._prefilling.popleft()
+            self._release_slot(st.slot)
+            return
+        if time.perf_counter() > req.deadline:
+            self._prefilling.popleft()
+            self._release_slot(st.slot)
+            self._fail(req, DeadlineExceededError(
+                "deadline exceeded during chunked prefill "
+                f"({st.done_tokens}/{len(req.prompt)} prompt tokens)"))
+            return
+        p0, bucket, clen = st.plan[st.idx]
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :clen] = req.prompt[p0:p0 + clen]
+        table = st.table.padded(st.tbl_bucket)
+        t0 = time.perf_counter()
+        try:
+            exe = self._get_chunk_exe(bucket, st.tbl_bucket)
+        except Exception as e:  # noqa: BLE001 — compile failed BEFORE
+            # any donation: only this request is affected
+            self._prefilling.popleft()
+            self._release_slot(st.slot)
+            self._fail(req, e)
+            return
+        try:
+            with self._profiler.record("generation.prefill"):
+                first, self._kcs, self._vcs = exe(
+                    self.model._params, self._kcs, self._vcs, tokens,
+                    np.int32(p0), np.int32(clen), table,
+                    np.uint32(req.seed), np.float32(req.temperature),
+                    np.int32(req.top_k))
+                first = int(np.asarray(first))  # device sync
+        except Exception as e:  # noqa: BLE001 — the call died with the
+            # pools donated: every in-flight sequence lost its prefix
+            self._prefilling.popleft()
+            self._release_slot(st.slot)
+            self._fail(req, e)
+            self._poison(repr(e))
+            return
+        self.metrics.prefill_ms.record((time.perf_counter() - t0) * 1e3)
+        self.metrics.inc("prefill_chunks")
+        self.metrics.prompt_bucket_hist.record(bucket)
+        st.idx += 1
+        if st.idx < len(st.plan):
+            return
+        # final chunk: the request becomes a decode lane. Its sampled
+        # token is generated token #1 (TTFT stops here).
+        self._prefilling.popleft()
+        self.metrics.inc("prefills")
+        if len(st.plan) > 1:
+            self.metrics.inc("chunked_prefills")
+        L = len(req.prompt)
+        slots = self._slots
+        slots.token[st.slot] = first
+        slots.pos[st.slot] = L
+        slots.step[st.slot] = 1
+        slots.seed[st.slot] = req.seed
+        slots.temp[st.slot] = req.temperature
+        slots.top_k[st.slot] = req.top_k
+        self._tables[st.slot] = st.table.padded(self._blocks_per_seq)
+        self._update_block_gauges()
+        self.metrics.tokens.record(1)
+        self._emit(req, first, time.perf_counter())
+        self._check_done(st.slot, req, first)
+
     def _poison(self, why: str):
         """A device call failed after the caches were donated to it:
         every in-flight sequence lost its prefix. Fail them all loudly
@@ -617,6 +976,14 @@ class GenerationEngine:
             self._fail(req, ServingError(f"generation step failed: "
                                          f"{why}"))
         self.metrics.active_slots = 0
+        if self.cache_backend == "paged":
+            # mid-prefill requests hold slots too, so they were failed
+            # above; reset the block bookkeeping wholesale
+            self._prefilling.clear()
+            self._allocator = BlockAllocator(self.num_blocks)
+            self._tables[:] = NULL_BLOCK
+            self._slot_blocks = [None] * self.num_slots
+            self._update_block_gauges()
         self._cache = self._fresh_cache()
         self._kcs = self._cache.ks
         self._vcs = self._cache.vs
@@ -637,8 +1004,7 @@ class GenerationEngine:
         except Exception:
             # compile failed BEFORE any donation: only this request is
             # affected — free its slot and let the caller fail it
-            self._slots.free(slot)
-            self.metrics.active_slots = self._slots.active_count
+            self._release_slot(slot)
             raise
         try:
             with self._profiler.record("generation.prefill"):
@@ -668,15 +1034,32 @@ class GenerationEngine:
         self._emit(req, first, time.perf_counter())
         self._check_done(slot, req, first)
 
+    def _ready_slots(self) -> List[int]:
+        """Slots in the DECODE phase. On the paged backend a slot is
+        claimed at admission but only decode-ready after its final
+        prefill chunk (step > 0); mid-prefill slots ride the decode
+        batch as masked lanes (NULL tables — their writes land in the
+        null block) and their sampled junk is never read."""
+        st = self._slots
+        return [s for s in range(self.num_slots)
+                if st.requests[s] is not None and st.step[s] > 0]
+
     def _decode_step(self):
         st = self._slots
-        active = st.active_slots()
+        active = self._ready_slots()
         t0 = time.perf_counter()
         with self._profiler.record("generation.decode_step"):
-            nxt, self._kcs, self._vcs = self._get_decode_exe()(
-                self.model._params, self._kcs, self._vcs,
-                st.token.copy(), st.pos.copy(), st.seed.copy(),
-                st.step.copy(), st.temp.copy(), st.top_k.copy())
+            if self.cache_backend == "paged":
+                nxt, self._kcs, self._vcs = self._get_decode_exe()(
+                    self.model._params, self._kcs, self._vcs,
+                    st.token.copy(), st.pos.copy(), self._tables.copy(),
+                    st.seed.copy(), st.step.copy(), st.temp.copy(),
+                    st.top_k.copy())
+            else:
+                nxt, self._kcs, self._vcs = self._get_decode_exe()(
+                    self.model._params, self._kcs, self._vcs,
+                    st.token.copy(), st.pos.copy(), st.seed.copy(),
+                    st.step.copy(), st.temp.copy(), st.top_k.copy())
             nxt = np.asarray(nxt)  # device sync: the step really ran
         now = time.perf_counter()
         self.metrics.decode_step_ms.record((now - t0) * 1e3)
@@ -695,12 +1078,17 @@ class GenerationEngine:
             self._check_done(slot, req, token, now)
         if itl:
             self.metrics.itl_ms.record_many(itl)
+        if self.cache_backend == "paged":
+            self._update_block_gauges()
 
     def _loop(self):
+        paged = self.cache_backend == "paged"
         while self._running:
             try:
                 self._admit()
-                if self._slots.active_count:
+                if paged and self._prefilling:
+                    self._prefill_chunk_step()
+                if self._ready_slots():
                     self._decode_step()
             except Exception as e:  # noqa: BLE001 — a device-level
                 # failure must fail the in-flight work, not wedge the
@@ -716,6 +1104,13 @@ class GenerationEngine:
                 break
             self._fail(req, ServingError("generation engine stopped"),
                        count=False)
+        if paged:
+            self._prefilling.clear()  # their slots drain just below
+            if self._held is not None:
+                self._fail(self._held,
+                           ServingError("generation engine stopped"),
+                           count=False)
+                self._held = None
         for slot in self._slots.active_slots():
             req = self._slots.requests[slot]
             self._slots.free(slot)

@@ -14,6 +14,22 @@ Host-side bookkeeping (which slot belongs to which request, each
 slot's write position, sampling params) lives in :class:`SlotTable` as
 small numpy arrays that ship to the device once per decode step — the
 device never sees request identity, only the dense slot batch.
+
+**The no-zeroing-on-reuse invariant** (test-asserted in
+``tests/test_paged_generation.py::TestNoZeroingInvariant``): a freed
+slot is handed to its next occupant with the previous occupant's K/V
+intact. Correctness rests entirely on the attention LENGTH mask — the
+decode kernels (`kernels/decode_attention.py`,
+`kernels/paged_attention.py`) mask every key position ``>= length``,
+so the stale tail beyond the new occupant's ``seq_len`` is
+mathematically invisible, and prefill overwrites exactly the prefix
+the new occupant will unmask. Nothing in the engine may ever rely on
+cache contents beyond the live length, and no code path zeroes on
+free/alloc (a zeroing pass would cost a full cache write per
+admission for no semantic gain). The SAME contract carries to the
+paged backend one granularity finer: a recycled BLOCK keeps its stale
+contents, masked by the owning sequence's length
+(`serving/paging.py`).
 """
 from __future__ import annotations
 

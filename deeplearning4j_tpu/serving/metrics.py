@@ -110,6 +110,17 @@ class GenerationMetrics:
         self.active_slots = 0      # gauge
         self.num_slots = 0
         self.cache_bytes = 0
+        # paged-cache gauges/counters (serving/paging.py; all zero
+        # when the engine runs the dense slot backend)
+        self.cache_backend = "slots"
+        self.block_size = 0
+        self.blocks_total = 0          # allocatable blocks (excl. null)
+        self.blocks_free = 0           # gauge
+        self.blocks_peak_used = 0      # high-water mark
+        self.prefill_chunks = 0        # chunk device calls
+        self.chunked_prefills = 0      # prompts that spanned >1 chunk
+        self.kv_tokens_live = 0        # written positions, live seqs
+        self.kv_tokens_allocated = 0   # blocks_used * block_size
         # compile cache: decode + one prefill executable per bucket
         self.compiles = 0
         self.warmed_buckets: List[int] = []
@@ -122,7 +133,33 @@ class GenerationMetrics:
     def snapshot(self) -> Dict:
         occ = self.occupancy_hist
         steps = occ.total()
+        paged = None
+        if self.cache_backend == "paged":
+            used = self.blocks_total - self.blocks_free
+            alloc = self.kv_tokens_allocated
+            paged = {
+                "block_size": self.block_size,
+                "blocks_total": self.blocks_total,
+                "blocks_free": self.blocks_free,
+                "blocks_used": used,
+                "blocks_peak_used": self.blocks_peak_used,
+                "utilization": round(used / self.blocks_total, 4)
+                if self.blocks_total else 0.0,
+                # internal fragmentation: the share of ALLOCATED token
+                # capacity not (yet) holding live K/V — bounded by
+                # block_size-1 tokens per sequence, vs up to
+                # max_seq_len-1 per slot on the dense backend
+                "fragmentation": round(
+                    1.0 - self.kv_tokens_live / alloc, 4)
+                if alloc else 0.0,
+                "kv_tokens_live": self.kv_tokens_live,
+                "kv_tokens_allocated": alloc,
+                "prefill_chunks": self.prefill_chunks,
+                "chunked_prefills": self.chunked_prefills,
+            }
         return {
+            "cache_backend": self.cache_backend,
+            "paged": paged,
             "requests": self.requests,
             "responses": self.responses,
             "client_errors": self.client_errors,

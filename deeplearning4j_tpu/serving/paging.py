@@ -1,0 +1,185 @@
+"""Paged KV-cache memory manager: block-granular allocation for the
+generation runtime (vLLM/PagedAttention, SOSP '23 — PAPERS.md).
+
+The slot cache (:mod:`.kvcache`) preallocates ``max_seq_len`` tokens of
+K/V per slot, so memory scales with the WORST-CASE sequence length:
+a slot serving an 8-token completion pins the same bytes as one serving
+a 500-token one. Here the unit of allocation is a BLOCK of
+``block_size`` token positions inside one shared pool per layer::
+
+    K, V : [num_blocks, n_heads, block_size, head_dim]
+
+A sequence owns ceil((prompt + max_tokens) / block_size) blocks — its
+ACTUAL worst case, not the engine's — and a block table maps its
+logical positions to pool blocks. The pool arrays never change shape,
+so the compiled decode executable never changes either; "which block
+belongs to whom" is host-side bookkeeping, exactly like the slot
+table's "which slot belongs to whom", one granularity finer.
+
+Invariants, shared with the slot cache and test-asserted:
+
+- **No zeroing on reuse.** A freed block re-enters the free list with
+  its stale K/V intact; the next owner's writes overwrite the prefix
+  it uses and the per-sequence length masks everything beyond. There
+  is never a zeroing pass between occupants.
+- **Block 0 is the null block.** It is never allocated to a request.
+  Padded block-table entries point at it, so (a) gathers through
+  padding read garbage that the length mask discards, and (b) writes
+  from padded lanes (inactive decode slots, the padded tail of a
+  prefill chunk past a request's allocation) land in memory nobody
+  ever unmasks.
+- **No over-commit.** :meth:`BlockAllocator.alloc` is all-or-nothing:
+  a request's full worst-case block count is claimed at admission or
+  the request stays queued — the engine never admits work it could be
+  unable to finish (the alternative, swapping/preemption, trades that
+  guarantee for recompute; see docs/generation.md).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+#: Block index reserved as the write/read target for padded table
+#: entries. Never handed out by the allocator.
+NULL_BLOCK = 0
+
+
+def blocks_for(tokens: int, block_size: int) -> int:
+    """Blocks needed to hold ``tokens`` positions."""
+    return -(-int(tokens) // int(block_size))
+
+
+def pow2_bucket(n: int, cap: Optional[int] = None) -> int:
+    """Smallest power of two >= n (>= 1), optionally clamped to
+    ``cap`` — the block-table padding rule that keeps the set of
+    prefill executables finite and AOT-warmable. Delegates to the
+    serving engine's :func:`~.engine.next_bucket` so the paged and
+    dense bucket policies can never silently diverge."""
+    from .engine import next_bucket
+    return next_bucket(max(int(n), 1), 1,
+                       (1 << 30) if cap is None else cap)
+
+
+class BlockAllocator:
+    """Free-list allocator over the pool's block indices.
+
+    Block 0 (:data:`NULL_BLOCK`) is reserved; ``capacity`` counts only
+    allocatable blocks. Allocation is all-or-nothing and LIFO, so a
+    just-freed (cache-warm) block is reused first — same policy as the
+    slot table's free list."""
+
+    def __init__(self, num_blocks: int):
+        self.num_blocks = int(num_blocks)
+        if self.num_blocks < 2:
+            raise ValueError("num_blocks must be >= 2 (block 0 is the "
+                             f"reserved null block), got {num_blocks}")
+        self._free = list(range(self.num_blocks - 1, NULL_BLOCK, -1))
+        # mirror of _free for O(1) double-free checks: free() runs on
+        # the scheduler thread at every retirement, and a linear scan
+        # of the free list there would tax every stream's ITL
+        self._free_set = set(self._free)
+        self.peak_used = 0
+
+    @property
+    def capacity(self) -> int:
+        return self.num_blocks - 1
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return self.capacity - len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Claim ``n`` blocks, or None (claim NOTHING) if fewer than
+        ``n`` are free — the no-over-commit contract."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} blocks")
+        if n > len(self._free):
+            return None
+        blocks = [self._free.pop() for _ in range(n)]
+        self._free_set.difference_update(blocks)
+        self.peak_used = max(self.peak_used, self.used_count)
+        return blocks
+
+    def free(self, blocks: Sequence[int]):
+        """Return blocks to the free list. No zeroing — stale contents
+        stay masked by the next owner's length."""
+        for b in blocks:
+            b = int(b)
+            if b == NULL_BLOCK or not 0 < b < self.num_blocks:
+                raise ValueError(f"block {b} is not allocatable")
+            if b in self._free_set:
+                raise ValueError(f"double free of block {b}")
+        self._free.extend(int(b) for b in blocks)
+        self._free_set.update(int(b) for b in blocks)
+
+    def stats(self) -> dict:
+        return {"total": self.capacity, "free": self.free_count,
+                "used": self.used_count, "peak_used": self.peak_used}
+
+
+class BlockTable:
+    """One request's logical-position → pool-block mapping (host-side
+    int32). ``padded(n)`` emits the device-facing row, padded with
+    :data:`NULL_BLOCK` to a caller-chosen length (a pow2 bucket for
+    prefill executables; the engine-wide max for the decode batch), so
+    executable shapes depend on the BUCKET, never the request."""
+
+    def __init__(self, blocks: Sequence[int], block_size: int):
+        self.blocks = [int(b) for b in blocks]
+        self.block_size = int(block_size)
+
+    def __len__(self) -> int:
+        return len(self.blocks)
+
+    @property
+    def capacity_tokens(self) -> int:
+        return len(self.blocks) * self.block_size
+
+    def padded(self, n: int) -> np.ndarray:
+        if n < len(self.blocks):
+            raise ValueError(f"cannot pad {len(self.blocks)} blocks "
+                             f"into a table of {n}")
+        out = np.full(n, NULL_BLOCK, np.int32)
+        out[:len(self.blocks)] = self.blocks
+        return out
+
+
+class PagedKVCache:
+    """Per-layer pooled K/V blocks, the paged sibling of
+    :class:`~.kvcache.KVCache`: same pytree-threaded-through-donated-
+    executables lifecycle, but the leading axis is POOL BLOCKS shared
+    by every sequence instead of per-sequence slots.
+
+    ``layer_shapes`` are per-layer ``(n_heads, block_size, head_dim)``
+    — i.e. ``model.cache_shapes(block_size)``."""
+
+    def __init__(self, layer_shapes: Sequence[Tuple[int, int, int]],
+                 num_blocks: int, dtype=jnp.float32):
+        self.num_blocks = int(num_blocks)
+        self.layer_shapes = [tuple(s) for s in layer_shapes]
+        self.block_size = int(self.layer_shapes[0][1])
+        self.dtype = dtype
+        self.ks: List[jnp.ndarray] = [
+            jnp.zeros((self.num_blocks,) + s, dtype)
+            for s in self.layer_shapes]
+        self.vs: List[jnp.ndarray] = [
+            jnp.zeros((self.num_blocks,) + s, dtype)
+            for s in self.layer_shapes]
+
+    def nbytes(self) -> int:
+        """Device bytes the pool pins: ``num_blocks * block_size * H *
+        Dh * 2 (K+V) * layers * itemsize`` — the number to budget
+        against HBM (docs/generation.md has the sizing guidance)."""
+        return int(sum(2 * int(np.prod((self.num_blocks,) + s))
+                       * jnp.dtype(self.dtype).itemsize
+                       for s in self.layer_shapes))
+
+    def block_nbytes(self) -> int:
+        """Bytes one block pins across all layers (K+V)."""
+        return self.nbytes() // self.num_blocks

@@ -85,8 +85,10 @@ class ServedGenerator:
                  num_slots: int = 8, max_queue: int = 256,
                  default_timeout_ms: float = 60_000.0, **engine_opts):
         # remaining GenerationEngine tuning (max_seq_len,
-        # prompt_buckets, min_prompt_bucket, decode_impl, ...) passes
-        # through verbatim; unknown keys fail loudly in the engine
+        # prompt_buckets, min_prompt_bucket, decode_impl, cache=
+        # "slots"|"paged", block_size, num_blocks,
+        # prefill_chunk_tokens, ...) passes through verbatim; unknown
+        # keys fail loudly in the engine
         self.name = name
         self.version = int(version)
         self.model = model

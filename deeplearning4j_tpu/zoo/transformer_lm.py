@@ -128,6 +128,61 @@ class CausalTransformerLM:
         x = layer_norm(x, params["lnf_g"], params["lnf_b"])
         return x @ params["head"], new_k, new_v
 
+    # -- paged KV cache (serving/paging) --------------------------------
+    def forward_decode_paged(self, params, tokens, pos, k_pools, v_pools,
+                             block_tables, impl: str = "auto"):
+        """One cached decode step against the PAGED pools. Same
+        contract as :meth:`forward_decode` with per-layer pools
+        [num_blocks, H, block_size, Dh] addressed through
+        ``block_tables`` [S, n_blocks] (NULL_BLOCK-padded; inactive
+        rows must be all-NULL so their writes land in the null
+        block)."""
+        x = params["tok"][tokens] + params["pos"][pos]
+        new_k, new_v = [], []
+        for blk, bp, kc, vc in zip(self.blocks, params["blocks"],
+                                   k_pools, v_pools):
+            x, kc, vc = blk.apply_decode_paged(bp, x, kc, vc,
+                                               block_tables, pos, impl)
+            new_k.append(kc)
+            new_v.append(vc)
+        x = layer_norm(x, params["lnf_g"], params["lnf_b"])
+        return x @ params["head"], new_k, new_v
+
+    def forward_prefill_chunk(self, params, tokens, p0, chunk_len,
+                              k_pools, v_pools, block_table):
+        """One prefill CHUNK against the paged pools: embed the chunk
+        at its global positions, run every block's
+        ``apply_prefill_paged`` (scatter K/V into the owning blocks,
+        attend causally over the gathered prefix), and return the
+        chunk's logits. The caller splits a prompt into chunks and
+        feeds them in order; on the final chunk it samples from row
+        ``chunk_len - 1``.
+
+        tokens: [1, C] int32 (C = chunk bucket); p0: scalar int32
+        chunk start; chunk_len: scalar int32 valid tokens in this
+        chunk; block_table: [n_blocks] int32 covering at least
+        ``p0 + C`` positions. Returns (logits [C, V], k_pools,
+        v_pools)."""
+        C = tokens.shape[1]
+        gpos = p0 + jnp.arange(C)
+        # padded tail rows can run past the position table; clamp the
+        # lookup — their embeddings are zeroed below and their K/V
+        # lands beyond the live length, where the mask keeps it dark
+        x = (params["tok"][tokens[0]]
+             + params["pos"][jnp.clip(gpos, 0, self.max_seq_len - 1)])
+        row_mask = (jnp.arange(C) < chunk_len).astype(x.dtype)
+        x = (x * row_mask[:, None])[None]
+        new_k, new_v = [], []
+        for blk, bp, kc, vc in zip(self.blocks, params["blocks"],
+                                   k_pools, v_pools):
+            x, kc, vc = blk.apply_prefill_paged(bp, x, kc, vc,
+                                                block_table, p0,
+                                                chunk_len)
+            new_k.append(kc)
+            new_v.append(vc)
+        x = layer_norm(x[0], params["lnf_g"], params["lnf_b"])
+        return x @ params["head"], new_k, new_v
+
     def logits(self, tokens) -> jnp.ndarray:
         """Convenience uncached full-sequence logits (tests/training
         harnesses; the serving path never calls this)."""

@@ -43,7 +43,11 @@ def main(quick: bool = False):
     acc_d = dense.evaluate(ArrayDataSetIterator(x, y, batch=256)).accuracy()
 
     comp = _net()
-    acc_obj = GradientSharingAccumulator(threshold=1e-3, adaptive=True)
+    # mode="gradient" opts into the TPU-native value-preserving
+    # pipeline; the default ("update") is the reference-faithful
+    # sign*threshold update-domain one
+    acc_obj = GradientSharingAccumulator(threshold=1e-3, adaptive=True,
+                                         mode="gradient")
     ParallelWrapper(comp, accumulator=acc_obj).fit(
         ArrayDataSetIterator(x, y, batch=128), epochs=epochs)
     acc_c = comp.evaluate(ArrayDataSetIterator(x, y, batch=256)).accuracy()

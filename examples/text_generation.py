@@ -67,6 +67,18 @@ def main(quick: bool = False):
                 resp.read().decode().strip().splitlines()]
     conn.close()
 
+    # -- the same LM behind the PAGED cache + chunked prefill ----------
+    # (docs/generation.md "The paged cache"): memory is allocated in
+    # blocks against each request's ACTUAL prompt + max_tokens, and a
+    # long prompt prefills in chunks interleaved with decode steps
+    gen_p = server.register_generator(
+        "lm-paged", lm, num_slots=4 if quick else 16,
+        cache="paged", block_size=16,
+        prefill_chunk_tokens=16 if quick else 64)
+    gen_p.warmup()
+    long_prompt = rs.randint(1, 128, 40 if quick else 180).tolist()
+    gen_p.generate(long_prompt, max_tokens=8, temperature=0.8, seed=1)
+
     stats = json.loads(urllib.request.urlopen(base + "/stats",
                                               timeout=30).read())
     m = stats["models"]["lm"]
@@ -75,6 +87,11 @@ def main(quick: bool = False):
           f"{m['slots']['mean_occupancy']} of {m['slots']['num_slots']} "
           f"slots; ttft p50 {m['ttft_ms']['p50']} ms, "
           f"itl p50 {m['itl_ms']['p50']} ms")
+    mp = stats["models"]["lm-paged"]["paged"]
+    print(f"paged: {mp['blocks_peak_used']}/{mp['blocks_total']} blocks "
+          f"peak ({mp['block_size']} tokens each), "
+          f"{mp['prefill_chunks']} prefill chunks "
+          f"({mp['chunked_prefills']} prompts chunked)")
     server.stop()
     n_tokens = sum(len(r["tokens"]) for r in results)
     n_streamed = sum(1 for c in streamed if "token" in c)

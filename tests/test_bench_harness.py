@@ -70,6 +70,15 @@ def test_generation_scenario_harness_runs_on_cpu():
                       bool)
     assert res["recompiles_post_warmup"] == 0
     assert res["mean_slot_occupancy"] > 1.0  # it actually batched
+    # paged backend (ISSUE 3): same workload, token-identical to the
+    # slot engine, compile-free, and the peak block footprint is the
+    # measured memory number (same shapes here, so identity is exact)
+    assert res["tokens_identical_paged_vs_slots"] is True
+    assert res["paged_recompiles_post_warmup"] == 0
+    assert res["paged_tokens_per_sec"] > 0
+    assert 0 < res["paged_peak_kv_bytes"] <= res["paged_pool_bytes"]
+    assert res["chunked_prefills"] >= 1  # the 160-token probes chunked
+    assert res["itl_p95_short_ms_longprompt_unchunked"] > 0
 
 
 def test_check_bench_regression_comparator():
@@ -96,3 +105,37 @@ def test_check_bench_regression_comparator():
     r = cbr.compare(rec, partial, 0.2)
     assert not r["regressions"]
     assert len(r["skipped"]) == 3  # the extras didn't run
+
+
+def test_check_bench_regression_new_metric_is_reported_not_crashed():
+    """ISSUE 3 satellite: a scenario present in the fresh bench but
+    absent from the recorded baseline (the just-added paged scenario,
+    until a BENCH_*.json records it) must surface as "new, skipped" —
+    neither a crash nor a silent pass that hides the unguarded
+    metric."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "cbr2", os.path.join(ROOT, "tools", "check_bench_regression.py"))
+    cbr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cbr)
+    rec = {"value": 100.0,
+           "extra": {"generation": {"tokens_per_sec": 500.0}}}
+    fresh = {"value": 100.0,
+             "extra": {"generation": {"tokens_per_sec": 500.0,
+                                      "paged_tokens_per_sec": 450.0}}}
+    r = cbr.compare(rec, fresh, 0.2)
+    assert not r["regressions"]
+    news = [e for e in r["skipped"] if e.get("note", "").startswith("new")]
+    assert [e["metric"] for e in news] == \
+        ["generation_paged_tokens_per_sec"]
+    assert news[0]["fresh"] == 450.0
+    # and the new metric IS guarded once a baseline records it
+    rec2 = {"value": 100.0,
+            "extra": {"generation": {"tokens_per_sec": 500.0,
+                                     "paged_tokens_per_sec": 450.0}}}
+    bad = {"value": 100.0,
+           "extra": {"generation": {"tokens_per_sec": 500.0,
+                                    "paged_tokens_per_sec": 300.0}}}
+    r = cbr.compare(rec2, bad, 0.2)
+    assert [e["metric"] for e in r["regressions"]] == \
+        ["generation_paged_tokens_per_sec"]

@@ -222,7 +222,7 @@ class TestUpdateDomainQuantization:
 
 
 class TestGradientDomainValueMode:
-    """mode="gradient" (the TPU-native default): value-preserving
+    """mode="gradient" (TPU-native, opt-in): value-preserving
     threshold compression of GRADIENTS + one shared updater. The measured
     contract (tools/diag_compress.py): convergence at near-exact parity
     with dense — the per-worker-updater noise and sign*threshold
@@ -231,6 +231,11 @@ class TestGradientDomainValueMode:
     def test_mode_validation(self):
         with pytest.raises(ValueError):
             GradientSharingAccumulator(mode="bogus")
+
+    def test_reference_faithful_mode_is_the_default(self):
+        """ADVICE r5: reference parity must be opt-OUT — the TPU-native
+        gradient-domain redesign only engages when asked for."""
+        assert GradientSharingAccumulator().mode == "update"
 
     def test_value_codec_preserves_fired_values(self):
         from deeplearning4j_tpu.parallel.compression import (
@@ -273,8 +278,8 @@ class TestGradientDomainValueMode:
         pw_d = ParallelWrapper(dense)
         acc = GradientSharingAccumulator(threshold=1e-3, adaptive=True,
                                          min_sparsity=1e-3,
-                                         max_sparsity=0.5)
-        assert acc.mode == "gradient"  # the default
+                                         max_sparsity=0.5,
+                                         mode="gradient")
         pw_c = ParallelWrapper(comp, accumulator=acc)
         for _ in range(12):
             pw_d.fit(ArrayDataSetIterator(x, y, batch=16, shuffle=False),
@@ -302,7 +307,8 @@ class TestGradientDomainValueMode:
         model = MultiLayerNetwork(conf).init()
         init_leaves = [np.asarray(l) for l in
                        jax.tree_util.tree_leaves(model._opt_state)]
-        acc = GradientSharingAccumulator(threshold=1e-3)
+        acc = GradientSharingAccumulator(threshold=1e-3,
+                                         mode="gradient")
         pw = ParallelWrapper(model, accumulator=acc)
         pw.fit(ArrayDataSetIterator(x, y, batch=128, shuffle=False),
                epochs=3)

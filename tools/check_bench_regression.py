@@ -35,6 +35,8 @@ METRICS = {
     ("extra", "serving", "speedup_vs_unbatched"): "serving_speedup",
     ("extra", "generation", "tokens_per_sec"): "generation_tokens_per_sec",
     ("extra", "generation", "speedup_vs_sequential"): "generation_speedup",
+    ("extra", "generation", "paged_tokens_per_sec"):
+        "generation_paged_tokens_per_sec",
     ("extra", "word2vec", "tokens_per_sec"): "word2vec_tokens_per_sec",
     ("extra", "etl_pipeline", "rows_per_sec"): "etl_rows_per_sec",
 }
@@ -99,8 +101,24 @@ def compare(recorded: dict, fresh: dict, threshold: float) -> dict:
     for path, name in METRICS.items():
         old = _dig(recorded, path)
         new = _dig(fresh, path)
-        if old is None or old <= 0:
-            continue  # never recorded — nothing to hold the line on
+        if old is None:
+            # never recorded — nothing to hold the line on. But a
+            # metric the FRESH run produces (a scenario added since
+            # the last recording, e.g. the paged-generation one) must
+            # be SAID to be unguarded, not silently passed over — the
+            # next recorded BENCH_*.json picks it up
+            if new is not None:
+                skipped.append({"metric": name, "fresh": round(new, 3),
+                                "note": "new, skipped (no recorded "
+                                        "baseline yet)"})
+            continue
+        if old <= 0:
+            # recorded, but by a degenerate run — that is a broken
+            # BASELINE, not a new metric; say which
+            skipped.append({"metric": name, "recorded": old,
+                            "note": "recorded baseline is non-positive,"
+                                    " skipped"})
+            continue
         if new is None:
             skipped.append({"metric": name, "recorded": old,
                             "note": "missing from fresh run"})

@@ -84,12 +84,14 @@ def main():
     run("update-mode fixed thr=1e-5",
         GradientSharingAccumulator(threshold=1e-5, adaptive=False,
                                    mode="update"))
-    run("gradient-mode (default): thr=1e-3 adaptive [1e-3,0.5]",
+    run("gradient-mode (opt-in): thr=1e-3 adaptive [1e-3,0.5]",
         GradientSharingAccumulator(threshold=1e-3, adaptive=True,
-                                   min_sparsity=1e-3, max_sparsity=0.5))
+                                   min_sparsity=1e-3, max_sparsity=0.5,
+                                   mode="gradient"))
     run("gradient-mode thr0=1e-2 adaptive [1e-3,0.3]",
         GradientSharingAccumulator(threshold=1e-2, adaptive=True,
-                                   min_sparsity=1e-3, max_sparsity=0.3))
+                                   min_sparsity=1e-3, max_sparsity=0.3,
+                                   mode="gradient"))
 
 
 def ablations():
