@@ -578,6 +578,25 @@ cb_dt, cb_tok, cb_out = run_all(eng, concurrent=True)
 recompiles = eng.metrics.compiles - compiles_before
 stats = eng.stats()
 dense_kv_bytes = stats["kv_cache_bytes"]
+
+# -- chaos probe (ISSUE 4): the SAME engine and workload with ~1% of
+# decode steps raising an injected transient fault, plus a scripted
+# cache-corrupting fault (two at full scale) forcing recompute-
+# recovery — every in-flight request re-prefilled from prompt +
+# emitted tokens. The gated number is recovered-tokens/sec: the
+# throughput the engine still delivers while absorbing faults.
+# Correctness bar: token-identical to the fault-free run, zero
+# requests lost, zero recompiles (recovery reuses warmed buckets).
+from deeplearning4j_tpu.serving import FaultInjector
+chaos_inj = FaultInjector(seed=0, rates={"device_step": 0.01},
+                          plan={"prefill": [5, 20]},
+                          corrupting=("prefill",))
+eng.set_fault_injector(chaos_inj)
+ch_compiles = eng.metrics.compiles
+ch_dt, ch_tok, ch_out = run_all(eng, concurrent=True)
+ch_faults = eng.stats()["faults"]
+ch_recompiles = eng.metrics.compiles - ch_compiles
+eng.set_fault_injector(None)
 eng.stop()
 
 # -- paged KV cache + chunked prefill (ISSUE 3). Same mixed-length
@@ -683,6 +702,12 @@ print(json.dumps({
     "itl_p95_short_ms_baseline": round(pct(base_gaps, 95), 2),
     "itl_p95_short_ms_longprompt_chunked": round(pct(chunk_gaps, 95), 2),
     "itl_p95_short_ms_longprompt_unchunked": round(pct(flat_gaps, 95), 2),
+    "chaos_tokens_per_sec": round(ch_tok / ch_dt, 1),
+    "chaos_tokens_identical": ch_out == cb_out,
+    "chaos_retries": ch_faults["retries"],
+    "chaos_recoveries": ch_faults["recoveries"],
+    "chaos_requests_lost": sum(1 for t in ch_out if not t),
+    "chaos_recompiles_post_warmup": ch_recompiles,
     "synthetic_data": True}))
 """
 
@@ -916,7 +941,13 @@ def main():
                                      "chunked_prefills",
                                      "itl_p95_short_ms_baseline",
                                      "itl_p95_short_ms_longprompt_chunked",
-                                     "itl_p95_short_ms_longprompt_unchunked")
+                                     "itl_p95_short_ms_longprompt_unchunked",
+                                     "chaos_tokens_per_sec",
+                                     "chaos_tokens_identical",
+                                     "chaos_retries",
+                                     "chaos_recoveries",
+                                     "chaos_requests_lost",
+                                     "chaos_recompiles_post_warmup")
                                     if k in gen}
     # static cost model (tools/perf_audit.py — chip-independent): the
     # roofline predictions the measured numbers are judged against

@@ -54,8 +54,11 @@ def decode_attention_xla(q, k, v, lengths):
     # fully-masked rows (length 0: a free slot riding the batch) would
     # softmax to uniform and read garbage V — zero them instead
     p = jnp.where(valid, p, 0.0)
-    return jnp.einsum("sht,shtd->shd", p,
-                      v.astype(jnp.float32)).astype(q.dtype)
+    # V must be masked as well: p is 0 past the live length, but
+    # 0 * NaN = NaN, and a recycled slot's stale tail may hold
+    # non-finite K/V (e.g. a quarantined poison request's leavings)
+    v = jnp.where(valid[..., None], v.astype(jnp.float32), 0.0)
+    return jnp.einsum("sht,shtd->shd", p, v).astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -85,6 +88,9 @@ def _decode_kernel(q_ref, k_ref, v_ref, vm_ref, o_ref, m_s, l_s, acc_s, *,
     # where-guard keeps fully-masked rows at p=0 (exp(-inf - -inf) = 1
     # would fabricate uniform attention for an empty slot)
     p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
+    # zero masked V rows too: p=0 there, but 0 * NaN = NaN would leak
+    # a recycled slot's non-finite stale tail into the accumulator
+    v_blk = jnp.where(mask.reshape(-1, 1), v_blk, 0.0)
     corr = jnp.exp(m_prev - m_new)
     m_s[:, 0] = m_new
     l_s[:, 0] = l_prev * corr + p.sum(axis=1)

@@ -98,6 +98,9 @@ def _paged_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     # where-guard keeps fully-masked rows at p=0 (exp(-inf - -inf) = 1
     # would fabricate uniform attention for an empty sequence)
     p = jnp.where(mask, jnp.exp(sc - m_new[:, None]), 0.0)
+    # zero masked V rows too: p=0 there, but 0 * NaN = NaN would leak
+    # a recycled block's non-finite stale tail into the accumulator
+    v_blk = jnp.where(mask.reshape(-1, 1), v_blk, 0.0)
     corr = jnp.exp(m_prev - m_new)
     m_s[:, 0] = m_new
     l_s[:, 0] = l_prev * corr + p.sum(axis=1)

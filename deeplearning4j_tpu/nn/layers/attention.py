@@ -265,8 +265,12 @@ class SelfAttentionLayer(Layer):
         s = jnp.where(valid, s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         p = jnp.where(valid, p, 0.0)
-        att = jnp.einsum("hct,htd->chd", p,
-                         vv.astype(jnp.float32)).astype(x.dtype)
+        # V past what this sequence has WRITTEN (j >= p0 + C) is a
+        # previous occupant's stale leavings and may be non-finite;
+        # p is 0 there but 0 * NaN = NaN, so mask V as well
+        written = (jnp.arange(T) < p0 + C)[None, :, None]
+        vv = jnp.where(written, vv.astype(jnp.float32), 0.0)
+        att = jnp.einsum("hct,htd->chd", p, vv).astype(x.dtype)
         out = att.reshape(C, self.n_out) @ params["Wo"] + params["b"]
         return self.activation(out)[None], k_pool, v_pool
 

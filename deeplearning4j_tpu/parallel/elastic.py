@@ -25,6 +25,18 @@ from typing import Callable, List, Optional
 from ..util.serializer import ModelSerializer
 
 
+def _pid_alive(pid: int) -> bool:
+    """Is some process with this pid running? (EPERM means yes —
+    a live process we may not signal.)"""
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True
+    return True
+
+
 class FaultTolerantTrainer:
     """Train with periodic whole-state checkpoints; resume picks up at
     the last completed checkpoint."""
@@ -43,26 +55,75 @@ class FaultTolerantTrainer:
 
     @staticmethod
     def list_checkpoints(directory: str) -> List[str]:
-        paths = glob.glob(os.path.join(directory, "checkpoint_epoch*.zip"))
-
-        def epoch_of(p):
-            m = re.search(r"checkpoint_epoch(\d+)\.zip$", p)
-            return int(m.group(1)) if m else -1
-        return sorted(paths, key=epoch_of)
+        """Completed checkpoints only, oldest -> newest. The regex is a
+        FULL filename filter, not just a sort key: temp files from an
+        interrupted _save (``*.zip.tmp.*``) and any stray file must
+        never be listed — resume() loads the last entry, and keep-last
+        pruning deletes the first ones."""
+        pat = re.compile(r"checkpoint_epoch(\d+)\.zip$")
+        paths = [p for p in
+                 glob.glob(os.path.join(directory, "checkpoint_epoch*.zip"))
+                 if pat.search(p)]
+        return sorted(paths, key=lambda p: int(pat.search(p).group(1)))
 
     def _save(self, epoch: int):
         # _saving guards signal-handler re-entry: a SIGTERM landing
-        # mid-write must not reuse the same .tmp path (see
+        # mid-write must not start a second write (see
         # PreemptionHandler._handle)
         self._saving = True
         try:
             path = self._ckpt_path(epoch)
-            tmp = path + ".tmp"
-            ModelSerializer.write_model(self.model, tmp, save_updater=True)
-            os.replace(tmp, path)  # atomic: partial writes never go live
+            # pid-unique temp name IN the checkpoint directory (rename
+            # must not cross filesystems): a crash mid-write leaves
+            # only a temp file resume() will never look at, and a
+            # restarted writer can't collide with the corpse
+            tmp = f"{path}.tmp.{os.getpid()}"
+            try:
+                ModelSerializer.write_model(self.model, tmp,
+                                            save_updater=True)
+                # flush the bytes to stable storage BEFORE the rename
+                # goes live — os.replace alone is atomic against
+                # process crashes but can surface a truncated target
+                # after a power loss reorders the metadata ahead of
+                # the data
+                with open(tmp, "rb+") as f:
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)  # atomic: partials never go live
+                # ...and make the rename itself durable: the directory
+                # entry is still only in the page cache, and for a NEW
+                # checkpoint name a power loss could lose the file
+                # entirely despite _save having returned success
+                dfd = os.open(self.dir, os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
+            except BaseException:
+                # never leave a half-written temp behind on failure
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                raise
             ckpts = self.list_checkpoints(self.dir)
-            for old in ckpts[:-self.keep_last]:
+            for old in ckpts[:-self.keep_last] if self.keep_last else []:
                 os.remove(old)
+            # sweep temp corpses from CRASHED earlier runs (ours was
+            # renamed or removed above); they'd otherwise pin disk
+            # forever since list_checkpoints rightly skips them. A temp
+            # whose embedded pid is still ALIVE is not a corpse — it's
+            # a concurrent trainer (preemption handover: the dying
+            # process's final _save overlapping our first) mid-write,
+            # and deleting it would destroy that checkpoint
+            for stale in glob.glob(os.path.join(
+                    self.dir, "checkpoint_epoch*.zip.tmp.*")):
+                pid_s = stale.rsplit(".", 1)[-1]
+                if pid_s.isdigit() and _pid_alive(int(pid_s)):
+                    continue
+                try:
+                    os.remove(stale)
+                except OSError:
+                    pass
         finally:
             self._saving = False
 

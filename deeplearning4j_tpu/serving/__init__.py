@@ -38,11 +38,26 @@ HTTP surface::
                                        newline-delimited JSON tokens)
     GET  /v1/models                    registry listing
     GET  /stats                        serving metrics per model
-    GET  /health
+    GET  /health                       legacy summary (always 200)
+    GET  /healthz                      liveness: 503 when any engine
+                                       loop is wedged (stall watchdog)
+    GET  /readyz                       readiness: 503 + Retry-After
+                                       while draining
 
 Status codes: 400 malformed request (client), 404 unknown route/model,
-500 internal failure, 503 load shed (queue full), 504 deadline
-exceeded.
+500 internal failure (incl. quarantined poison requests), 503 load
+shed (queue full) or draining — always with ``Retry-After``, 504
+deadline exceeded.
+
+Fault tolerance (:mod:`.faults`, docs/serving.md "Operating the
+server"): supervised engine loops retry transient step faults with
+bounded backoff and rebuild cache-corrupting failures by
+recompute-recovery (no accepted request is ever lost); poison requests
+(non-finite logits) are quarantined alone; ``drain()`` — wirable to
+SIGTERM via :meth:`InferenceServer.install_signal_handlers` — flips
+readiness off, finishes in-flight work, then joins the scheduler
+threads. ``faults.{retries,recoveries,quarantined,drains}`` counters
+surface per model at ``GET /stats``.
 
 Generation (see :mod:`.generation`): causal LMs registered via
 ``register_generator`` decode token-by-token under iteration-level
@@ -58,6 +73,8 @@ loop for more than one chunk.
 from __future__ import annotations
 
 import json
+import os
+import signal
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Sequence
@@ -65,8 +82,11 @@ from typing import Any, Dict, Optional, Sequence
 import jax
 import numpy as np
 
-from .batcher import DeadlineExceededError, MicroBatcher, QueueFullError
+from .batcher import (DeadlineExceededError, DrainingError, MicroBatcher,
+                      QueueFullError)
 from .engine import ClientError, InferenceEngine, ServingError, next_bucket
+from .faults import (CorruptedStateFault, FaultInjector,
+                     PoisonRequestError, TransientFault)
 from .generation import GenerationEngine
 from .kvcache import KVCache, SlotTable
 from .metrics import GenerationMetrics, ServingMetrics, profiler_sections
@@ -80,7 +100,9 @@ __all__ = [
     "GenerationMetrics", "KVCache", "SlotTable", "PagedKVCache",
     "BlockAllocator", "BlockTable", "ServingMetrics",
     "ClientError", "ServingError", "QueueFullError",
-    "DeadlineExceededError", "next_bucket", "export_stablehlo",
+    "DeadlineExceededError", "DrainingError", "FaultInjector",
+    "TransientFault", "CorruptedStateFault", "PoisonRequestError",
+    "next_bucket", "export_stablehlo",
 ]
 
 
@@ -162,6 +184,9 @@ class InferenceServer:
         self.max_body_bytes = int(max_body_bytes)
         self.registry = registry or ModelRegistry()
         self._owns_registry = registry is None
+        self._ready = True            # flips off when drain() starts
+        self._prev_handlers: Dict[int, Any] = {}
+        self._signal_drain: Optional[threading.Thread] = None
         self._opts = dict(batching=batching, max_batch_size=max_batch_size,
                           max_latency_ms=max_latency_ms,
                           max_queue=max_queue,
@@ -183,11 +208,13 @@ class InferenceServer:
             def log_message(self, *a):
                 pass
 
-            def _json(self, obj, code=200):
+            def _json(self, obj, code=200, headers=None):
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -195,6 +222,16 @@ class InferenceServer:
                 try:
                     if self.path == "/health":
                         self._json(server._health())
+                    elif self.path == "/healthz":
+                        code, body = server._healthz()
+                        self._json(body, code)
+                    elif self.path == "/readyz":
+                        if server.ready():
+                            self._json({"ready": True})
+                        else:
+                            self._json({"ready": False,
+                                        "reason": "draining"}, 503,
+                                       headers={"Retry-After": "1"})
                     elif self.path == "/stats":
                         self._json(server.stats())
                     elif self.path in ("/v1/models", "/v1/models/"):
@@ -239,6 +276,13 @@ class InferenceServer:
                     self._json({"error": "not found"}, 404)
                     return
                 name, action = route
+                if not server.ready():
+                    # draining: shed BEFORE touching the registry so
+                    # half-drained engines never see new work; clients
+                    # retry against another replica after Retry-After
+                    self._json({"error": "server is draining"}, 503,
+                               headers={"Retry-After": "1"})
+                    return
                 req = None
                 try:
                     try:
@@ -262,7 +306,9 @@ class InferenceServer:
                     version = (req.get("version")
                                if isinstance(req, dict) else None)
                     server._count_error(name, code, version)
-                    self._json({"error": str(e)}, code)
+                    self._json({"error": str(e)}, code,
+                               headers=({"Retry-After": "1"}
+                                        if code == 503 else None))
 
             def _stream_ndjson(self, it):
                 """Chunked transfer-encoded newline-delimited JSON: one
@@ -444,6 +490,89 @@ class InferenceServer:
         if self.model is not None:
             d["model"] = type(self.model).__name__  # legacy field
         return d
+
+    # -- lifecycle (docs/serving.md "Operating the server") ------------
+    def ready(self) -> bool:
+        """Readiness: True until :meth:`drain` starts. ``/readyz``
+        mirrors this (200 vs 503 + Retry-After) so load balancers pull
+        the replica before its in-flight work finishes."""
+        return self._ready
+
+    def _healthz(self):
+        """Liveness: (status code, body). 503 only when some engine's
+        scheduler loop is WEDGED — thread dead or heartbeat stale past
+        its stall watchdog. Draining/stopped engines are alive (that's
+        readiness's job), so a restart isn't provoked mid-drain."""
+        models = self.registry.health()
+        ok = all(models.values())
+        return (200 if ok else 503), {
+            "status": "ok" if ok else "stalled",
+            "models": models}
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful shutdown, phase 1: flip readiness off (``/readyz``
+        -> 503, new POSTs -> 503 + Retry-After), drain every engine
+        (in-flight requests finish, scheduler threads join). The HTTP
+        listener stays up so ``/stats``, ``/healthz`` and in-flight
+        streaming responses keep flowing; call :meth:`stop` (phase 2)
+        to tear it down. Returns True when everything drained within
+        ``timeout_s``."""
+        self._ready = False
+        return self.registry.drain(timeout_s)
+
+    def install_signal_handlers(self, signals=(signal.SIGTERM,),
+                                drain_timeout_s: float = 30.0,
+                                reraise: bool = True) -> bool:
+        """Wire graceful drain to SIGTERM (the platform's preemption
+        notice — same contract as
+        :class:`~..parallel.elastic.PreemptionHandler` for training):
+        on signal, drain + stop, then chain the previous handler (or
+        re-deliver the default action so the process actually exits).
+        Signal handlers are a main-thread-only facility; elsewhere
+        this degrades to a no-op and returns False.
+
+        The handler itself only flips readiness and hands off: Python
+        runs it on the main thread between bytecodes, so the main
+        thread may at that instant hold the very registry/batcher
+        locks ``drain()`` needs — blocking in the handler would
+        deadlock the process on a lock its own thread holds. The
+        blocking drain + stop run on a dedicated thread. Chaining
+        works by RESTORING the previous disposition in the handler
+        (``signal.signal`` is itself main-thread-only) and having the
+        worker re-deliver the signal after the drain: CPython then
+        runs the previous handler on the main thread, the context it
+        is entitled to (e.g. ``PreemptionHandler`` re-arms SIG_DFL,
+        legal only there). A side effect is the usual graceful-then-
+        forceful contract: a second signal during the drain takes the
+        previous/default action immediately."""
+        if threading.current_thread() is not threading.main_thread():
+            return False
+
+        def _handle(signum, frame):
+            self._ready = False       # lock-free; /readyz flips now
+            if self._signal_drain is not None \
+                    and self._signal_drain.is_alive():
+                return                # drain already in flight
+            prev = self._prev_handlers.get(signum)
+            if reraise and prev is not None:
+                signal.signal(signum, prev)
+
+            def _drain_and_exit():
+                self.drain(drain_timeout_s)
+                self.stop()
+                if reraise and prev is not None \
+                        and prev != signal.SIG_IGN:
+                    os.kill(os.getpid(), signum)
+            # non-daemon: interpreter exit waits for the (time-bounded)
+            # drain instead of killing it mid-flight
+            self._signal_drain = threading.Thread(
+                target=_drain_and_exit, name="serving-signal-drain",
+                daemon=False)
+            self._signal_drain.start()
+        for s in signals:
+            self._prev_handlers[s] = signal.getsignal(s)
+            signal.signal(s, _handle)
+        return True
 
     def stats(self) -> dict:
         return {"models": self.registry.stats(),

@@ -26,10 +26,16 @@ from typing import Any, Optional, Sequence
 from ..profiler import OpProfiler
 from .engine import (ClientError, InferenceEngine, ServingError,
                      _concat_results, _slice)
+from .faults import TransientFault, poll_until_idle
 
 
 class QueueFullError(ServingError):
     """Load shed: the request queue is at capacity (HTTP 503)."""
+
+
+class DrainingError(QueueFullError):
+    """The server is draining for shutdown: new work is rejected with
+    503 + ``Retry-After`` while in-flight requests finish."""
 
 
 class DeadlineExceededError(ServingError):
@@ -78,19 +84,33 @@ class MicroBatcher:
                  max_batch_size: Optional[int] = None,
                  max_latency_ms: float = 5.0,
                  max_queue: int = 256,
-                 default_timeout_ms: float = 30_000.0):
+                 default_timeout_ms: float = 30_000.0,
+                 max_retries: int = 3,
+                 retry_backoff_ms: float = 1.0,
+                 retry_backoff_max_ms: float = 50.0,
+                 stall_timeout_s: float = 30.0):
         self.engine = engine
         self.max_batch_size = int(max_batch_size or engine.max_batch_size)
         if self.max_batch_size > engine.max_batch_size:
             raise ValueError("batcher max_batch_size exceeds the engine's")
         self.max_latency_ms = float(max_latency_ms)
         self.default_timeout_ms = float(default_timeout_ms)
+        # supervision: a TransientFault from the device call is retried
+        # up to max_retries times with bounded exponential backoff (the
+        # inference path is stateless — no donation — so a retry is
+        # always safe); anything else fails the batch as before
+        self.max_retries = int(max_retries)
+        self.retry_backoff_ms = float(retry_backoff_ms)
+        self.retry_backoff_max_ms = float(retry_backoff_max_ms)
+        self.stall_timeout_s = float(stall_timeout_s)
         self.metrics = engine.metrics
         self.metrics.queue_max = int(max_queue)
         self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=max_queue)
         self._held: "deque[_Request]" = deque()  # signature-mismatched
         self._profiler = OpProfiler.get_instance()
         self._running = True
+        self._draining = False
+        self._beat = time.monotonic()  # scheduler heartbeat (/healthz)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="serving-batcher")
         self._thread.start()
@@ -102,6 +122,12 @@ class MicroBatcher:
         :class:`~.engine.ClientError` on malformed payloads,
         :class:`QueueFullError` when shedding, and
         :class:`DeadlineExceededError` past the deadline."""
+        if self._draining:
+            # checked before _running: a drained replica answers 503 +
+            # Retry-After (retry elsewhere), not 500, for its lifetime
+            self.metrics.inc("shed")
+            raise DrainingError("batcher is draining; retry against "
+                                "another replica")
         if not self._running:
             raise ServingError("batcher is stopped")
         feed, n, sig = self.engine.normalize(inputs, outputs)
@@ -162,6 +188,7 @@ class MicroBatcher:
 
     def _loop(self):
         while self._running:
+            self._beat = time.monotonic()
             head = self._next(0.05)
             if head is None or self._expired(head):
                 continue
@@ -185,7 +212,14 @@ class MicroBatcher:
                 batch.append(nxt)
                 rows += nxt.n
             self._held.extend(skipped)
-            self._execute(batch, rows)
+            # final expiry sweep: members (the head included) can age
+            # out DURING the fill wait — dead rows must not ride the
+            # device call, and an all-expired batch must skip the call
+            # entirely. _expired counts each drop exactly once (CAS
+            # against the waiter's own timeout accounting).
+            batch = [r for r in batch if not self._expired(r)]
+            if batch:
+                self._execute(batch, sum(r.n for r in batch))
             self.metrics.queue_depth = self._queue.qsize()
         # drain on stop: fail fast rather than strand waiters
         for req in list(self._held):
@@ -197,24 +231,74 @@ class MicroBatcher:
         feed = feeds[0] if len(feeds) == 1 else _concat_results(feeds)
         self.metrics.inc("batches")
         self.metrics.batch_hist.record(rows)
-        t0 = time.perf_counter()
-        try:
-            with self._profiler.record("serving.batch"):
-                # rows were normalized in submit(); the sig is shared by
-                # construction — skip re-validating on the hot path
-                res = self.engine.predict_normalized(feed, rows,
-                                                     batch[0].sig)
-        except Exception as e:  # noqa: BLE001 — scatter to all waiters
-            for r in batch:
-                r.error = e
-                r.event.set()
-            return
+        backoff = self.retry_backoff_ms / 1e3
+        attempt = 0
+        while True:
+            t0 = time.perf_counter()  # device_ms times the call that
+            try:                      # succeeded, not the backoffs
+                with self._profiler.record("serving.batch"):
+                    # rows were normalized in submit(); the sig is
+                    # shared by construction — skip re-validating on
+                    # the hot path
+                    res = self.engine.predict_normalized(feed, rows,
+                                                         batch[0].sig)
+                break
+            except TransientFault as e:
+                # raised before the device call touched anything —
+                # retry the SAME batch with bounded backoff; give up
+                # only after max_retries and fail the batch like any
+                # other device error
+                attempt += 1
+                if attempt > self.max_retries:
+                    for r in batch:
+                        r.error = e
+                        r.event.set()
+                    return
+                self.metrics.inc("retries")
+                time.sleep(backoff)
+                backoff = min(backoff * 2.0,
+                              self.retry_backoff_max_ms / 1e3)
+            except Exception as e:  # noqa: BLE001 — scatter to waiters
+                for r in batch:
+                    r.error = e
+                    r.event.set()
+                return
         self.metrics.device_ms.record((time.perf_counter() - t0) * 1e3)
         lo = 0
         for r in batch:
             r.result = _slice(res, lo, lo + r.n)
             lo += r.n
             r.event.set()
+
+    def alive(self) -> bool:
+        """Liveness for ``/healthz``: False only when the scheduler is
+        WEDGED — thread dead while it should run, or no heartbeat
+        within ``stall_timeout_s`` (the loop beats every iteration,
+        bounded by its 50 ms idle poll, so a stale beat means a stuck
+        device call). A deliberately stopped/drained batcher is not
+        wedged."""
+        if not self._running:
+            return True
+        if not self._thread.is_alive():
+            return False
+        return (time.monotonic() - self._beat) <= self.stall_timeout_s
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful shutdown: reject new submits with 503
+        (:class:`DrainingError`), let queued + in-flight requests
+        finish, then join the scheduler thread. Returns True when the
+        queue fully drained within ``timeout_s`` (leftovers past the
+        budget are failed by :meth:`stop`)."""
+        first = not self._draining
+        self._draining = True
+        if first:
+            self.metrics.inc("drains")
+        clean = poll_until_idle(
+            lambda: self._queue.empty() and not self._held, timeout_s)
+        # the scheduler finishes its in-flight batch (waiters get their
+        # results) before observing _running=False; join covers it
+        self.stop()
+        return clean
 
     def stop(self, timeout_s: float = 5.0):
         self._running = False

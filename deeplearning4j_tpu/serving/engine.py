@@ -13,6 +13,7 @@ buckets before the server takes traffic.
 """
 from __future__ import annotations
 
+import hashlib
 import threading
 from collections import OrderedDict
 from typing import Any, Dict, Optional, Sequence, Tuple
@@ -22,6 +23,46 @@ import numpy as np
 
 from ..profiler import OpProfiler
 from .metrics import ServingMetrics
+
+
+# -- process-level XLA executable memo ---------------------------------
+# ``jax.jit(fn).lower(...).compile()`` bypasses jax's jit cache (every
+# engine builds fresh closures), so two engines serving the same
+# architecture at the same shapes each pay the full XLA compile — which
+# dominates multi-engine processes (replica-per-model servers, test
+# suites). The memo is keyed by the lowered program's own text:
+# identical HLO is identical compute, so there is no config
+# fingerprint to get wrong. Backend and donation spec are in the key
+# because they live in compile options, not (reliably) in the text.
+# Tracing/lowering still runs per engine (cheap); only the XLA compile
+# is shared. Executables are stateless and reentrant, so cross-engine
+# sharing — donated buffers included — is safe.
+_EXE_MEMO: "OrderedDict[Tuple, Any]" = OrderedDict()
+_EXE_MEMO_LOCK = threading.Lock()
+_EXE_MEMO_CAP = 64
+
+
+def compile_memoized(fn, args, donate_argnums=()):
+    """``jit(fn, donate).lower(*args).compile()`` with a bounded
+    process-level LRU keyed by (backend, donation, sha256(HLO))."""
+    donate = tuple(donate_argnums)
+    lowered = jax.jit(fn, donate_argnums=donate).lower(*args)
+    key = (jax.default_backend(), donate,
+           hashlib.sha256(lowered.as_text().encode()).hexdigest())
+    with _EXE_MEMO_LOCK:
+        exe = _EXE_MEMO.get(key)
+        if exe is not None:
+            _EXE_MEMO.move_to_end(key)
+            return exe
+    exe = lowered.compile()
+    with _EXE_MEMO_LOCK:
+        prior = _EXE_MEMO.get(key)
+        if prior is not None:
+            return prior          # lost a benign compile race
+        _EXE_MEMO[key] = exe
+        while len(_EXE_MEMO) > _EXE_MEMO_CAP:
+            _EXE_MEMO.popitem(last=False)
+    return exe
 
 
 class ServingError(RuntimeError):
@@ -69,8 +110,12 @@ class InferenceEngine:
     def __init__(self, model, default_outputs: Optional[Sequence[str]] = None,
                  max_batch_size: int = 64, min_bucket: int = 1,
                  cache_size: int = 16,
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 fault_injector=None):
         self.model = model
+        # serving/faults.py FaultInjector (or None — the default; the
+        # hot path then pays exactly one attribute load per call)
+        self._faults = fault_injector
         self.default_outputs = list(default_outputs or [])
         self.max_batch_size = int(max_batch_size)
         self.min_bucket = int(min_bucket)
@@ -275,7 +320,7 @@ class InferenceEngine:
             fn = self._fn_for(sig[1])
             state = self._state_for(fn)
             with self._profiler.record("serving.compile"):
-                exe = jax.jit(fn).lower(state, feed).compile()
+                exe = compile_memoized(fn, (state, feed))
             with self._lock:
                 self.metrics.compiles += 1
                 # cache the executable WITH its fn: weights are re-read
@@ -364,6 +409,11 @@ class InferenceEngine:
         self.metrics.bucket_hist.record(bucket)
         padded = (jax.tree_util.tree_map(lambda a: _pad_rows(a, bucket), feed)
                   if isinstance(feed, dict) else _pad_rows(feed, bucket))
+        if self._faults is not None:
+            # injection seam: fires BEFORE the device call, so a
+            # transient fault leaves no partial state and the batcher
+            # above can retry the whole call
+            self._faults.fire("device_step")
         if self._kind == "duck":
             # fallback: the model's own output() (its internal jit cache
             # still benefits from the bounded bucket shapes)

@@ -40,8 +40,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..profiler import OpProfiler
-from .batcher import DeadlineExceededError, QueueFullError
-from .engine import ClientError, ServingError
+from .batcher import DeadlineExceededError, DrainingError, QueueFullError
+from .engine import ClientError, ServingError, compile_memoized
+from .faults import (CorruptedStateFault, PoisonRequestError,
+                     TransientFault, poll_until_idle)
 from .kvcache import KVCache, SlotTable
 from .metrics import GenerationMetrics
 from .paging import (NULL_BLOCK, BlockAllocator, BlockTable, PagedKVCache,
@@ -114,11 +116,25 @@ def _sample_one(logits, temp, top_k, key):
 # ---------------------------------------------------------------------------
 # request
 # ---------------------------------------------------------------------------
+def _recovery_seq(req: "_GenRequest") -> np.ndarray:
+    """The K/V prefix a (possibly recovered) request must hold before
+    its next decode step: the prompt, plus — after recompute-recovery —
+    the already-emitted tokens minus the last one, whose K/V the next
+    decode step writes at ``pos`` exactly like a fresh admission's
+    first sampled token. Shared by both cache backends so the resume
+    math can never diverge between them."""
+    if req.tokens:
+        return np.concatenate(
+            [req.prompt, np.asarray(req.tokens[:-1], np.int32)])
+    return req.prompt
+
+
 class _GenRequest:
     __slots__ = ("prompt", "max_tokens", "temperature", "top_k", "seed",
                  "eos_id", "deadline", "event", "tokens", "error",
                  "finish_reason", "stream_q", "t_submit", "t_first",
-                 "t_last", "abandoned", "_lock", "_timeout_counted")
+                 "t_last", "abandoned", "recoveries", "_lock",
+                 "_timeout_counted")
 
     def __init__(self, prompt, max_tokens, temperature, top_k, seed,
                  eos_id, deadline, stream: bool):
@@ -142,6 +158,7 @@ class _GenRequest:
         self.t_first: Optional[float] = None
         self.t_last: Optional[float] = None
         self.abandoned = False  # submitter gave up: skip, don't recount
+        self.recoveries = 0     # recompute-recovery re-admissions
         self._lock = threading.Lock()
         self._timeout_counted = False
 
@@ -221,18 +238,26 @@ class _ChunkState:
     block table, and the chunk plan with a cursor. The scheduler
     processes ONE chunk per loop iteration, interleaved with decode
     steps, so a long prompt's prefill never stalls the decode loop for
-    longer than one chunk (Sarathi-Serve, PAPERS.md)."""
+    longer than one chunk (Sarathi-Serve, PAPERS.md).
 
-    __slots__ = ("req", "slot", "table", "tbl_bucket", "plan", "idx")
+    ``seq`` is the token prefix the chunks run over: the prompt for a
+    fresh admission, or prompt + already-emitted tokens (minus the
+    last, whose K/V the next decode step writes) when re-admitted by
+    recompute-recovery."""
+
+    __slots__ = ("req", "slot", "table", "tbl_bucket", "plan", "idx",
+                 "seq")
 
     def __init__(self, req: "_GenRequest", slot: int, table: BlockTable,
-                 tbl_bucket: int, plan: List[Tuple[int, int, int]]):
+                 tbl_bucket: int, plan: List[Tuple[int, int, int]],
+                 seq: np.ndarray):
         self.req = req
         self.slot = slot
         self.table = table
         self.tbl_bucket = tbl_bucket
         self.plan = plan                  # [(p0, chunk_bucket, len)]
         self.idx = 0
+        self.seq = seq
 
     @property
     def done_tokens(self) -> int:
@@ -284,7 +309,13 @@ class GenerationEngine:
                  block_size: int = 16,
                  num_blocks: Optional[int] = None,
                  prefill_chunk_tokens: Optional[int] = None,
-                 metrics: Optional[GenerationMetrics] = None):
+                 metrics: Optional[GenerationMetrics] = None,
+                 fault_injector=None,
+                 max_step_retries: int = 3,
+                 retry_backoff_ms: float = 1.0,
+                 retry_backoff_max_ms: float = 50.0,
+                 max_recoveries_per_request: int = 3,
+                 stall_timeout_s: float = 30.0):
         if getattr(model, "_params", None) is None:
             model.init()
         self.model = model
@@ -398,6 +429,23 @@ class GenerationEngine:
         self._donate = (1, 2)
         self._queue: "queue.Queue[_GenRequest]" = queue.Queue(
             maxsize=int(max_queue))
+        # -- fault tolerance (serving/faults.py) --------------------
+        # seams fire only when an injector is configured; the
+        # supervised loop always runs (real device faults need no
+        # injector to happen)
+        self._faults = fault_injector
+        self._max_step_retries = int(max_step_retries)
+        self._retry_backoff_s = float(retry_backoff_ms) / 1e3
+        self._retry_backoff_max_s = float(retry_backoff_max_ms) / 1e3
+        self._max_recoveries = int(max_recoveries_per_request)
+        self._stall_timeout_s = float(stall_timeout_s)
+        # requests to re-admit AHEAD of the queue: transient-faulted
+        # admissions and recompute-recovery re-admissions (they were
+        # already accepted — later arrivals must not starve them)
+        self._requeue: "collections.deque[_GenRequest]" = \
+            collections.deque()
+        self._draining = False
+        self._beat = time.monotonic()  # scheduler heartbeat (/healthz)
         self._running = True
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="generation-scheduler")
@@ -429,6 +477,12 @@ class GenerationEngine:
         self.metrics.kv_tokens_allocated = a.used_count * self.block_size
 
     # -- executables ---------------------------------------------------
+    # Every executable also returns a FINITE-LOGITS flag computed
+    # in-graph (an all-reduce over isfinite — noise next to the
+    # matmuls): the poison-request guard. A request whose own weights+
+    # tokens drive the logits to NaN/Inf is QUARANTINED by the host
+    # loop — failed alone with 500, slot/blocks freed — instead of
+    # silently emitting garbage or wedging the batch.
     def _decode_fn(self):
         model = self.model
         impl = self.decode_impl
@@ -438,16 +492,18 @@ class GenerationEngine:
                      steps, temps, top_ks):
                 logits, kcs, vcs = model.forward_decode_paged(
                     params, tokens, pos, kcs, vcs, tables, impl)
+                ok = jnp.all(jnp.isfinite(logits), axis=-1)  # per lane
                 nxt = _sample_batch(logits, temps, top_ks, seeds, steps)
-                return nxt, kcs, vcs
+                return nxt, ok, kcs, vcs
             return step
 
         def step(params, kcs, vcs, tokens, pos, seeds, steps, temps,
                  top_ks):
             logits, kcs, vcs = model.forward_decode(params, tokens, pos,
                                                     kcs, vcs, impl)
+            ok = jnp.all(jnp.isfinite(logits), axis=-1)      # per lane
             nxt = _sample_batch(logits, temps, top_ks, seeds, steps)
-            return nxt, kcs, vcs
+            return nxt, ok, kcs, vcs
         return step
 
     def _chunk_fn(self):
@@ -457,13 +513,19 @@ class GenerationEngine:
                   temp, top_k):
             logits, kcs, vcs = model.forward_prefill_chunk(
                 params, tokens, p0, chunk_len, kcs, vcs, table)
+            # guard only rows < chunk_len: padded tail rows attend
+            # positions past the live length — stale block junk that
+            # is allowed to be anything (no-zeroing invariant)
+            ok = jnp.all(jnp.where(
+                (jnp.arange(tokens.shape[1]) < chunk_len)[:, None],
+                jnp.isfinite(logits), True))
             last = jax.lax.dynamic_index_in_dim(
                 logits, chunk_len - 1, axis=0, keepdims=False)
             # same step-0 fold as the slot prefill — the first token's
             # sample is bit-identical across backends
             key = jax.random.fold_in(jax.random.PRNGKey(seed), 0)
             first = _sample_one(last, temp, top_k, key)
-            return first, kcs, vcs
+            return first, ok, kcs, vcs
         return chunk
 
     def _prefill_fn(self):
@@ -476,6 +538,9 @@ class GenerationEngine:
                 jnp.float32)
             logits, ks, vs = model.forward_prefill(params, tokens,
                                                    key_mask)
+            # padded rows only see keys under key_mask, so any
+            # non-finite value traces back to the request's own tokens
+            ok = jnp.all(jnp.isfinite(logits))
             # write this request's K/V rows into its slot; positions
             # past ``length`` hold junk from the padded prompt tail but
             # stay masked (and are overwritten as decode advances)
@@ -487,7 +552,7 @@ class GenerationEngine:
                 logits[0], length - 1, axis=0, keepdims=False)
             key = jax.random.fold_in(jax.random.PRNGKey(seed), 0)
             first = _sample_one(last, temp, top_k, key)
-            return first, kcs, vcs
+            return first, ok, kcs, vcs
         return prefill
 
     def _get_decode_exe(self):
@@ -510,9 +575,8 @@ class GenerationEngine:
                         np.zeros(S, np.uint32), np.zeros(S, np.int32),
                         np.zeros(S, np.float32), np.zeros(S, np.int32))
             with self._profiler.record("generation.compile"):
-                exe = jax.jit(
-                    self._decode_fn(),
-                    donate_argnums=self._donate).lower(*args).compile()
+                exe = compile_memoized(self._decode_fn(), args,
+                                       self._donate)
             self.metrics.inc("compiles")
             self._decode_exe = exe
             return exe
@@ -535,9 +599,8 @@ class GenerationEngine:
                     np.full(tbl_bucket, NULL_BLOCK, np.int32),
                     np.uint32(0), np.float32(0.0), np.int32(0))
             with self._profiler.record("generation.compile"):
-                exe = jax.jit(
-                    self._chunk_fn(),
-                    donate_argnums=self._donate).lower(*args).compile()
+                exe = compile_memoized(self._chunk_fn(), args,
+                                       self._donate)
             self.metrics.inc("compiles")
             self._prefill_exe[key] = exe
             return exe
@@ -555,9 +618,8 @@ class GenerationEngine:
                     np.int32(0), np.uint32(0), np.float32(0.0),
                     np.int32(0))
             with self._profiler.record("generation.compile"):
-                exe = jax.jit(
-                    self._prefill_fn(),
-                    donate_argnums=self._donate).lower(*args).compile()
+                exe = compile_memoized(self._prefill_fn(), args,
+                                       self._donate)
             self.metrics.inc("compiles")
             self._prefill_exe[bucket] = exe
             return exe
@@ -597,6 +659,12 @@ class GenerationEngine:
     # -- client side ---------------------------------------------------
     def _make_request(self, prompt, max_tokens, temperature, top_k, seed,
                       eos_id, timeout_ms, stream) -> _GenRequest:
+        if self._draining:
+            # checked before _running: a drained replica answers 503 +
+            # Retry-After (retry elsewhere), not 500, for its lifetime
+            self.metrics.inc("shed")
+            raise DrainingError("generation engine is draining; retry "
+                                "against another replica")
         if not self._running:
             raise ServingError("generation engine is stopped")
         try:
@@ -657,6 +725,10 @@ class GenerationEngine:
                            time.perf_counter() + timeout, stream)
 
     def _enqueue(self, req: _GenRequest):
+        if self._draining:
+            self.metrics.inc("shed")
+            raise DrainingError("generation engine is draining; retry "
+                                "against another replica")
         try:
             self._queue.put_nowait(req)
         except queue.Full:
@@ -720,6 +792,13 @@ class GenerationEngine:
             raise
 
     # -- scheduler side ------------------------------------------------
+    def _hit(self, seam: str):
+        """Fire the fault-injection seam (no-op without an injector:
+        one attribute load)."""
+        fi = self._faults
+        if fi is not None:
+            fi.fire(seam)
+
     def _fail(self, req: _GenRequest, exc: BaseException,
               count: bool = True):
         """``count=False`` for graceful-shutdown drains: a deploy
@@ -751,6 +830,13 @@ class GenerationEngine:
         req.t_last = now
         if req.stream_q is not None:
             req.stream_q.put(("token", token))
+            fi = self._faults
+            if fi is not None and fi.fire("client_disconnect"):
+                # simulate the HTTP consumer hanging up mid-stream:
+                # exactly what _TokenStream.close() does on a real
+                # disconnect — the scheduler frees the slot/blocks at
+                # the next retirement check
+                req.abandoned = True
 
     def _release_slot(self, slot: int):
         """Free a slot AND (paged) its blocks + decode-table row. No
@@ -798,20 +884,33 @@ class GenerationEngine:
         return False
 
     def _admit(self):
-        """Fill free slots from the queue. Blocks briefly only when the
-        engine is fully idle — with active slots the decode loop must
-        keep stepping, so admission is non-blocking."""
+        """Fill free slots from the queue (the re-admission deque
+        first — transient-faulted and recovery re-admissions were
+        accepted earlier than anything still queued). Blocks briefly
+        only when the engine is fully idle — with active slots the
+        decode loop must keep stepping, so admission is non-blocking.
+
+        Fault contract for one admission: a :class:`TransientFault`
+        (injected before any state changed) re-stashes the request and
+        propagates so the loop retries with backoff; a
+        :class:`CorruptedStateFault` propagates for recompute-recovery
+        (re-stashing the request unless it was already failed — the
+        attributed-device-failure path fails it inside
+        :meth:`_prefill`); anything else fails just this request."""
         if self.cache_backend == "paged":
             return self._admit_paged()
         while self._running and self._slots.free_count:
-            try:
-                if self._slots.active_count:
-                    req = self._queue.get_nowait()
-                else:
-                    req = self._queue.get(timeout=0.05)
-            except queue.Empty:
-                return
-            self.metrics.queue_depth = self._queue.qsize()
+            if self._requeue:
+                req = self._requeue.popleft()
+            else:
+                try:
+                    if self._slots.active_count:
+                        req = self._queue.get_nowait()
+                    else:
+                        req = self._queue.get(timeout=0.05)
+                except queue.Empty:
+                    return
+                self.metrics.queue_depth = self._queue.qsize()
             if req.abandoned:
                 continue
             if time.perf_counter() > req.deadline:
@@ -820,6 +919,13 @@ class GenerationEngine:
                 continue
             try:
                 self._prefill(req)
+            except TransientFault:
+                self._requeue.appendleft(req)
+                raise
+            except CorruptedStateFault:
+                if req.error is None and req.finish_reason is None:
+                    self._requeue.appendleft(req)
+                raise
             except Exception as e:  # noqa: BLE001 — fail one request
                 self._fail(req, e)
 
@@ -849,7 +955,9 @@ class GenerationEngine:
         Admission only STARTS the prefill — chunks run interleaved
         with decode steps in the scheduler loop."""
         while self._running and self._slots.free_count:
-            if self._held is not None:
+            if self._requeue:
+                req = self._requeue.popleft()
+            elif self._held is not None:
                 req, self._held = self._held, None
             else:
                 try:
@@ -866,12 +974,30 @@ class GenerationEngine:
                 self._fail(req, DeadlineExceededError(
                     "expired in the generation queue"))
                 continue
-            L = len(req.prompt)
+            seq = _recovery_seq(req)
+            L = len(seq)
             plan = self._chunk_plan(L)
-            need = blocks_for(L + req.max_tokens, self.block_size)
+            # block budget is unchanged by recovery: prefix + remaining
+            # generation == prompt + max_tokens positions either way
+            need = blocks_for(len(req.prompt) + req.max_tokens,
+                              self.block_size)
+            try:
+                self._hit("alloc")
+            except (TransientFault, CorruptedStateFault):
+                # nothing allocated yet — re-stash the request so the
+                # retry (or recovery) re-admits it, in order
+                self._requeue.appendleft(req)
+                raise
             blocks = self._allocator.alloc(need)
             if blocks is None:
-                self._held = req
+                if self._held is None:
+                    self._held = req
+                else:
+                    # a different request already waits at the head
+                    # for blocks (req came from the re-admission
+                    # deque) — it must go back there, NOT overwrite
+                    # the held one into oblivion
+                    self._requeue.appendleft(req)
                 return
             table = BlockTable(blocks, self.block_size)
             # the table bucket must also cover the LAST chunk's padded
@@ -882,14 +1008,15 @@ class GenerationEngine:
             # allocation hit padded NULL entries -> the null block.
             # Either way, never another request's blocks — which is
             # exactly what an undersized table would break.
-            span = max(L + req.max_tokens, plan[-1][0] + plan[-1][1])
+            span = max(len(req.prompt) + req.max_tokens,
+                       plan[-1][0] + plan[-1][1])
             tbl_bucket = pow2_bucket(
                 blocks_for(span, self.block_size), cap=self._tbl_top)
             slot = self._slots.alloc(req)
             assert slot is not None  # guarded by free_count
             self._slot_blocks[slot] = table
             self._prefilling.append(
-                _ChunkState(req, slot, table, tbl_bucket, plan))
+                _ChunkState(req, slot, table, tbl_bucket, plan, seq))
             self.metrics.active_slots = self._slots.active_count
             self._update_block_gauges()
 
@@ -909,11 +1036,15 @@ class GenerationEngine:
             self._release_slot(st.slot)
             self._fail(req, DeadlineExceededError(
                 "deadline exceeded during chunked prefill "
-                f"({st.done_tokens}/{len(req.prompt)} prompt tokens)"))
+                f"({st.done_tokens}/{len(st.seq)} prompt tokens)"))
             return
+        # injection seam: BEFORE any mutation — a TransientFault here
+        # leaves the chunk state at the deque head, so the retried
+        # iteration re-runs this same chunk
+        self._hit("prefill")
         p0, bucket, clen = st.plan[st.idx]
         tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :clen] = req.prompt[p0:p0 + clen]
+        tokens[0, :clen] = st.seq[p0:p0 + clen]
         table = st.table.padded(st.tbl_bucket)
         t0 = time.perf_counter()
         try:
@@ -926,50 +1057,70 @@ class GenerationEngine:
             return
         try:
             with self._profiler.record("generation.prefill"):
-                first, self._kcs, self._vcs = exe(
+                first, okd, self._kcs, self._vcs = exe(
                     self.model._params, self._kcs, self._vcs, tokens,
                     np.int32(p0), np.int32(clen), table,
                     np.uint32(req.seed), np.float32(req.temperature),
                     np.int32(req.top_k))
                 first = int(np.asarray(first))  # device sync
+                ok = bool(np.asarray(okd))
         except Exception as e:  # noqa: BLE001 — the call died with the
-            # pools donated: every in-flight sequence lost its prefix
+            # pools donated: attribute the failure to THIS request
+            # (fail it alone), then let the loop recompute-recover
+            # every other in-flight sequence's lost prefix
             self._prefilling.popleft()
             self._release_slot(st.slot)
             self._fail(req, e)
-            self._poison(repr(e))
-            return
+            raise CorruptedStateFault(
+                f"prefill chunk device call failed: {e!r}")
         self.metrics.prefill_ms.record((time.perf_counter() - t0) * 1e3)
         self.metrics.inc("prefill_chunks")
         self.metrics.prompt_bucket_hist.record(bucket)
+        if not ok:
+            # poison quarantine: this request's own tokens drove the
+            # logits non-finite — fail it alone, free its blocks now
+            self._prefilling.popleft()
+            self._release_slot(st.slot)
+            self.metrics.inc("quarantined")
+            self._fail(req, PoisonRequestError(
+                "request produced non-finite logits during prefill; "
+                "quarantined"))
+            return
         st.idx += 1
         if st.idx < len(st.plan):
             return
-        # final chunk: the request becomes a decode lane. Its sampled
-        # token is generated token #1 (TTFT stops here).
+        # final chunk: the request becomes a decode lane. Fresh
+        # admission: its sampled token is generated token #1 (TTFT
+        # stops here). Recovery re-admission: the already-emitted
+        # stream stands — restore the decode cursor (last token, pos,
+        # PRNG fold index) instead of emitting; the re-sampled first
+        # token is discarded.
         self._prefilling.popleft()
         self.metrics.inc("prefills")
         if len(st.plan) > 1:
             self.metrics.inc("chunked_prefills")
-        L = len(req.prompt)
+        L = len(st.seq)
         slots = self._slots
-        slots.token[st.slot] = first
+        resumed = bool(req.tokens)
+        slots.token[st.slot] = req.tokens[-1] if resumed else first
         slots.pos[st.slot] = L
-        slots.step[st.slot] = 1
+        slots.step[st.slot] = len(req.tokens) if resumed else 1
         slots.seed[st.slot] = req.seed
         slots.temp[st.slot] = req.temperature
         slots.top_k[st.slot] = req.top_k
         self._tables[st.slot] = st.table.padded(self._blocks_per_seq)
         self._update_block_gauges()
+        if resumed:
+            return
         self.metrics.tokens.record(1)
         self._emit(req, first, time.perf_counter())
         self._check_done(st.slot, req, first)
 
     def _poison(self, why: str):
-        """A device call failed after the caches were donated to it:
-        every in-flight sequence lost its prefix. Fail them all loudly
-        (silently decoding from a zeroed cache would be worse) and
-        reallocate so the engine stays servable."""
+        """LAST RESORT (recovery itself failed): every in-flight
+        sequence lost its prefix and cannot be rebuilt. Fail them all
+        loudly (silently decoding from a zeroed cache would be worse)
+        and reallocate so the engine stays servable."""
         for slot in self._slots.active_slots():
             req = self._slots.requests[slot]
             self._slots.free(slot)
@@ -988,16 +1139,76 @@ class GenerationEngine:
         self._kcs = self._cache.ks
         self._vcs = self._cache.vs
 
+    def _recover(self, why: str):
+        """Recompute-recovery (the vLLM preempt-and-recompute insight:
+        decode state is CHEAP to rebuild — it is a pure function of
+        prompt + emitted tokens). After a cache-corrupting failure,
+        every in-flight request is re-admitted at the FRONT of the
+        line and re-prefilled from prompt + already-emitted tokens;
+        its PRNG stream continues at ``fold_in(seed, len(emitted))``,
+        so post-recovery output is token-identical to a fault-free
+        run and NO accepted request is ever lost. Only requests that
+        keep triggering recoveries (``max_recoveries_per_request``) or
+        age past their deadline are failed."""
+        recovered: List[_GenRequest] = []
+        st = self._slots
+        for slot in st.active_slots():
+            recovered.append(st.requests[slot])
+            st.free(slot)
+        self.metrics.active_slots = 0
+        if self.cache_backend == "paged":
+            # mid-prefill requests hold slots too, so the slot sweep
+            # above already collected them EXACTLY once (collecting
+            # from _prefilling as well would re-admit them twice);
+            # they re-prefill from scratch — req.tokens carries
+            # whatever they had already emitted. Block bookkeeping
+            # resets wholesale: the pool arrays were donated away with
+            # the caches.
+            self._prefilling.clear()
+            self._allocator = BlockAllocator(self.num_blocks)
+            self._tables[:] = NULL_BLOCK
+            self._slot_blocks = [None] * self.num_slots
+        self._cache = self._fresh_cache()
+        self._kcs = self._cache.ks
+        self._vcs = self._cache.vs
+        now = time.perf_counter()
+        for req in recovered:
+            if req.abandoned:
+                continue
+            if now > req.deadline:
+                self._fail(req, DeadlineExceededError(
+                    "deadline exceeded during fault recovery "
+                    f"({len(req.tokens)} tokens emitted)"))
+            elif req.recoveries >= self._max_recoveries:
+                # a request that rides every crash is probably causing
+                # them — attribution of last resort
+                self._fail(req, ServingError(
+                    f"request failed {req.recoveries} recovery "
+                    f"attempts: {why}"))
+            else:
+                req.recoveries += 1
+                self._requeue.append(req)
+        if self.cache_backend == "paged":
+            self._update_block_gauges()
+
     def _prefill(self, req: _GenRequest):
+        # injection seam: BEFORE the slot claim, so a TransientFault
+        # leaves nothing to unwind — _admit re-stashes the request and
+        # the loop retries with backoff
+        self._hit("prefill")
+        resumed = bool(req.tokens)
+        seq = _recovery_seq(req)
         slot = self._slots.alloc(req)
         assert slot is not None  # guarded by free_count in _admit
-        L = len(req.prompt)
+        L = len(seq)
         # route to the smallest CONFIGURED bucket, not the raw pow2
         # ladder — warmup() covered exactly prompt_buckets, and an
-        # off-list bucket here would compile under traffic
+        # off-list bucket here would compile under traffic. Recovery
+        # prefixes fit too: prompt + emitted <= max_seq_len, and
+        # max_seq_len is always a bucket.
         bucket = next(b for b in self.prompt_buckets if b >= L)
         tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :L] = req.prompt
+        tokens[0, :L] = seq
         t0 = time.perf_counter()
         try:
             exe = self._get_prefill_exe(bucket)
@@ -1008,27 +1219,47 @@ class GenerationEngine:
             raise
         try:
             with self._profiler.record("generation.prefill"):
-                first, self._kcs, self._vcs = exe(
+                first, okd, self._kcs, self._vcs = exe(
                     self.model._params, self._kcs, self._vcs, tokens,
                     np.int32(L), np.int32(slot), np.uint32(req.seed),
                     np.float32(req.temperature), np.int32(req.top_k))
                 first = int(np.asarray(first))  # device sync
+                ok = bool(np.asarray(okd))
         except Exception as e:
-            # the call itself died mid-flight with the caches donated
-            self._slots.free(slot)
-            self._poison(repr(e))
-            raise
+            # the call itself died mid-flight with the caches donated:
+            # attribute the failure to THIS request (fail it alone),
+            # then raise for recompute-recovery of everyone else
+            self._release_slot(slot)
+            self._fail(req, e)
+            raise CorruptedStateFault(
+                f"prefill device call failed: {e!r}")
         self.metrics.prefill_ms.record((time.perf_counter() - t0) * 1e3)
         self.metrics.inc("prefills")
         self.metrics.prompt_bucket_hist.record(bucket)
+        if not ok:
+            # poison quarantine: only this request's logits are
+            # non-finite — fail it alone with 500, free the slot now.
+            # Its NaN K/V rows stay in the cache but are stale-tail
+            # data the no-zeroing invariant already masks.
+            self._release_slot(slot)
+            self.metrics.inc("quarantined")
+            self._fail(req, PoisonRequestError(
+                "request produced non-finite logits during prefill; "
+                "quarantined"))
+            return
         st = self._slots
-        st.token[slot] = first
-        st.pos[slot] = L          # where the first token's K/V will go
-        st.step[slot] = 1         # PRNG fold index for the NEXT sample
+        st.token[slot] = req.tokens[-1] if resumed else first
+        st.pos[slot] = L          # where the next token's K/V will go
+        st.step[slot] = len(req.tokens) if resumed else 1  # PRNG fold
         st.seed[slot] = req.seed
         st.temp[slot] = req.temperature
         st.top_k[slot] = req.top_k
         self.metrics.active_slots = st.active_count
+        if resumed:
+            # the emitted stream stands — the re-sampled first token is
+            # discarded; decode continues at fold_in(seed, step), the
+            # same stream position a fault-free run would use
+            return
         # prefill's own sampled token is generated token #1
         self.metrics.tokens.record(1)
         self._emit(req, first, time.perf_counter())
@@ -1047,53 +1278,120 @@ class GenerationEngine:
     def _decode_step(self):
         st = self._slots
         active = self._ready_slots()
+        # injection seam: BEFORE the device call (and its donation), so
+        # a TransientFault here is retryable with all state intact
+        self._hit("device_step")
         t0 = time.perf_counter()
         with self._profiler.record("generation.decode_step"):
             if self.cache_backend == "paged":
-                nxt, self._kcs, self._vcs = self._get_decode_exe()(
+                nxt, okd, self._kcs, self._vcs = self._get_decode_exe()(
                     self.model._params, self._kcs, self._vcs,
                     st.token.copy(), st.pos.copy(), self._tables.copy(),
                     st.seed.copy(), st.step.copy(), st.temp.copy(),
                     st.top_k.copy())
             else:
-                nxt, self._kcs, self._vcs = self._get_decode_exe()(
+                nxt, okd, self._kcs, self._vcs = self._get_decode_exe()(
                     self.model._params, self._kcs, self._vcs,
                     st.token.copy(), st.pos.copy(), st.seed.copy(),
                     st.step.copy(), st.temp.copy(), st.top_k.copy())
             nxt = np.asarray(nxt)  # device sync: the step really ran
+            ok = np.asarray(okd)
         now = time.perf_counter()
         self.metrics.decode_step_ms.record((now - t0) * 1e3)
         self.metrics.inc("decode_steps")
         self.metrics.occupancy_hist.record(len(active))
-        self.metrics.tokens.record(len(active))
         tokens = nxt.tolist()
+        emitted = 0
         itl: List[float] = []
         for slot in active:
             req = st.requests[slot]
+            if not ok[slot]:
+                # poison quarantine: only THIS lane's logits are
+                # non-finite (the guard is per-row, sampling is
+                # per-row) — fail the offending request with 500 and
+                # free its slot/blocks immediately; every other lane
+                # in this same batch keeps decoding untouched
+                self.metrics.inc("quarantined")
+                exc = PoisonRequestError(
+                    "request produced non-finite logits at decode "
+                    f"step {int(st.step[slot])}; quarantined")
+                self._release_slot(slot)  # zeroes the slot row — build
+                self._fail(req, exc)      # the message first
+                continue
             token = tokens[slot]
             st.token[slot] = token
             st.pos[slot] += 1
             st.step[slot] += 1
             self._emit(req, token, now, itl_out=itl)
+            emitted += 1
             self._check_done(slot, req, token, now)
+        # count only tokens actually delivered — a quarantined lane
+        # emitted nothing, and pre-counting len(active) would inflate
+        # tokens/sec under poison load
+        if emitted:
+            self.metrics.tokens.record(emitted)
         if itl:
             self.metrics.itl_ms.record_many(itl)
         if self.cache_backend == "paged":
             self._update_block_gauges()
 
     def _loop(self):
+        """The supervised scheduler loop. One iteration = admit, one
+        prefill chunk (paged), one decode step. Failure ladder:
+
+        - :class:`~.faults.TransientFault` (raised before any
+          donation): retry the iteration with bounded exponential
+          backoff, up to ``max_step_retries`` consecutive strikes.
+        - strikes exhausted, :class:`~.faults.CorruptedStateFault`, or
+          ANY other exception (a device call dying after the caches
+          were donated): recompute-recovery via :meth:`_recover`.
+        - recovery itself failing: :meth:`_poison` (fail all in-flight
+          loudly, reallocate, keep serving).
+
+        The loop itself never dies to a fault — the heartbeat
+        (``/healthz`` watchdog) goes stale only when an iteration
+        genuinely hangs."""
         paged = self.cache_backend == "paged"
+        backoff = self._retry_backoff_s
+        strikes = 0
         while self._running:
+            self._beat = time.monotonic()
             try:
+                self._hit("latency")  # injected tail latency (sleeps)
                 self._admit()
                 if paged and self._prefilling:
                     self._prefill_chunk_step()
                 if self._ready_slots():
                     self._decode_step()
-            except Exception as e:  # noqa: BLE001 — a device-level
-                # failure must fail the in-flight work, not wedge the
-                # scheduler thread (see _poison)
-                self._poison(repr(e))
+            except TransientFault as e:
+                strikes += 1
+                if strikes > self._max_step_retries:
+                    # bounded give-up: rebuild rather than spin forever
+                    self.metrics.inc("recoveries")
+                    try:
+                        self._recover(f"retries exhausted: {e!r}")
+                    except Exception as e2:  # noqa: BLE001
+                        self._poison(repr(e2))
+                    strikes = 0
+                    backoff = self._retry_backoff_s
+                else:
+                    self.metrics.inc("retries")
+                    time.sleep(backoff)
+                    backoff = min(backoff * 2.0,
+                                  self._retry_backoff_max_s)
+            except Exception as e:  # noqa: BLE001 — cache-corrupting
+                # (donated buffers gone) or an unexpected scheduler
+                # error: rebuild all in-flight state by recompute
+                self.metrics.inc("recoveries")
+                try:
+                    self._recover(repr(e))
+                except Exception as e2:  # noqa: BLE001
+                    self._poison(repr(e2))
+                strikes = 0
+                backoff = self._retry_backoff_s
+            else:
+                strikes = 0
+                backoff = self._retry_backoff_s
         # shutdown cleanup runs HERE, on the scheduler thread — stop()
         # must not mutate the slot table from another thread while a
         # final device call might still be in flight
@@ -1104,6 +1402,10 @@ class GenerationEngine:
                 break
             self._fail(req, ServingError("generation engine stopped"),
                        count=False)
+        for req in self._requeue:
+            self._fail(req, ServingError("generation engine stopped"),
+                       count=False)
+        self._requeue.clear()
         if paged:
             self._prefilling.clear()  # their slots drain just below
             if self._held is not None:
@@ -1121,6 +1423,49 @@ class GenerationEngine:
     # -- admin ---------------------------------------------------------
     def stats(self) -> Dict:
         return self.metrics.snapshot()
+
+    def set_fault_injector(self, injector) -> None:
+        """Swap the fault injector (``None`` disables injection). The
+        seams read it per call, so this is safe between workloads —
+        chaos tests and staging probes can reuse one warmed engine
+        instead of paying a fresh compile set per fault scenario."""
+        self._faults = injector
+
+    def alive(self) -> bool:
+        """Liveness for ``/healthz``: False only when the scheduler is
+        WEDGED — thread dead while it should be running, or no
+        heartbeat within ``stall_timeout_s`` (the loop beats every
+        iteration; its longest legitimate pause is one device call).
+        A deliberately stopped/drained engine is not wedged."""
+        if not self._running:
+            return True
+        if not self._thread.is_alive():
+            return False
+        return (time.monotonic() - self._beat) <= self._stall_timeout_s
+
+    def _idle(self) -> bool:
+        empty = (self._queue.empty() and not self._requeue
+                 and self._slots.active_count == 0)
+        if self.cache_backend == "paged":
+            empty = empty and not self._prefilling \
+                and self._held is None
+        return empty
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful shutdown: new submissions are rejected with 503
+        (:class:`~.batcher.DrainingError`), every queued and in-flight
+        generation runs to completion, then the scheduler thread
+        joins. Returns True when the engine fully drained within
+        ``timeout_s``; leftovers past the budget are failed by
+        :meth:`stop`'s shutdown path (uncounted, as for any deploy
+        restart). Safe to call from a signal handler's thread."""
+        first = not self._draining
+        self._draining = True
+        if first:
+            self.metrics.inc("drains")
+        clean = poll_until_idle(self._idle, timeout_s)
+        self.stop()
+        return clean
 
     def stop(self, timeout_s: float = 5.0):
         """Stop the scheduler. Queued and in-flight requests are

@@ -29,6 +29,11 @@ class ServingMetrics:
         self.server_errors = 0     # 5xx-class failures
         self.shed = 0              # rejected, queue full (503)
         self.timeouts = 0          # request deadline exceeded (504)
+        # fault-tolerance counters (serving/faults.py)
+        self.retries = 0           # transient step failures retried
+        self.recoveries = 0        # state rebuilds (n/a for batcher)
+        self.quarantined = 0       # poison requests failed alone
+        self.drains = 0            # graceful drains initiated
         self.batches = 0           # device calls issued
         self.batch_hist = CountHistogram()   # rows per device call
         self.bucket_hist = CountHistogram()  # padded bucket per call
@@ -61,6 +66,12 @@ class ServingMetrics:
             "server_errors": self.server_errors,
             "shed": self.shed,
             "timeouts": self.timeouts,
+            "faults": {
+                "retries": self.retries,
+                "recoveries": self.recoveries,
+                "quarantined": self.quarantined,
+                "drains": self.drains,
+            },
             "queue_depth": self.queue_depth,
             "queue_max": self.queue_max,
             "batches": self.batches,
@@ -96,6 +107,15 @@ class GenerationMetrics:
         self.server_errors = 0     # 5xx-class failures
         self.shed = 0              # rejected, queue full (503)
         self.timeouts = 0          # deadline exceeded (504)
+        # fault-tolerance counters (serving/faults.py): transient step
+        # retries, recompute-recoveries (every in-flight request
+        # re-prefilled from prompt + emitted tokens), poison requests
+        # quarantined (non-finite logits -> 500, batchmates unharmed),
+        # graceful drains
+        self.retries = 0
+        self.recoveries = 0
+        self.quarantined = 0
+        self.drains = 0
         self.prefills = 0          # prefill device calls
         self.decode_steps = 0      # decode device calls (all slots)
         self.tokens = RateMeter(rate_window_s)   # generated tokens
@@ -166,6 +186,12 @@ class GenerationMetrics:
             "server_errors": self.server_errors,
             "shed": self.shed,
             "timeouts": self.timeouts,
+            "faults": {
+                "retries": self.retries,
+                "recoveries": self.recoveries,
+                "quarantined": self.quarantined,
+                "drains": self.drains,
+            },
             "queue_depth": self.queue_depth,
             "queue_max": self.queue_max,
             "prefills": self.prefills,

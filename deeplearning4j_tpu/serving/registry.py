@@ -29,17 +29,23 @@ class ServedModel:
                  batching: bool = True, max_batch_size: int = 64,
                  max_latency_ms: float = 5.0, max_queue: int = 256,
                  cache_size: int = 16,
-                 default_timeout_ms: float = 30_000.0):
+                 default_timeout_ms: float = 30_000.0,
+                 fault_injector=None,
+                 max_retries: int = 3,
+                 retry_backoff_ms: float = 1.0):
         self.name = name
         self.version = int(version)
         self.model = model
         self.engine = InferenceEngine(
             model, default_outputs=default_outputs,
-            max_batch_size=max_batch_size, cache_size=cache_size)
+            max_batch_size=max_batch_size, cache_size=cache_size,
+            fault_injector=fault_injector)
         self.batcher = MicroBatcher(
             self.engine, max_batch_size=max_batch_size,
             max_latency_ms=max_latency_ms, max_queue=max_queue,
-            default_timeout_ms=default_timeout_ms) if batching else None
+            default_timeout_ms=default_timeout_ms,
+            max_retries=max_retries,
+            retry_backoff_ms=retry_backoff_ms) if batching else None
 
     @property
     def metrics(self) -> ServingMetrics:
@@ -63,6 +69,18 @@ class ServedModel:
     def warmup(self, buckets: Sequence[int], example=None,
                outputs: Optional[Sequence[str]] = None):
         return self.engine.warmup(buckets, example=example, outputs=outputs)
+
+    def alive(self) -> bool:
+        """Liveness (``/healthz``): the batcher's scheduler loop is
+        not wedged. Unbatched models have no loop to stall."""
+        return self.batcher.alive() if self.batcher is not None else True
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Reject new work (503 + Retry-After), finish in-flight
+        requests, join the scheduler thread."""
+        if self.batcher is not None:
+            return self.batcher.drain(timeout_s)
+        return True
 
     def stop(self):
         if self.batcher is not None:
@@ -108,6 +126,16 @@ class ServedGenerator:
 
     def warmup(self, buckets: Optional[Sequence[int]] = None):
         return self.engine.warmup(buckets)
+
+    def alive(self) -> bool:
+        """Liveness (``/healthz``): the decode scheduler loop is not
+        wedged (heartbeat watchdog in the engine)."""
+        return self.engine.alive()
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Reject new work (503 + Retry-After), let every in-flight
+        generation finish, join the scheduler thread."""
+        return self.engine.drain(timeout_s)
 
     def stop(self):
         self.engine.stop()
@@ -231,6 +259,44 @@ class ModelRegistry:
                 items.extend((f"{name}@{v}", served)
                              for v, served in vs.items() if v != latest)
         return {key: served.stats() for key, served in items}
+
+    def health(self) -> Dict[str, bool]:
+        """Liveness per served model (``/healthz``), keyed like
+        :meth:`stats` (latest under the bare name, older under
+        name@v)."""
+        with self._lock:
+            items = []
+            for name, vs in self._models.items():
+                latest = max(vs)
+                items.append((name, vs[latest]))
+                items.extend((f"{name}@{v}", served)
+                             for v, served in vs.items() if v != latest)
+        return {key: served.alive() for key, served in items}
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Drain every served model CONCURRENTLY (sequential drains
+        would stack their timeouts): each rejects new work with 503
+        while its in-flight requests finish, then its scheduler thread
+        joins. Models stay registered — `/stats` and `/healthz` remain
+        queryable after the drain. Returns True when every model
+        drained cleanly within ``timeout_s``."""
+        with self._lock:
+            served = [s for vs in self._models.values()
+                      for s in vs.values()]
+        results: Dict[int, bool] = {}
+
+        def go(s):
+            try:
+                results[id(s)] = bool(s.drain(timeout_s))
+            except Exception:  # noqa: BLE001 — a failed drain is dirty
+                results[id(s)] = False
+        threads = [threading.Thread(target=go, args=(s,), daemon=True)
+                   for s in served]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout_s + 5.0)
+        return all(results.get(id(s), False) for s in served)
 
     def stop(self):
         with self._lock:

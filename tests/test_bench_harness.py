@@ -79,6 +79,15 @@ def test_generation_scenario_harness_runs_on_cpu():
     assert 0 < res["paged_peak_kv_bytes"] <= res["paged_pool_bytes"]
     assert res["chunked_prefills"] >= 1  # the 160-token probes chunked
     assert res["itl_p95_short_ms_longprompt_unchunked"] > 0
+    # chaos probe (ISSUE 4): the same engine absorbing injected
+    # transient decode faults + a scripted recompute-recovery must
+    # lose nothing, reproduce the fault-free tokens, and never
+    # recompile — while still reporting a throughput for the gate
+    assert res["chaos_tokens_per_sec"] > 0
+    assert res["chaos_tokens_identical"] is True
+    assert res["chaos_requests_lost"] == 0
+    assert res["chaos_recompiles_post_warmup"] == 0
+    assert res["chaos_recoveries"] >= 1
 
 
 def test_check_bench_regression_comparator():

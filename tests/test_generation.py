@@ -92,7 +92,10 @@ class TestDecodeAttentionKernel:
             assert np.abs(out[0]).max() == 0.0
 
     def test_masked_tail_ignored(self):
-        """Keys past the live length must not influence the output."""
+        """Keys past the live length must not influence the output —
+        even NON-FINITE ones (a quarantined poison request can leave
+        NaN K/V in the slot it vacates; 0 * NaN = NaN would otherwise
+        leak through the masked probabilities into the sum)."""
         S, T, H, D = 1, 8, 2, 4
         rng = jax.random.PRNGKey(1)
         ks = jax.random.split(rng, 3)
@@ -100,11 +103,15 @@ class TestDecodeAttentionKernel:
         k = jax.random.normal(ks[1], (S, H, T, D))
         v = jax.random.normal(ks[2], (S, H, T, D))
         lens = jnp.array([5], jnp.int32)
-        base = np.asarray(decode_attention_xla(q, k, v, lens))
-        k2 = k.at[:, :, 5:].set(99.0)
-        v2 = v.at[:, :, 5:].set(-99.0)
-        poisoned = np.asarray(decode_attention_xla(q, k2, v2, lens))
-        np.testing.assert_allclose(base, poisoned, rtol=1e-6)
+        for tail in (99.0, jnp.nan):
+            for impl in (decode_attention_xla,
+                         lambda *a: decode_attention_pallas(
+                             *a, interpret=True)):
+                base = np.asarray(impl(q, k, v, lens))
+                k2 = k.at[:, :, 5:].set(tail)
+                v2 = v.at[:, :, 5:].set(-tail)
+                poisoned = np.asarray(impl(q, k2, v2, lens))
+                np.testing.assert_allclose(base, poisoned, rtol=1e-6)
 
 
 class TestCachedDecodeLayers:

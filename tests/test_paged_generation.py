@@ -175,13 +175,20 @@ class TestPagedAttentionKernel:
         kernel-level half)."""
         q, kp, vp, tbl, lens = self._setup()
         lens = jnp.array([5, 12, 16], jnp.int32)
-        base = np.asarray(paged_attention_xla(q, kp, vp, tbl, lens))
         # poison row 0's second block beyond position 5 (block 1 of its
-        # table holds positions 4..7 -> offsets 1..3 are dead)
-        kp2 = kp.at[1, :, 2:].set(99.0)
-        vp2 = vp.at[1, :, 2:].set(-99.0)
-        poisoned = np.asarray(paged_attention_xla(q, kp2, vp2, tbl, lens))
-        np.testing.assert_allclose(base[0], poisoned[0], rtol=1e-6)
+        # table holds positions 4..7 -> offsets 1..3 are dead); NaN is
+        # the hard case — a quarantined request's freed blocks keep
+        # their non-finite K/V, and 0 * NaN = NaN would leak through
+        for tail in (99.0, jnp.nan):
+            for impl in (paged_attention_xla,
+                         lambda *a: paged_attention_pallas(
+                             *a, interpret=True)):
+                base = np.asarray(impl(q, kp, vp, tbl, lens))
+                kp2 = kp.at[1, :, 2:].set(tail)
+                vp2 = vp.at[1, :, 2:].set(-tail)
+                poisoned = np.asarray(impl(q, kp2, vp2, tbl, lens))
+                np.testing.assert_allclose(base[0], poisoned[0],
+                                           rtol=1e-6)
 
 
 # ---------------------------------------------------------------------------
@@ -503,10 +510,11 @@ class TestNoZeroingInvariant:
         v = jax.random.normal(ks[2], (S, H, T, D))
         lens = jnp.array([6], jnp.int32)
         base = np.asarray(decode_attention_xla(q, k, v, lens))
-        k2 = k.at[:, :, 6:].set(1e6)
-        v2 = v.at[:, :, 6:].set(-1e6)
-        poisoned = np.asarray(decode_attention_xla(q, k2, v2, lens))
-        np.testing.assert_allclose(base, poisoned, rtol=1e-6)
+        for tail in (1e6, jnp.nan):
+            k2 = k.at[:, :, 6:].set(tail)
+            v2 = v.at[:, :, 6:].set(-tail)
+            poisoned = np.asarray(decode_attention_xla(q, k2, v2, lens))
+            np.testing.assert_allclose(base, poisoned, rtol=1e-6)
 
 
 class TestChunkedPrefillScheduling:
@@ -557,3 +565,45 @@ class TestChunkedPrefillScheduling:
         span = max(31 + 1, plan[-1][0] + plan[-1][1])
         assert pow2_bucket(blocks_for(span, 8)) <= eng._tbl_top
         eng.stop()
+
+
+class TestPagedStreamDisconnect:
+    """Mid-stream client disconnect on the PAGED backend (ISSUE 4
+    satellite — the slot backend's coverage lives in
+    test_generation.py): closing a stream() iterator must free the
+    request's BLOCKS promptly, not just its slot. Reuses the shared
+    warmed module engine; each test starts and ends with an idle
+    engine and a full pool."""
+
+    def test_dropped_stream_frees_blocks(self, lm, paged_engine):
+        eng = paged_engine
+        cap = eng._allocator.capacity
+        errs0 = eng.metrics.server_errors
+        it = eng.stream([1, 2, 3], max_tokens=25, temperature=0.5)
+        next(it)            # stream is live, blocks are claimed...
+        assert eng._allocator.free_count < cap
+        it.close()          # ...then the client hangs up
+        deadline = time.time() + 5.0
+        while eng._allocator.free_count < cap and time.time() < deadline:
+            time.sleep(0.01)
+        # the scheduler released slot AND blocks at the next step —
+        # long before the abandoned request's max_tokens would have
+        assert eng._allocator.free_count == cap
+        assert eng._slots.active_count == 0
+        # pool fully reusable afterwards
+        r = eng.generate([1, 2, 3], max_tokens=3)
+        assert r["tokens"] == _ref_greedy(lm, [1, 2, 3], 3)
+        assert eng.metrics.server_errors == errs0
+
+    def test_never_started_paged_stream_releases_blocks(
+            self, paged_engine):
+        eng = paged_engine
+        cap = eng._allocator.capacity
+        it = eng.stream([1, 2], max_tokens=25, temperature=0.5)
+        it.close()          # consumer never called next()
+        deadline = time.time() + 5.0
+        while (eng._allocator.free_count < cap
+               or eng._slots.active_count) and time.time() < deadline:
+            time.sleep(0.01)
+        assert eng._allocator.free_count == cap
+        assert eng._slots.active_count == 0
