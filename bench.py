@@ -739,6 +739,113 @@ print(json.dumps({"model": "Word2Vec SG-NS (text8-scale synthetic)",
                   "wall_seconds": round(dt, 1)}))
 """
 
+TRAINING_CHAOS_CODE = _COMMON + r"""
+# Resilient-training chaos probe (ISSUE 5): steps/sec through the
+# supervised step loop with ~1% injected transient step faults, an
+# async step-granular checkpoint cadence against an injected-slow
+# disk, and ONE scripted preemption mid-run followed by restart +
+# resume. The gated number is chaos steps/sec END TO END — retries,
+# checkpoint stalls, the preemption's synchronous flush, the restart's
+# recompile, and the resume fast-forward all land inside the timed
+# window, because that is the throughput a preemptible-TPU training
+# job actually delivers. Correctness bar: the resumed run's final
+# params are BIT-IDENTICAL to an uninterrupted clean run of the same
+# schedule (CPU-JAX by design — the acceptance regime, same as the
+# serving scenarios).
+import tempfile
+from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+from deeplearning4j_tpu.faults import FaultInjector, PreemptionFault
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel.elastic import FaultTolerantTrainer
+
+EPOCHS = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+N, BATCH, DIN = 8192, 128, 64          # 64 steps per epoch
+STEPS_PER_EPOCH = N // BATCH
+TOTAL_STEPS = EPOCHS * STEPS_PER_EPOCH
+
+def build():
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-3))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=128, activation="tanh"))
+            .layer(DenseLayer(n_out=64, activation="tanh"))
+            .layer(OutputLayer(n_out=10, loss="mcxent",
+                               activation="softmax"))
+            .input_type_feed_forward(DIN).build())
+    return MultiLayerNetwork(conf).init()
+
+rs = np.random.RandomState(0)
+X = rs.rand(N, DIN).astype(np.float32)
+Y = np.eye(10, dtype=np.float32)[rs.randint(0, 10, N)]
+
+def it():
+    # shuffle on: resume must replay the dead run's exact order
+    return ArrayDataSetIterator(X, Y, batch=BATCH, shuffle=True, seed=3)
+
+# -- clean reference: the same supervised loop + checkpoint cadence,
+# no injector (compile inside the window, symmetric with chaos)
+clean_dir = tempfile.mkdtemp(prefix="bench_tchaos_clean_")
+m_clean = build()
+t0 = time.perf_counter()
+FaultTolerantTrainer(m_clean, clean_dir,
+                     save_every_n_steps=50).fit(it(), epochs=EPOCHS)
+clean_dt = time.perf_counter() - t0
+
+# -- chaos run: ~1% transient step faults + 20ms-slow checkpoint disk
+# + a scripted preemption at the midpoint, then restart and resume
+chaos_dir = tempfile.mkdtemp(prefix="bench_tchaos_")
+
+def injector():
+    return FaultInjector(seed=0, rates={"train_step": 0.01,
+                                        "checkpoint_io": 1.0},
+                         slow_ms={"checkpoint_io": 20.0},
+                         plan={"preempt": [TOTAL_STEPS // 2]})
+
+t0 = time.perf_counter()
+m1 = build()
+tr1 = FaultTolerantTrainer(m1, chaos_dir, save_every_n_steps=50,
+                           fault_injector=injector())
+try:
+    tr1.fit(it(), epochs=EPOCHS)
+    preempted = False
+except PreemptionFault:
+    preempted = True
+# "restart": fresh process state — resume the checkpoint, new trainer,
+# new injector whose preempt plan is already spent at this call count
+m2 = FaultTolerantTrainer.resume(chaos_dir)
+inj2 = FaultInjector(seed=0, rates={"train_step": 0.01,
+                                    "checkpoint_io": 1.0},
+                     slow_ms={"checkpoint_io": 20.0})
+tr2 = FaultTolerantTrainer(m2, chaos_dir, save_every_n_steps=50,
+                           fault_injector=inj2)
+tr2.fit(it(), epochs=EPOCHS)
+chaos_dt = time.perf_counter() - t0
+
+leaves = lambda m: jax.tree_util.tree_leaves(m._params)
+identical = all(bool(np.array_equal(np.asarray(a), np.asarray(b)))
+                for a, b in zip(leaves(m_clean), leaves(m2)))
+f1, f2 = tr1.faults_snapshot(), tr2.faults_snapshot()
+d = jax.devices()[0]
+print(json.dumps({
+    "model": f"MLP d{DIN} supervised training "
+             f"({TOTAL_STEPS} steps, 1% step faults, 1 preemption)",
+    "platform": d.platform, "device_kind": d.device_kind,
+    "steps_per_sec": round(TOTAL_STEPS / chaos_dt, 1),
+    "clean_steps_per_sec": round(TOTAL_STEPS / clean_dt, 1),
+    "chaos_vs_clean": round(clean_dt / chaos_dt, 3),
+    "total_steps": int(m2._step),
+    "preempted": preempted,
+    "retries": f1["retries"] + f2["retries"],
+    "preemptions": f1["preemptions"],
+    "async_checkpoints": f1["async_checkpoints"] + f2["async_checkpoints"],
+    "sync_checkpoints": f1["sync_checkpoints"] + f2["sync_checkpoints"],
+    "checkpoint_stall_s": round(f1["checkpoint_stall_s"]
+                                + f2["checkpoint_stall_s"], 4),
+    "params_identical_to_clean": identical,
+    "synthetic_data": True}))
+"""
+
 
 def _run(code, env_extra, timeout, argv=()):
     env = dict(os.environ)
@@ -949,6 +1056,22 @@ def main():
                                      "chaos_requests_lost",
                                      "chaos_recompiles_post_warmup")
                                     if k in gen}
+        # resilient-training chaos probe: supervised step loop absorbing
+        # ~1% transient step faults + one scripted preemption/resume
+        # (CPU-JAX by design — the acceptance regime)
+        tc = _run(TRAINING_CHAOS_CODE, _CPU_ENV, timeout=900)
+        if tc:
+            extras["training_chaos"] = {k: tc[k] for k in
+                                        ("model", "steps_per_sec",
+                                         "clean_steps_per_sec",
+                                         "chaos_vs_clean",
+                                         "total_steps", "preempted",
+                                         "retries", "preemptions",
+                                         "async_checkpoints",
+                                         "sync_checkpoints",
+                                         "checkpoint_stall_s",
+                                         "params_identical_to_clean")
+                                        if k in tc}
     # static cost model (tools/perf_audit.py — chip-independent): the
     # roofline predictions the measured numbers are judged against
     # (VERDICT r4 #2). Committed JSON, so this costs no compile time.

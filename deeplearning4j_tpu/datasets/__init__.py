@@ -122,6 +122,20 @@ class ArrayDataSetIterator(DataSetIterator):
     def total_examples(self) -> int:
         return self.features.shape[0]
 
+    # -- replay cursor (resilient-training checkpoints) ----------------
+    def state_dict(self) -> dict:
+        """Everything a bit-exact resume needs to REPLAY this
+        iterator's stream: just the reset counter — the shuffle
+        permutation for a pass is a pure function of (seed, _epoch),
+        and the in-pass position is tracked by the training loop as a
+        batch count (robust to prefetch wrappers running ahead of the
+        consumer). Captured by FaultTolerantTrainer at each epoch
+        start, BEFORE the epoch's reset()."""
+        return {"epoch": int(self._epoch)}
+
+    def load_state_dict(self, state: dict):
+        self._epoch = int(state.get("epoch", self._epoch))
+
 
 class AsyncDataSetIterator(DataSetIterator):
     """Background-thread prefetch wrapper (ref: AsyncDataSetIterator —
@@ -194,6 +208,16 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def batch_size(self) -> int:
         return self.base.batch_size()
+
+    # replay cursor delegates to the base iterator; only the reset
+    # counter matters, so the prefetch queue's head-start is irrelevant
+    def state_dict(self) -> dict:
+        return (self.base.state_dict()
+                if hasattr(self.base, "state_dict") else {})
+
+    def load_state_dict(self, state: dict):
+        if hasattr(self.base, "load_state_dict"):
+            self.base.load_state_dict(state)
 
 
 # ---------------------------------------------------------------------------

@@ -43,6 +43,24 @@ def _clip_grads(grads, max_norm, clip_value):
     return grads
 
 
+def _finite_ok(loss, grads):
+    """Scalar bool: loss and EVERY gradient leaf finite (the in-graph
+    anomaly flag of the guarded train step — one fused reduction per
+    leaf, no host sync)."""
+    ok = jnp.all(jnp.isfinite(loss))
+    for g in jax.tree_util.tree_leaves(grads):
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g)))
+    return ok
+
+
+def _select_ok(ok, new, old):
+    """Per-leaf `where(ok, new, old)` — when ok is True this is the
+    new value BITWISE (XLA select of identical shapes), which is what
+    makes guarded and unguarded clean runs trajectory-identical."""
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(ok, n, o), new, old)
+
+
 def _regularization_penalty(params, layers_meta):
     """Ref: BaseMultiLayerUpdater.preApply :395 — L1/L2 penalty over layer
     params; biases use the *_bias coefficients."""
@@ -244,9 +262,20 @@ class MultiLayerNetwork:
         return data_loss + reg, (new_state, new_carries)
 
     # -- the one true train step (jitted) ------------------------------
-    def _make_step_fn(self):
+    def _make_step_fn(self, guard: bool = False):
         """The raw (un-jitted) pure train-step function — also consumed by
-        parallel.ParallelWrapper, which jits it with mesh shardings."""
+        parallel.ParallelWrapper, which jits it with mesh shardings.
+
+        ``guard=True`` compiles in the anomaly guard (the training
+        analog of serving's poison quarantine): the step additionally
+        returns a scalar ``ok`` flag — loss AND every gradient leaf
+        finite — and when ``ok`` is False every state output is the
+        in-graph-selected ORIGINAL (params, updater state, net state
+        unchanged), so one NaN/Inf batch can never corrupt the run.
+        The select is `jnp.where(True, new, old) == new` bitwise, so a
+        guarded and unguarded run over clean data produce identical
+        trajectories. Chosen at build time: one extra compile at
+        warmup, zero recompiles after."""
         updaters = self._updaters
         layer_keys = self._layer_keys
         max_norm = self.conf.max_grad_norm
@@ -262,6 +291,8 @@ class MultiLayerNetwork:
             (loss, (new_net_state, _)), grads = jax.value_and_grad(
                 lambda p: self._loss_fn(p, net_state, x, y, mask, True, rng),
                 has_aux=True)(params)
+            if guard:
+                ok = _finite_ok(loss, grads)
             grads = _clip_grads(grads, max_norm, clip_value)
             new_opt = {}
             new_params = {}
@@ -278,12 +309,18 @@ class MultiLayerNetwork:
                     new_p = apply_constraints(layers[i].constraints, new_p,
                                               layers[i].bias_param_names())
                 new_params[key] = new_p
+            if guard:
+                new_params = _select_ok(ok, new_params, params)
+                new_opt = _select_ok(ok, new_opt, opt_state)
+                new_net_state = _select_ok(ok, new_net_state, net_state)
+                return new_params, new_opt, new_net_state, loss, ok
             return new_params, new_opt, new_net_state, loss
 
         return step_fn
 
-    def _make_step(self):
-        return jax.jit(self._make_step_fn(), donate_argnums=(0, 1, 2))
+    def _make_step(self, guard: bool = False):
+        return jax.jit(self._make_step_fn(guard=guard),
+                       donate_argnums=(0, 1, 2))
 
     def _make_tbptt_step(self):
         """Truncated-BPTT chunk step: like the regular step but threads RNN

@@ -80,20 +80,27 @@ def batch_sharded(mesh: Mesh, axis: str = "data") -> NamedSharding:
     return NamedSharding(mesh, P(axis))
 
 
-def jit_sharded_step(model, mesh: Mesh, axis: str = "data"):
+def jit_sharded_step(model, mesh: Mesh, axis: str = "data",
+                     guard: bool = False):
     """THE data-parallel jit contract for a model training step —
     params/opt/net state replicated (and donated), batch sharded over
     `axis`. Single definition shared by ParallelWrapper (single-host)
     and parallel.multihost (cross-process mesh) so the step-fn
-    signature's sharding map lives in exactly one place."""
+    signature's sharding map lives in exactly one place.
+
+    ``guard=True`` compiles the anomaly-guarded step variant (trailing
+    in-graph ``ok`` output; see MultiLayerNetwork._make_step_fn) — a
+    build-time choice, so the supervised training loop adds zero
+    post-warmup recompiles."""
     if model._params is None:
         model.init()
     repl = replicated(mesh)
     data = batch_sharded(mesh, axis)
+    outs = (repl, repl, repl, None) + ((None,) if guard else ())
     return jax.jit(
-        model._make_step_fn(),
+        model._make_step_fn(guard=guard),
         in_shardings=(repl, repl, repl, repl, data, data, None, repl),
-        out_shardings=(repl, repl, repl, None),
+        out_shardings=outs,
         donate_argnums=(0, 1, 2))
 
 
@@ -211,21 +218,131 @@ class ParallelWrapper:
         self.prefetch_buffer = prefetch_buffer
         self.accumulator = accumulator
         self._sharded_step = None
+        self._step_cache = {}   # guard flag -> compiled step
 
     @property
     def num_workers(self) -> int:
         return int(self.mesh.shape["data"])
 
-    def _build_step(self):
+    def _build_step(self, guard: bool = False):
         m = self.model
         if m._params is None:
             m.init()
+        self._sharded_step_guard = guard
         if self.accumulator is not None:
-            self._sharded_step = self._build_compressed_step()
-            return
-        self._sharded_step = jit_sharded_step(m, self.mesh)
+            self._sharded_step = self._build_compressed_step(guard=guard)
+        else:
+            self._sharded_step = jit_sharded_step(m, self.mesh,
+                                                  guard=guard)
+        self._step_cache[guard] = self._sharded_step
 
-    def _build_compressed_step(self):
+    def ensure_step(self, guard: bool = False):
+        """The compiled sharded step for this wrapper, built once PER
+        GUARD VARIANT and cached — the resilient trainer's entry point.
+        Alternating a guarded trainer fit with a plain wrapper fit must
+        swap between the two cached programs, not recompile the sharded
+        step on every flip."""
+        cached = self._step_cache.get(guard)
+        if cached is None:
+            self._build_step(guard=guard)
+        else:
+            self._sharded_step = cached
+            self._sharded_step_guard = guard
+        return self._sharded_step
+
+    # -- resilient-training state hooks --------------------------------
+    def extra_checkpoint_state(self):
+        """Flat ``{key: host ndarray}`` of the gradient-sharing
+        accumulator's carried device state (per-worker residuals,
+        adaptive threshold, and — in update mode — per-worker updater
+        moments). Ridden into every resilient checkpoint so a resumed
+        run replays the compressed trajectory bit-exactly; ``None``
+        when there is nothing beyond the model to save."""
+        acc = self.accumulator
+        if acc is None or acc.residuals is None:
+            return None
+        from ..util.serializer import _flatten_tree
+        flat = {f"gradient_sharing/residuals/{k}": v
+                for k, v in _flatten_tree(acc.residuals).items()}
+        flat["gradient_sharing/threshold"] = np.array(acc.threshold,
+                                                      copy=True)
+        flat["gradient_sharing/last_sparsity"] = np.array(
+            acc.last_sparsity, copy=True)
+        if acc.opt_state is not None:
+            flat.update({f"gradient_sharing/opt_state/{k}": v
+                         for k, v in _flatten_tree(acc.opt_state).items()})
+        return flat
+
+    def load_extra_checkpoint_state(self, flat):
+        """Inverse of :meth:`extra_checkpoint_state`: restore the
+        accumulator's device state from a checkpoint/rollback
+        snapshot. Requires the carried state to exist already (the
+        step builder initializes it, consuming ``model._resume_extra``
+        on first build after a resume)."""
+        acc = self.accumulator
+        if acc is None or acc.residuals is None or not flat:
+            return
+        from ..util.serializer import _unflatten_like
+        gs = {k[len("gradient_sharing/"):]: v for k, v in flat.items()
+              if k.startswith("gradient_sharing/")}
+        if not gs:
+            return
+        data_sh = NamedSharding(self.mesh, P("data"))
+        res_flat = {k[len("residuals/"):]: v for k, v in gs.items()
+                    if k.startswith("residuals/")}
+        if res_flat:
+            acc.residuals = jax.device_put(
+                _unflatten_like(acc.residuals, res_flat), data_sh)
+        if "threshold" in gs:
+            acc.threshold = jnp.asarray(np.asarray(gs["threshold"]),
+                                        jnp.float32)
+        if "last_sparsity" in gs:
+            acc.last_sparsity = jnp.asarray(
+                np.asarray(gs["last_sparsity"]), jnp.float32)
+        opt_flat = {k[len("opt_state/"):]: v for k, v in gs.items()
+                    if k.startswith("opt_state/")}
+        if opt_flat and acc.opt_state is not None:
+            acc.opt_state = jax.device_put(
+                _unflatten_like(acc.opt_state, opt_flat), data_sh)
+
+    def _init_accumulator_state(self, per_worker_opt: bool):
+        """First-build installation of the accumulator's carried device
+        state (zeros / broadcast templates), then overlay any resume
+        state a restored checkpoint left on the model — so a
+        ``FaultTolerantTrainer.resume()`` + fresh wrapper continues the
+        compressed run with the exact residuals/moments it died with."""
+        m, acc, mesh, ndev = (self.model, self.accumulator, self.mesh,
+                              self.num_workers)
+        # commit the model state (and the scalar carries below) to the
+        # mesh NOW: otherwise the step's first dispatch sees
+        # uncommitted host arrays and every later one sees committed
+        # outputs — two pjit cache signatures for one program
+        repl_sh = NamedSharding(mesh, P())
+        m._params = jax.device_put(m._params, repl_sh)
+        if m._opt_state is not None:
+            m._opt_state = jax.device_put(m._opt_state, repl_sh)
+        if m._net_state:
+            m._net_state = jax.device_put(m._net_state, repl_sh)
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros((ndev,) + p.shape, p.dtype), m._params)
+        acc.residuals = jax.device_put(
+            zeros, NamedSharding(mesh, P("data")))
+        acc.threshold = jax.device_put(
+            jnp.asarray(acc.initial_threshold, jnp.float32), repl_sh)
+        acc.last_sparsity = jax.device_put(
+            jnp.asarray(0.0, jnp.float32), repl_sh)
+        if per_worker_opt:
+            acc.opt_state = jax.device_put(
+                jax.tree_util.tree_map(
+                    lambda s: jnp.broadcast_to(s, (ndev,) + s.shape),
+                    m._opt_state),
+                NamedSharding(mesh, P("data")))
+        resume = getattr(m, "_resume_extra", None)
+        if resume:
+            self.load_extra_checkpoint_state(dict(resume))
+            m._resume_extra = None   # consumed
+
+    def _build_compressed_step(self, guard: bool = False):
         """Compile the gradient-sharing step with the reference's
         UPDATE-domain pipeline (`StochasticGradientDescent.java:52-93`):
         per-worker local grads -> LOCAL updater (per-worker state) ->
@@ -251,29 +368,19 @@ class ParallelWrapper:
         ndev = self.num_workers
         updaters, layer_keys = m._updaters, m._layer_keys
         layers = m.layers
-        from ..nn.multilayer import _clip_grads
+        from ..nn.multilayer import _clip_grads, _finite_ok, _select_ok
         max_norm = m.conf.max_grad_norm
         clip_value = m.conf.grad_clip_value
 
         if acc.mode == "gradient":
-            return self._build_gradient_compressed_step()
+            return self._build_gradient_compressed_step(guard=guard)
 
         # per-worker state: one leading device axis, sharded over "data"
         # (each worker owns its residual AND its updater state — ref:
         # EncodingHandler per-worker residual carry; the reference's
         # workers likewise run their own updaters before encoding)
         if acc.residuals is None:
-            zeros = jax.tree_util.tree_map(
-                lambda p: jnp.zeros((ndev,) + p.shape, p.dtype), m._params)
-            acc.residuals = jax.device_put(
-                zeros, NamedSharding(mesh, P("data")))
-            acc.threshold = jnp.asarray(acc.initial_threshold, jnp.float32)
-            acc.last_sparsity = jnp.asarray(0.0, jnp.float32)
-            acc.opt_state = jax.device_put(
-                jax.tree_util.tree_map(
-                    lambda s: jnp.broadcast_to(s, (ndev,) + s.shape),
-                    m._opt_state),
-                NamedSharding(mesh, P("data")))
+            self._init_accumulator_state(per_worker_opt=True)
 
         def worker_step(params, opt_state, net_state, residual, threshold,
                         step, x, y, mask, rng):
@@ -282,6 +389,13 @@ class ParallelWrapper:
             (loss, (new_net_state, _)), grads = jax.value_and_grad(
                 lambda p: m._loss_fn(p, net_state, x, y, mask, True, rng),
                 has_aux=True)(params)
+            if guard:
+                # the anomaly flag must be GLOBAL: one worker's NaN
+                # shard poisons the pmean for everyone, so all workers
+                # must agree to skip (pmin = logical AND across the
+                # data axis)
+                ok = lax.pmin(_finite_ok(loss, grads).astype(jnp.int32),
+                              "data") > 0
             grads = _clip_grads(grads, max_norm, clip_value)
             # LOCAL updater first (update-domain quantization)
             local_opt = jax.tree_util.tree_map(lambda a: a[0], opt_state)
@@ -325,18 +439,44 @@ class ParallelWrapper:
                                               layers[i].bias_param_names())
                 new_params[key] = new_p
             new_opt = jax.tree_util.tree_map(lambda a: a[None], new_opt)
+            if guard:
+                # in-graph skip, residual INCLUDED (the gradient-
+                # sharing analog of serving's quarantine residue): a
+                # NaN batch must not leak into the error-feedback carry
+                # any more than into params or moments
+                new_params = _select_ok(ok, new_params, params)
+                new_opt = _select_ok(ok, new_opt, opt_state)
+                new_net_state = _select_ok(ok, new_net_state, net_state)
+                new_residual = _select_ok(ok, new_residual, residual)
+                new_threshold = jnp.where(ok, new_threshold, threshold)
+                return (new_params, new_opt, new_net_state, new_residual,
+                        new_threshold, sparsity, loss, ok)
             return (new_params, new_opt, new_net_state, new_residual,
                     new_threshold, sparsity, loss)
 
         repl = P()
         data = P("data")
+        # explicit in_shardings (mirroring jit_sharded_step): without
+        # them the FIRST call sees uncommitted host arrays and later
+        # calls see the jit's committed outputs — two dispatch
+        # signatures, two compiles of the same program
+        rs, ds = NamedSharding(mesh, repl), NamedSharding(mesh, data)
         sharded = jax.jit(
             shard_map_compat(
                 worker_step, mesh=mesh,
                 in_specs=(repl, data, repl, data, repl, repl, data, data,
                           data, repl),
-                out_specs=(repl, data, repl, data, repl, repl, repl),
+                out_specs=(repl, data, repl, data, repl, repl, repl)
+                + ((repl,) if guard else ()),
                 check_vma=False),
+            in_shardings=(rs, ds, rs, ds, rs, rs, ds, ds, None, rs),
+            # out_shardings mirror the specs so carried outputs
+            # (opt_state/residuals/threshold) feed back into the next
+            # call with the EXACT sharding the signature expects —
+            # XLA normalizes P("data") to P() on a 1-device axis,
+            # which would otherwise mint a second cache entry
+            out_shardings=(rs, ds, rs, ds, rs, rs, rs)
+            + ((rs,) if guard else ()),
             donate_argnums=(0, 1, 2, 3))
 
         def step_like(params, opt_state, net_state, step, x, y, mask, rng):
@@ -346,17 +486,21 @@ class ParallelWrapper:
             # preemption checkpoint taken mid-fit — PreemptionHandler
             # fires between steps, before fit() returns — never pairs
             # advanced params/_step with stale Adam moments
-            (new_params, acc.opt_state, new_net, acc.residuals,
-             acc.threshold, acc.last_sparsity, loss) = sharded(
+            out = sharded(
                 params, acc.opt_state, net_state, acc.residuals,
                 acc.threshold, step, x, y, mask, rng)
+            (new_params, acc.opt_state, new_net, acc.residuals,
+             acc.threshold, acc.last_sparsity, loss) = out[:7]
             ckpt_opt = jax.tree_util.tree_map(lambda a: a[0],
                                               acc.opt_state)
+            if guard:
+                return new_params, ckpt_opt, new_net, loss, out[7]
             return new_params, ckpt_opt, new_net, loss
 
+        step_like._jit = sharded  # recompile introspection for tests
         return step_like
 
-    def _build_gradient_compressed_step(self):
+    def _build_gradient_compressed_step(self, guard: bool = False):
         """Compile the TPU-native ``mode="gradient"`` pipeline: per-worker
         local grads -> (+ residual) -> threshold-fire with TRUE values
         (`compression.strom_value_encode_decode`) -> pmean(decoded) ->
@@ -374,24 +518,23 @@ class ParallelWrapper:
         ndev = self.num_workers
         updaters, layer_keys = m._updaters, m._layer_keys
         layers = m.layers
-        from ..nn.multilayer import _clip_grads
+        from ..nn.multilayer import _clip_grads, _finite_ok, _select_ok
         max_norm = m.conf.max_grad_norm
         clip_value = m.conf.grad_clip_value
 
         # per-worker residual carry only; updater state stays replicated
         if acc.residuals is None:
-            zeros = jax.tree_util.tree_map(
-                lambda p: jnp.zeros((ndev,) + p.shape, p.dtype), m._params)
-            acc.residuals = jax.device_put(
-                zeros, NamedSharding(mesh, P("data")))
-            acc.threshold = jnp.asarray(acc.initial_threshold, jnp.float32)
-            acc.last_sparsity = jnp.asarray(0.0, jnp.float32)
+            self._init_accumulator_state(per_worker_opt=False)
 
         def worker_step(params, opt_state, net_state, residual, threshold,
                         step, x, y, mask, rng):
             (loss, (new_net_state, _)), grads = jax.value_and_grad(
                 lambda p: m._loss_fn(p, net_state, x, y, mask, True, rng),
                 has_aux=True)(params)
+            if guard:
+                # global agreement, same rationale as update mode
+                ok = lax.pmin(_finite_ok(loss, grads).astype(jnp.int32),
+                              "data") > 0
             grads = _clip_grads(grads, max_norm, clip_value)
             flat_g, treedef = jax.tree_util.tree_flatten(grads)
             flat_r = treedef.flatten_up_to(residual)
@@ -426,27 +569,52 @@ class ParallelWrapper:
                     new_p = apply_constraints(layers[i].constraints, new_p,
                                               layers[i].bias_param_names())
                 new_params[key] = new_p
+            if guard:
+                # skip selects the residual too — error feedback must
+                # not accumulate a NaN batch's firings
+                new_params = _select_ok(ok, new_params, params)
+                new_opt = _select_ok(ok, new_opt, opt_state)
+                new_net_state = _select_ok(ok, new_net_state, net_state)
+                new_residual = _select_ok(ok, new_residual, residual)
+                new_threshold = jnp.where(ok, new_threshold, threshold)
+                return (new_params, new_opt, new_net_state, new_residual,
+                        new_threshold, sparsity, loss, ok)
             return (new_params, new_opt, new_net_state, new_residual,
                     new_threshold, sparsity, loss)
 
         repl = P()
         data = P("data")
+        # explicit in_shardings for one dispatch signature across
+        # uncommitted first-call inputs and committed outputs (see
+        # the update-mode builder)
+        rs, ds = NamedSharding(mesh, repl), NamedSharding(mesh, data)
         sharded = jax.jit(
             shard_map_compat(
                 worker_step, mesh=mesh,
                 in_specs=(repl, repl, repl, data, repl, repl, data, data,
                           data, repl),
-                out_specs=(repl, repl, repl, data, repl, repl, repl),
+                out_specs=(repl, repl, repl, data, repl, repl, repl)
+                + ((repl,) if guard else ()),
                 check_vma=False),
+            in_shardings=(rs, rs, rs, ds, rs, rs, ds, ds, None, rs),
+            # mirror out_specs (see the update-mode builder: 1-device
+            # P("data") outputs normalize to P() and would re-key the
+            # dispatch cache on the next call)
+            out_shardings=(rs, rs, rs, ds, rs, rs, rs)
+            + ((rs,) if guard else ()),
             donate_argnums=(0, 1, 2, 3))
 
         def step_like(params, opt_state, net_state, step, x, y, mask, rng):
-            (new_params, new_opt, new_net, acc.residuals, acc.threshold,
-             acc.last_sparsity, loss) = sharded(
+            out = sharded(
                 params, opt_state, net_state, acc.residuals,
                 acc.threshold, step, x, y, mask, rng)
+            (new_params, new_opt, new_net, acc.residuals, acc.threshold,
+             acc.last_sparsity, loss) = out[:7]
+            if guard:
+                return new_params, new_opt, new_net, loss, out[7]
             return new_params, new_opt, new_net, loss
 
+        step_like._jit = sharded  # recompile introspection for tests
         return step_like
 
     def fit(self, iterator, epochs: int = 1):
@@ -458,8 +626,10 @@ class ParallelWrapper:
         m = self.model
         if m._params is None:
             m.init()
-        if self._sharded_step is None:
-            self._build_step()
+        # ensure the UNGUARDED variant: a trainer may have cached the
+        # guarded step (5 outputs) on this wrapper, and fit()'s 4-value
+        # unpack in MultiLayerNetwork.fit would blow up on it
+        self.ensure_step(guard=False)
         from ..datasets import AsyncDataSetIterator, DataSetIterator
         if (self.prefetch_buffer and isinstance(iterator, DataSetIterator)
                 and not isinstance(iterator, AsyncDataSetIterator)):

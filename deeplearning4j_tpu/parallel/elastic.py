@@ -12,17 +12,48 @@ updater state + step/epoch counters, the reference's completeness bar),
 with atomic rename so a preemption mid-write never corrupts the latest
 checkpoint, and rotation (keep_last) like CheckpointListener
 (:164-189).
+
+Beyond the reference's epoch-granular bar, the trainer runs a
+RESILIENT step mode (CheckFreq / Bamboo / Varuna shapes; see
+:mod:`.resilience`):
+
+- **step-granular asynchronous checkpoints** (``save_every_n_steps``):
+  the step loop pays only the device→host snapshot; serialization +
+  fsync + atomic rename run on a background thread, at most one write
+  in flight. Checkpoints capture everything BIT-EXACT resume needs —
+  step/epoch counters, the model PRNG key, the data-iterator replay
+  cursor, and out-of-model state like the gradient-sharing
+  accumulator's residuals — so kill-at-step-k + ``resume()`` replays
+  the exact parameter trajectory of the uninterrupted run.
+- **a supervised step loop** (``fault_injector=``, ``anomaly_guard=``):
+  transient step faults retried with bounded backoff; an in-graph
+  finite-grads/loss guard that skips-and-counts anomalous batches and
+  rolls back to the last good in-memory snapshot after K consecutive
+  anomalies (the training analog of serving's poison quarantine).
+- **step-granular preemption**: SIGTERM mid-epoch flushes a checkpoint
+  at the next STEP boundary (not the next epoch), via the same
+  flip-a-flag-in-the-handler / do-the-work-outside treatment as the
+  serving SIGTERM wiring.
 """
 from __future__ import annotations
 
+import contextlib
 import glob
 import os
 import re
 import signal
 import threading
+import time
 from typing import Callable, List, Optional
 
-from ..util.serializer import ModelSerializer
+import jax
+import jax.numpy as jnp
+
+from ..faults import (FaultInjector, PreemptionFault,  # noqa: F401
+                      TransientFault)
+from ..util.serializer import ModelSerializer, snapshot_training_state
+from .resilience import (AsyncCheckpointWriter, TrainingAnomalyError,
+                         TrainingSupervisor)
 
 
 def _pid_alive(pid: int) -> bool:
@@ -37,114 +68,527 @@ def _pid_alive(pid: int) -> bool:
     return True
 
 
+#: completed-checkpoint filename filter AND sort key. Matches both the
+#: epoch-boundary form (`checkpoint_epoch3.zip` = 3 epochs done) and
+#: the step-granular form (`checkpoint_epoch3_step120.zip` = mid
+#: epoch-index 3, 120 optimizer steps done). Sorting by (epoch, step)
+#: is chronological: a mid-epoch-3 checkpoint (3, S) sits after the
+#: epoch-3 boundary (3, 0) and before the epoch-4 boundary (4, 0).
+_CKPT_RE = re.compile(r"checkpoint_epoch(\d+)(?:_step(\d+))?\.zip$")
+
+
 class FaultTolerantTrainer:
     """Train with periodic whole-state checkpoints; resume picks up at
-    the last completed checkpoint."""
+    the last completed checkpoint.
+
+    Epoch mode (default, the original surface)::
+
+        FaultTolerantTrainer(model, ckpt_dir).fit(it, epochs=100)
+
+    Resilient step mode — any of ``save_every_n_steps``,
+    ``fault_injector`` or ``anomaly_guard`` switches :meth:`fit` to
+    the supervised step loop::
+
+        inj = FaultInjector(rates={"train_step": 0.01})
+        tr = FaultTolerantTrainer(model, ckpt_dir,
+                                  save_every_n_steps=50,
+                                  fault_injector=inj,
+                                  anomaly_guard=True)
+        try:
+            tr.fit(it, epochs=100)
+        except PreemptionFault:
+            pass            # restart: resume() + fit() continues
+                            # bit-exactly mid-epoch
+
+    Pass ``wrapper=ParallelWrapper(model, ...)`` to run the supervised
+    loop over the wrapper's sharded (optionally compressed) step; the
+    gradient-sharing accumulator's residuals/threshold/per-worker
+    updater moments ride inside every checkpoint and restore on
+    resume."""
 
     def __init__(self, model, checkpoint_dir: str,
-                 save_every_n_epochs: int = 1, keep_last: int = 3):
+                 save_every_n_epochs: int = 1, keep_last: int = 3,
+                 save_every_n_steps: Optional[int] = None,
+                 async_write: bool = True,
+                 fault_injector: Optional[FaultInjector] = None,
+                 max_step_retries: int = 3,
+                 retry_backoff_ms: float = 5.0,
+                 anomaly_guard: bool = False,
+                 rollback_after: int = 3,
+                 snapshot_every_n_steps: Optional[int] = None,
+                 wrapper=None):
         self.model = model
         self.dir = checkpoint_dir
         self.save_every = max(1, save_every_n_epochs)
         self.keep_last = keep_last
+        self.save_every_n_steps = (None if not save_every_n_steps
+                                   else max(1, int(save_every_n_steps)))
+        self.async_write = bool(async_write)
+        self.injector = fault_injector
+        self.wrapper = wrapper
+        if wrapper is not None and wrapper.model is not model:
+            raise ValueError("wrapper.model must be the trainer's model")
+        self._step_mode = bool(self.save_every_n_steps
+                               or fault_injector is not None
+                               or anomaly_guard)
+        self.supervisor = TrainingSupervisor(
+            fault_injector=fault_injector,
+            max_step_retries=max_step_retries,
+            retry_backoff_ms=retry_backoff_ms,
+            anomaly_guard=anomaly_guard,
+            rollback_after=rollback_after)
+        # rollback-snapshot cadence: default to the disk cadence (the
+        # same host copy feeds both); a guarded run with no disk
+        # cadence still needs a rollback source, so it snapshots every
+        # good step — but an injector-only run (no guard, no disk
+        # cadence) has NO consumer for the copy, so it takes none: a
+        # device→host copy of the full state per step is not "zero
+        # overhead when no anomaly can ever fire"
+        self.snapshot_every_n_steps = (
+            max(1, int(snapshot_every_n_steps))
+            if snapshot_every_n_steps
+            else (self.save_every_n_steps or (1 if anomaly_guard
+                                              else None)))
+        self._writer: Optional[AsyncCheckpointWriter] = None
+        self._step_fns = {}
+        # preemption coordination (PreemptionHandler + preempt seam)
+        self._loop_active = False
+        self._preempt_requested = threading.Event()
+        self._preempt_handler = None
+        self._preempt_signum = None
+        self._batches_done = 0
+        self._epoch_it_state = None
         os.makedirs(checkpoint_dir, exist_ok=True)
 
     # -- checkpoint management -----------------------------------------
     def _ckpt_path(self, epoch: int) -> str:
         return os.path.join(self.dir, f"checkpoint_epoch{epoch}.zip")
 
+    def _step_ckpt_path(self, epoch: int, step: int) -> str:
+        return os.path.join(
+            self.dir, f"checkpoint_epoch{epoch}_step{step}.zip")
+
     @staticmethod
     def list_checkpoints(directory: str) -> List[str]:
         """Completed checkpoints only, oldest -> newest. The regex is a
         FULL filename filter, not just a sort key: temp files from an
-        interrupted _save (``*.zip.tmp.*``) and any stray file must
+        interrupted write (``*.zip.tmp.*``) and any stray file must
         never be listed — resume() loads the last entry, and keep-last
         pruning deletes the first ones."""
-        pat = re.compile(r"checkpoint_epoch(\d+)\.zip$")
         paths = [p for p in
                  glob.glob(os.path.join(directory, "checkpoint_epoch*.zip"))
-                 if pat.search(p)]
-        return sorted(paths, key=lambda p: int(pat.search(p).group(1)))
+                 if _CKPT_RE.search(p)]
 
-    def _save(self, epoch: int):
+        def key(p):
+            m = _CKPT_RE.search(p)
+            return (int(m.group(1)), int(m.group(2) or 0))
+        return sorted(paths, key=key)
+
+    def _write_atomic(self, snap: dict, path: str):
+        """One durable checkpoint write: pid-unique temp IN the
+        checkpoint directory, data fsync, atomic rename, directory
+        fsync, then rotation + stale-temp sweep. Fires the
+        ``checkpoint_io`` seam (bounded retry on transient fires — a
+        failed write attempt never touches the live checkpoint, the
+        temp machinery guarantees that). Runs on the async writer
+        thread in step mode, inline otherwise."""
+        t0 = time.perf_counter()
+        sup = self.supervisor
+        attempt = 0
+        while True:
+            try:
+                if self.injector is not None:
+                    self.injector.fire("checkpoint_io")
+                self._write_once(snap, path)
+                break
+            except TransientFault:
+                sup.retries.inc()
+                if attempt >= sup.max_step_retries:
+                    raise
+                # the same retry knobs the step seams honor
+                # (max_step_retries / retry_backoff_ms)
+                time.sleep(sup.retry_backoff_ms * (2 ** attempt) / 1e3)
+                attempt += 1
+        self._prune_and_sweep()
+        # single-writer by construction (the async worker, or the loop
+        # thread after _writer.wait()), so += cannot lose increments
+        self.supervisor.checkpoint_write_s += time.perf_counter() - t0
+
+    def _write_once(self, snap: dict, path: str):
+        # pid-unique temp name IN the checkpoint directory (rename
+        # must not cross filesystems): a crash mid-write leaves
+        # only a temp file resume() will never look at, and a
+        # restarted writer can't collide with the corpse
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            ModelSerializer.write_snapshot(snap, tmp)
+            # flush the bytes to stable storage BEFORE the rename
+            # goes live — os.replace alone is atomic against
+            # process crashes but can surface a truncated target
+            # after a power loss reorders the metadata ahead of
+            # the data
+            with open(tmp, "rb+") as f:
+                os.fsync(f.fileno())
+            os.replace(tmp, path)  # atomic: partials never go live
+            # ...and make the rename itself durable: the directory
+            # entry is still only in the page cache, and for a NEW
+            # checkpoint name a power loss could lose the file
+            # entirely despite the write having returned success
+            dfd = os.open(self.dir, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except BaseException:
+            # never leave a half-written temp behind on failure
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+
+    def _prune_and_sweep(self):
+        ckpts = self.list_checkpoints(self.dir)
+        for old in ckpts[:-self.keep_last] if self.keep_last else []:
+            try:
+                os.remove(old)
+            except OSError:
+                pass  # a concurrent writer's rotation got there first
+        # sweep temp corpses from CRASHED earlier runs (ours was
+        # renamed or removed above); they'd otherwise pin disk
+        # forever since list_checkpoints rightly skips them. A temp
+        # whose embedded pid is still ALIVE is not a corpse — it's
+        # a concurrent trainer (preemption handover: the dying
+        # process's final write overlapping our first) mid-write,
+        # and deleting it would destroy that checkpoint
+        for stale in glob.glob(os.path.join(
+                self.dir, "checkpoint_epoch*.zip.tmp.*")):
+            pid_s = stale.rsplit(".", 1)[-1]
+            if pid_s.isdigit() and _pid_alive(int(pid_s)):
+                continue
+            try:
+                os.remove(stale)
+            except OSError:
+                pass
+
+    def _save(self, epoch: int, cursor: Optional[dict] = None):
         # _saving guards signal-handler re-entry: a SIGTERM landing
         # mid-write must not start a second write (see
         # PreemptionHandler._handle)
         self._saving = True
         try:
-            path = self._ckpt_path(epoch)
-            # pid-unique temp name IN the checkpoint directory (rename
-            # must not cross filesystems): a crash mid-write leaves
-            # only a temp file resume() will never look at, and a
-            # restarted writer can't collide with the corpse
-            tmp = f"{path}.tmp.{os.getpid()}"
-            try:
-                ModelSerializer.write_model(self.model, tmp,
-                                            save_updater=True)
-                # flush the bytes to stable storage BEFORE the rename
-                # goes live — os.replace alone is atomic against
-                # process crashes but can surface a truncated target
-                # after a power loss reorders the metadata ahead of
-                # the data
-                with open(tmp, "rb+") as f:
-                    os.fsync(f.fileno())
-                os.replace(tmp, path)  # atomic: partials never go live
-                # ...and make the rename itself durable: the directory
-                # entry is still only in the page cache, and for a NEW
-                # checkpoint name a power loss could lose the file
-                # entirely despite _save having returned success
-                dfd = os.open(self.dir, os.O_RDONLY)
-                try:
-                    os.fsync(dfd)
-                finally:
-                    os.close(dfd)
-            except BaseException:
-                # never leave a half-written temp behind on failure
-                try:
-                    os.remove(tmp)
-                except OSError:
-                    pass
-                raise
-            ckpts = self.list_checkpoints(self.dir)
-            for old in ckpts[:-self.keep_last] if self.keep_last else []:
-                os.remove(old)
-            # sweep temp corpses from CRASHED earlier runs (ours was
-            # renamed or removed above); they'd otherwise pin disk
-            # forever since list_checkpoints rightly skips them. A temp
-            # whose embedded pid is still ALIVE is not a corpse — it's
-            # a concurrent trainer (preemption handover: the dying
-            # process's final _save overlapping our first) mid-write,
-            # and deleting it would destroy that checkpoint
-            for stale in glob.glob(os.path.join(
-                    self.dir, "checkpoint_epoch*.zip.tmp.*")):
-                pid_s = stale.rsplit(".", 1)[-1]
-                if pid_s.isdigit() and _pid_alive(int(pid_s)):
-                    continue
-                try:
-                    os.remove(stale)
-                except OSError:
-                    pass
+            snap = snapshot_training_state(self.model, cursor=cursor,
+                                           extra=self._extra_state())
+            self._write_atomic(snap, self._ckpt_path(epoch))
         finally:
             self._saving = False
+
+    def _extra_state(self):
+        if self.wrapper is not None:
+            return self.wrapper.extra_checkpoint_state()
+        return None
 
     # -- training ------------------------------------------------------
     def fit(self, iterator, epochs: int):
         """Train up to a TOTAL of `epochs` epochs (counting the model's
-        current epoch counter), checkpointing every `save_every` epochs.
+        current epoch counter), checkpointing every `save_every` epochs
+        (plus every `save_every_n_steps` optimizer steps in step mode).
         After a preemption, `resume()` + `fit()` with the same total
-        continues where the last checkpoint left off; if the target was
-        already reached, this is a no-op."""
+        continues where the last checkpoint left off — bit-exactly in
+        step mode; if the target was already reached, this is a no-op."""
+        if self._step_mode:
+            return self._fit_supervised(iterator, epochs)
         start = self.model._epoch
         for e in range(start, epochs):
-            self.model.fit(iterator, epochs=1)  # fit() advances _epoch
+            if self.wrapper is not None:
+                self.wrapper.fit(iterator, epochs=1)
+            else:
+                self.model.fit(iterator, epochs=1)  # fit() advances _epoch
             if (e + 1) % self.save_every == 0 or e + 1 == epochs:
                 self._save(e + 1)
         return self.model
 
+    # -- the supervised step loop --------------------------------------
+    def _ensure_step(self) -> Callable:
+        """The compiled step callable for this trainer's config — the
+        model's plain step or the wrapper's sharded/compressed step,
+        guarded when the anomaly guard is on. Built ONCE and cached:
+        the guard is a compile-time variant, so there is exactly one
+        warmup compile and zero recompiles after."""
+        guard = self.supervisor.anomaly_guard
+        if self.wrapper is not None:
+            step = self.wrapper.ensure_step(guard=guard)
+            self.supervisor.extra_state_fn = \
+                self.wrapper.extra_checkpoint_state
+            self.supervisor.load_extra_fn = \
+                self.wrapper.load_extra_checkpoint_state
+            return step
+        key = "guard" if guard else "plain"
+        if key not in self._step_fns:
+            self._step_fns[key] = self.model._make_step(guard=guard)
+        return self._step_fns[key]
+
+    def _current_cursor(self) -> dict:
+        return {"epoch": self.model._epoch,
+                "batches_into_epoch": self._batches_done,
+                "iterator": self._epoch_it_state}
+
+    def _fit_supervised(self, iterator, epochs: int):
+        m = self.model
+        if m._params is None:
+            m.init()
+        sup = self.supervisor
+        step_fn = self._ensure_step()
+        if self.async_write and (self._writer is None
+                                 or self._writer.closed):
+            self._writer = AsyncCheckpointWriter(self._write_atomic)
+        # a plain generator exhausts after one epoch and would silently
+        # yield nothing on later epochs — materialize it (same guard as
+        # MultiLayerNetwork.fit)
+        if not hasattr(iterator, "reset") and \
+                not isinstance(iterator, (list, tuple)):
+            iterator = list(iterator)
+        cursor = getattr(m, "_resume_cursor", None)
+        m._resume_cursor = None
+        mesh_ctx = (self.wrapper.mesh if self.wrapper is not None
+                    else contextlib.nullcontext())
+        self._loop_active = True
+        try:
+            with mesh_ctx:
+                # rollback needs a good snapshot BEFORE the first
+                # anomaly can strike (skipped entirely when nothing
+                # would ever consume it — see snapshot_every_n_steps)
+                if self.snapshot_every_n_steps:
+                    sup.capture_good(m, cursor=self._current_cursor())
+                for e in range(m._epoch, epochs):
+                    self._run_one_epoch(iterator, step_fn, cursor)
+                    cursor = None      # only the first epoch resumes
+                    for lst in m.listeners:
+                        if hasattr(lst, "on_epoch_end"):
+                            lst.on_epoch_end(m)
+                    if (e + 1) % self.save_every == 0 or e + 1 == epochs:
+                        self._checkpoint(self._ckpt_path(e + 1))
+        finally:
+            try:
+                # durability before fit() returns: an async checkpoint
+                # still in flight is not yet a checkpoint (a stored
+                # error from an earlier failed background write also
+                # surfaces here). _loop_active stays True through this
+                # wait ON PURPOSE: a SIGTERM here must take the flag
+                # path — the inline handler save would open the same
+                # pid-unique temp file the writer thread is mid-writing
+                # and rename the interleaved result live
+                if self._writer is not None:
+                    self._writer.wait()
+            finally:
+                self._loop_active = False
+                # a SIGTERM that landed after the last step boundary
+                # (final epoch checkpoint, writer wait) would otherwise
+                # be swallowed: no boundary remains to observe the
+                # flag, and the platform's terminate notice must still
+                # be honored — flush and run the chaining contract
+                # (which re-delivers the default terminate action).
+                # Inside this finally so a stale background-write error
+                # raised by wait() above cannot skip it. (A signal
+                # landing after this check takes the handler's inline
+                # path — safe, the writer is idle or dead by now.)
+                if self._preempt_requested.is_set():
+                    self._preempt_requested.clear()
+                    sup.preemptions.inc()
+                    self._flush_step_checkpoint()
+                    handler, self._preempt_handler = \
+                        self._preempt_handler, None
+                    if handler is not None:
+                        handler.finish_preemption(self._preempt_signum)
+                if self._writer is not None:
+                    # reclaim the writer thread: a process creating
+                    # many trainers must not accumulate idle daemons.
+                    # The object stays referenced for stats; the next
+                    # fit() builds a fresh one
+                    self._writer.close()
+        return m
+
+    def _run_one_epoch(self, iterator, step_fn, cursor: Optional[dict]):
+        m = self.model
+        # capture the iterator's replay state BEFORE its epoch reset:
+        # a mid-epoch checkpoint stores this state + a batch count, and
+        # resume replays the same shuffle order then skips the batches
+        # the dead run already trained on
+        it_state = (iterator.state_dict()
+                    if hasattr(iterator, "state_dict") else None)
+        skip = 0
+        if cursor is not None and cursor.get("epoch") == m._epoch:
+            if cursor.get("iterator") is not None \
+                    and hasattr(iterator, "load_state_dict"):
+                iterator.load_state_dict(cursor["iterator"])
+                it_state = cursor["iterator"]
+            skip = int(cursor.get("batches_into_epoch", 0))
+        self._epoch_it_state = it_state
+        self._batches_done = 0
+        for item in iterator:
+            if skip > 0:
+                # fast-forward WITHOUT consuming the model's PRNG key:
+                # the checkpointed key already reflects these batches'
+                # splits — re-splitting would fork the stream
+                skip -= 1
+                self._batches_done += 1
+                continue
+            self._run_one_step(step_fn, item)
+            self._batches_done += 1
+            self._after_step()
+        m._epoch += 1
+        # roll the cursor to the NEXT epoch's start: an epoch-boundary
+        # checkpoint must say "epoch E+1, batch 0, iterator as it
+        # stands now", not carry the finished epoch's batch count
+        self._batches_done = 0
+        self._epoch_it_state = (iterator.state_dict()
+                                if hasattr(iterator, "state_dict")
+                                else None)
+
+    def _run_one_step(self, step_fn, item):
+        m = self.model
+        sup = self.supervisor
+        b = m._unpack(item)
+        x, y, msk = b[0], b[1], (b[2] if len(b) > 2 else None)
+        x = m._reshape_input(jnp.asarray(x))
+        y = jnp.asarray(y)
+        mj = None if msk is None else jnp.asarray(msk)
+        t0 = time.perf_counter()
+        tbptt = m.conf.tbptt_fwd_length
+        # split ONCE per batch BEFORE the TBPTT branch, exactly like
+        # MultiLayerNetwork.fit — the epoch-mode and step-mode loops
+        # must consume the key stream identically or a checkpoint
+        # taken under one and resumed under the other diverges
+        rng_before = m._rng
+        rb_before = sup.rollbacks.value()
+        m._rng, sub = jax.random.split(m._rng)
+        if tbptt and x.ndim == 3 and x.shape[1] > tbptt:
+            # TBPTT chunks run through the model's own chunk step
+            # (retry/guard don't thread into the chunk loop); the
+            # cursor/PRNG machinery still makes them resume bit-exactly
+            loss = m._fit_tbptt(x, y, msk, tbptt)
+            advanced = True
+        else:
+            advanced, loss = sup.step(m, step_fn, x, y, mj, sub)
+        if advanced:
+            m._step += 1
+        elif sup.rollbacks.value() == rb_before:
+            # a skipped anomalous batch must not consume the key
+            # stream either: with per-batch RNG consumers (dropout)
+            # the split would make every later batch draw different
+            # masks than a run that never saw the bad batch — breaking
+            # the skip-identity contract. (NOT on the rollback path:
+            # rollback() just restored the snapshot's key, which this
+            # would clobber with the newer pre-split one)
+            m._rng = rng_before
+        m._last_loss = loss
+        dur = time.perf_counter() - t0
+        for lst in m.listeners:
+            lst.iteration_done(m, m._step, m._epoch)
+            if hasattr(lst, "on_timing"):
+                lst.on_timing(m, dur, x.shape[0])
+        self._advanced = advanced
+
+    def _after_step(self):
+        m = self.model
+        sup = self.supervisor
+        if self._advanced:
+            t0 = time.perf_counter()
+            snapped = False
+            if self.snapshot_every_n_steps \
+                    and m._step % self.snapshot_every_n_steps == 0:
+                sup.capture_good(m, cursor=self._current_cursor())
+                snapped = True
+            if self.save_every_n_steps \
+                    and m._step % self.save_every_n_steps == 0:
+                if not snapped:
+                    sup.capture_good(m, cursor=self._current_cursor())
+                self._checkpoint(
+                    self._step_ckpt_path(m._epoch, m._step),
+                    snap=sup.last_good)
+            sup.checkpoint_stall_s += time.perf_counter() - t0
+        # preemption checks ride the step boundary: the injected seam
+        # (scripted chaos) and the SIGTERM flag (real platform notice)
+        if self.injector is not None:
+            try:
+                self.injector.fire("preempt")
+            except PreemptionFault:
+                sup.preemptions.inc()
+                self._flush_step_checkpoint()
+                raise
+        if self._preempt_requested.is_set():
+            self._preempt_requested.clear()
+            sup.preemptions.inc()
+            self._flush_step_checkpoint()
+            handler, self._preempt_handler = self._preempt_handler, None
+            if handler is not None:
+                # on_preempt + chaining run HERE, on the loop's thread,
+                # with the checkpoint already durable — never inside
+                # the signal handler (same flip-the-flag treatment as
+                # the serving SIGTERM wiring)
+                handler.finish_preemption(self._preempt_signum)
+            raise PreemptionFault(
+                f"preempted at step {m._step}; step-granular "
+                "checkpoint flushed")
+
+    def _checkpoint(self, path: str, snap: Optional[dict] = None):
+        """Write through the async writer in async mode (the step loop
+        stalls only for the snapshot + any previous write still in
+        flight), inline otherwise."""
+        sup = self.supervisor
+        if snap is None:
+            snap = snapshot_training_state(
+                self.model, cursor=self._current_cursor(),
+                extra=self._extra_state())
+        if self._writer is not None:
+            self._writer.submit(snap, path)
+            sup.async_checkpoints.inc()
+        else:
+            self._write_atomic(snap, path)
+            sup.sync_checkpoints.inc()
+
+    def _flush_step_checkpoint(self):
+        """Synchronous, durable, step-granular flush — the preemption
+        path (the process is about to die; async timing is no good).
+        Waits out any in-flight async write first so rotation can't
+        race, then writes inline."""
+        if self._writer is not None:
+            try:
+                self._writer.wait()
+            except Exception:  # noqa: BLE001 — a stored error from an
+                # EARLIER failed background write must not abort the
+                # final flush: the process is dying and this inline
+                # write is the last chance at a step checkpoint (if the
+                # disk is truly gone, the write below raises itself)
+                pass
+        path = self._step_ckpt_path(self.model._epoch, self.model._step)
+        if not os.path.exists(path):
+            self._write_atomic(
+                snapshot_training_state(self.model,
+                                        cursor=self._current_cursor(),
+                                        extra=self._extra_state()),
+                path)
+            self.supervisor.sync_checkpoints.inc()
+
+    def faults_snapshot(self) -> dict:
+        """Supervisor + injector counters (the training analog of the
+        serving ``faults`` stats block)."""
+        d = self.supervisor.snapshot()
+        if self._writer is not None:
+            d["async_write_s_total"] = round(self._writer.write_s_total, 6)
+            d["async_writes"] = self._writer.writes
+        if self.injector is not None:
+            d["injector"] = self.injector.snapshot()
+        return d
+
     @staticmethod
     def resume(checkpoint_dir: str):
         """Restore the latest completed checkpoint (ref: the restarted
-        worker's params+updater refetch, technicalref.md:115-135)."""
+        worker's params+updater refetch, technicalref.md:115-135).
+        Format-v2 checkpoints restore the PRNG key and leave the loop
+        cursor + extra runtime state on the model for the next
+        ``fit()`` / ``ParallelWrapper`` to consume — resume is then
+        bit-exact, mid-epoch included."""
         ckpts = FaultTolerantTrainer.list_checkpoints(checkpoint_dir)
         if not ckpts:
             raise FileNotFoundError(
@@ -157,8 +601,8 @@ class PreemptionHandler:
     """Checkpoint-on-preemption hook (the §5.3 gap: the reference's
     restart story assumes the node can re-handshake; on TPU the
     platform sends SIGTERM before maintenance/preemption, so the
-    equivalent is: flush a final checkpoint the moment the signal
-    lands, then let the process exit and `FaultTolerantTrainer.resume`
+    equivalent is: flush a checkpoint the moment the signal lands,
+    then let the process exit and `FaultTolerantTrainer.resume`
     pick it up on restart).
 
     Usage::
@@ -166,6 +610,19 @@ class PreemptionHandler:
         trainer = FaultTolerantTrainer(model, ckpt_dir)
         with PreemptionHandler(trainer):
             trainer.fit(data, epochs=100)
+
+    When the trainer's SUPERVISED loop is running (step mode), the
+    handler only sets a flag — the same treatment as the serving
+    SIGTERM wiring, which never does blocking work in the handler
+    frame: the interrupted main thread is somewhere inside the step
+    loop, possibly holding the async-writer's lock, and a blocking
+    in-handler save could deadlock on it. The loop observes the flag
+    at the next STEP boundary, flushes a step-granular mid-epoch
+    checkpoint, and then runs ``on_preempt`` + chaining on its own
+    thread via :meth:`finish_preemption`. Outside the supervised loop
+    the handler saves inline as before (the main thread is blocked in
+    the handler, so the model state it snapshots cannot move — and the
+    epoch-granular path takes no locks the handler could need).
 
     The handler chains any previously-installed handler (so test
     runners / frameworks keep their own cleanup), marks
@@ -187,15 +644,30 @@ class PreemptionHandler:
 
     def _handle(self, signum, frame):
         self.preempted = True
+        tr = self.trainer
+        if getattr(tr, "_loop_active", False):
+            # supervised loop running beneath this very frame: hand off
+            # (flag only) — it flushes at the next step boundary and
+            # calls finish_preemption()
+            tr._preempt_handler = self
+            tr._preempt_signum = signum
+            tr._preempt_requested.set()
+            return
         # flush the current (possibly mid-epoch) training state — but
         # never clobber an existing clean epoch-boundary checkpoint with
         # the same tag, and never re-enter a _save the signal interrupted
         # mid-write (the shared .tmp would corrupt the live checkpoint;
         # skipping keeps the previous checkpoint intact)
-        epoch = self.trainer.model._epoch
-        if not getattr(self.trainer, "_saving", False) and \
-                not os.path.exists(self.trainer._ckpt_path(epoch)):
-            self.trainer._save(epoch)
+        epoch = tr.model._epoch
+        if not getattr(tr, "_saving", False) and \
+                not os.path.exists(tr._ckpt_path(epoch)):
+            tr._save(epoch)
+        self.finish_preemption(signum, frame)
+
+    def finish_preemption(self, signum, frame=None):
+        """Run the user callback and the chaining contract — called
+        from the handler itself (epoch path) or from the supervised
+        loop's thread after its step-granular flush."""
         if self.on_preempt is not None:
             self.on_preempt(signum)
         prev = self._prev.get(signum)
@@ -204,8 +676,14 @@ class PreemptionHandler:
                 prev(signum, frame)
             elif prev == signal.SIG_DFL:
                 # emulate the default action (terminate) so the doomed
-                # process actually exits after checkpointing
-                signal.signal(signum, signal.SIG_DFL)
+                # process actually exits after checkpointing.
+                # signal.signal is main-thread-only: when the loop runs
+                # elsewhere, skip re-arming rather than die on
+                # ValueError with the checkpoint already safe
+                try:
+                    signal.signal(signum, signal.SIG_DFL)
+                except ValueError:
+                    return
                 os.kill(os.getpid(), signum)
 
     def __enter__(self):

@@ -123,6 +123,27 @@ class OpProfiler:
                   f"{t['mean_s'] * 1e6:>10.1f} us/call")
 
 
+class Counter:
+    """Thread-safe monotonically-increasing event counter. The
+    resilient-training supervisor bumps these from the step loop while
+    tests/listeners read them concurrently; an unlocked ``+=`` would
+    lose increments under preemption (same rationale as OpProfiler's
+    record lock)."""
+
+    def __init__(self):
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> int:
+        with self._lock:
+            self._v += int(n)
+            return self._v
+
+    def value(self) -> int:
+        with self._lock:
+            return self._v
+
+
 class Reservoir:
     """Bounded sample reservoir with percentile queries (ref role: the
     reference's PerformanceListener latency aggregation). Keeps the most

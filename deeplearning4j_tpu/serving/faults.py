@@ -1,178 +1,21 @@
-"""Deterministic fault injection for the serving stack.
+"""Backwards-compat re-export: the fault-injection toolkit moved up to
+:mod:`deeplearning4j_tpu.faults` so training and serving chaos share
+ONE injector (same seams machinery, same seeded per-seam decision
+streams, same fault taxonomy). Serving code and existing callers keep
+importing from here; the classes ARE the shared ones — ``isinstance``
+checks and ``except`` clauses match across both runtimes.
 
-Ref role: the reference DL4J stack is built around surviving worker
-failure — its Aeron parameter server retries lost updates and the
-Spark training master re-schedules dead executors — and it proves that
-story with chaos-style tests that kill workers mid-run. This module is
-the serving-side equivalent: a seeded, scriptable
-:class:`FaultInjector` that the engines call at named SEAMS so tests
-and the bench chaos probe can make the runtime fail in exactly the
-ways real deployments do, deterministically.
-
-Seams (where the engines fire the injector):
-
-- ``device_step``   — immediately before a decode/batch device call
-  (`GenerationEngine._decode_step`, `InferenceEngine.predict_normalized`)
-- ``prefill``       — immediately before a prefill / prefill-chunk
-  (`GenerationEngine._prefill` / `_prefill_chunk_step`)
-- ``alloc``         — before claiming KV blocks at paged admission
-- ``client_disconnect`` — per streamed token; a fire marks the request
-  abandoned, as if the HTTP consumer hung up mid-stream
-- ``latency``       — once per scheduler iteration; a fire sleeps
-  ``latency_ms`` instead of raising (injects tail latency, not errors)
-
-Fault types injected at the raising seams:
-
-- :class:`TransientFault` — raised BEFORE any buffer donation, so the
-  engine's state is intact and the step can simply be retried (the
-  supervised loops do, with bounded exponential backoff).
-- :class:`CorruptedStateFault` — models a device call dying AFTER the
-  KV caches were donated to it: the prefixes are gone and the engine
-  must rebuild by recompute-recovery (re-prefill every in-flight
-  request from prompt + already-emitted tokens). Configure via
-  ``corrupting={"device_step", ...}``.
-
-The injector is INERT unless explicitly constructed and passed to an
-engine (``fault_injector=``); engines hold ``None`` by default and
-guard every seam with one attribute load, so production traffic pays
-zero overhead. Decisions are deterministic: each seam has its own call
-counter and its own ``RandomState`` seeded from ``(seed, seam)``, so
-the fire pattern at one seam never depends on how other seams
-interleave — the same workload replays the same faults.
+Note the fault types now subclass :class:`~..faults.FaultError`
+(a RuntimeError) rather than the serving-layer ``ServingError``; the
+HTTP front-end's default branch still maps them to 5xx, and nothing in
+the runtime caught them via ``except ServingError``.
 """
 from __future__ import annotations
 
-import threading
-import time
-import zlib
-from typing import Callable, Dict, Iterable, Optional
+from ..faults import (SEAMS, CorruptedStateFault, FaultError,  # noqa: F401
+                      FaultInjector, PoisonRequestError, PreemptionFault,
+                      TransientFault, poll_until_idle)
 
-import numpy as np
-
-from .engine import ServingError
-
-#: the seams engines fire; anything else is a configuration typo and
-#: fails loudly at construction rather than silently never firing
-SEAMS = ("device_step", "prefill", "alloc", "client_disconnect",
-         "latency")
-
-
-class TransientFault(ServingError):
-    """A retryable failure raised BEFORE any buffer donation: engine
-    state is intact, so the supervised loop retries the step with
-    bounded exponential backoff (HTTP 5xx only if retries exhaust AND
-    recovery fails)."""
-
-
-class CorruptedStateFault(ServingError):
-    """A device call failed after the KV caches were donated to it —
-    the in-flight prefixes are unrecoverable from the device and the
-    engine must rebuild by recompute-recovery."""
-
-
-class PoisonRequestError(ServingError):
-    """One request produced non-finite logits (NaN/Inf) — it is
-    quarantined: failed alone with HTTP 500, its slot/blocks freed
-    immediately, while the rest of the batch keeps decoding."""
-
-
-class FaultInjector:
-    """Seeded, scriptable fault source the engines consult at named
-    seams (see module docstring).
-
-    ``rates``: ``{seam: probability}`` — fire ~that fraction of calls,
-    from a per-seam seeded stream.
-    ``plan``: ``{seam: [call indices]}`` — fire exactly on those
-    1-based invocation counts of that seam (deterministic scripting
-    for tests; composes with ``rates``).
-    ``corrupting``: seams whose fires raise
-    :class:`CorruptedStateFault` instead of :class:`TransientFault`.
-    """
-
-    def __init__(self, seed: int = 0,
-                 rates: Optional[Dict[str, float]] = None,
-                 plan: Optional[Dict[str, Iterable[int]]] = None,
-                 corrupting: Iterable[str] = (),
-                 latency_ms: float = 1.0):
-        self.seed = int(seed)
-        self.rates = {s: float(p) for s, p in (rates or {}).items()}
-        self.plan = {s: frozenset(int(i) for i in idx)
-                     for s, idx in (plan or {}).items()}
-        self.corrupting = frozenset(corrupting)
-        unknown = [s for s in (set(self.rates) | set(self.plan)
-                               | self.corrupting) if s not in SEAMS]
-        if unknown:
-            raise ValueError(f"unknown fault seams {sorted(unknown)}; "
-                             f"valid seams: {list(SEAMS)}")
-        for s, p in self.rates.items():
-            if not 0.0 <= p <= 1.0:
-                raise ValueError(f"rate for seam {s!r} must be in "
-                                 f"[0, 1], got {p}")
-        self.latency_ms = float(latency_ms)
-        self._lock = threading.Lock()
-        self._calls = {s: 0 for s in SEAMS}
-        self._fired = {s: 0 for s in SEAMS}
-        # one stream PER SEAM, keyed by (seed, seam name): the decision
-        # at call #n of a seam depends only on n — never on how many
-        # times OTHER seams fired in between — so a workload replays
-        # the same fault pattern regardless of thread interleaving
-        self._rngs = {s: np.random.RandomState(
-            (self.seed * 1_000_003 + zlib.crc32(s.encode())) & 0xFFFFFFFF)
-            for s in self.rates}
-
-    def fire(self, seam: str) -> bool:
-        """Consult the injector at ``seam``. Returns False (no fault)
-        or True (``latency`` slept / ``client_disconnect`` should be
-        interpreted by the caller); the error seams raise instead of
-        returning True."""
-        if seam not in self._calls:
-            raise ValueError(f"unknown seam {seam!r}")
-        with self._lock:
-            self._calls[seam] += 1
-            n = self._calls[seam]
-            hit = n in self.plan.get(seam, ())
-            if not hit and seam in self.rates:
-                hit = bool(self._rngs[seam].random_sample()
-                           < self.rates[seam])
-            if not hit:
-                return False
-            self._fired[seam] += 1
-        if seam == "latency":
-            time.sleep(self.latency_ms / 1e3)
-            return True
-        if seam == "client_disconnect":
-            return True
-        if seam in self.corrupting:
-            raise CorruptedStateFault(
-                f"injected cache-corrupting fault at {seam!r} "
-                f"(call #{n})")
-        raise TransientFault(
-            f"injected transient fault at {seam!r} (call #{n})")
-
-    def snapshot(self) -> Dict:
-        """Per-seam call/fire counters (for tests and the bench chaos
-        probe's report)."""
-        with self._lock:
-            return {"calls": dict(self._calls),
-                    "fired": dict(self._fired)}
-
-
-def poll_until_idle(is_idle: Callable[[], bool], timeout_s: float,
-                    quiet_obs: int = 3, poll_s: float = 0.02) -> bool:
-    """True once ``is_idle()`` holds for ``quiet_obs`` CONSECUTIVE
-    observations before the deadline. A single idle glimpse is not
-    enough: a request can sit between ``queue.get()`` and its device
-    call / slot claim for a moment with every queue already empty.
-    Shared by the engine and batcher drain loops so the quiet
-    heuristic cannot drift between them."""
-    deadline = time.monotonic() + timeout_s
-    quiet = 0
-    while time.monotonic() < deadline:
-        if is_idle():
-            quiet += 1
-            if quiet >= quiet_obs:
-                return True
-        else:
-            quiet = 0
-        time.sleep(poll_s)
-    return False
+__all__ = ["SEAMS", "CorruptedStateFault", "FaultError", "FaultInjector",
+           "PoisonRequestError", "PreemptionFault", "TransientFault",
+           "poll_until_idle"]

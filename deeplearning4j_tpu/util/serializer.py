@@ -6,9 +6,22 @@ normalizer}. Same completeness bar here (SURVEY.md §5.4): config JSON +
 params + updater state + step counter round-trip exactly.
 
 Format: a zip holding `configuration.json`, `params.npz` (one entry per
-flattened pytree path), `updater.npz`, `meta.json`. Orbax-style sharded
-async checkpointing for the distributed path lives in
-`deeplearning4j_tpu.parallel.checkpoint`; this is the single-host format.
+flattened pytree path), `updater.npz`, `meta.json`. Format version 2
+(this file reads both) adds everything BIT-EXACT resume needs beyond
+the reference's bar: the model's PRNG key, the training-loop cursor
+(epoch + batches consumed into it + the data iterator's replay state),
+and an `extra.npz` of runtime state that lives outside the model —
+e.g. the gradient-sharing accumulator's per-worker residuals/updater
+moments (`parallel.ParallelWrapper`). Writing is split into
+:func:`snapshot_training_state` (the device→host copy — the only part
+that must pause training) and :meth:`ModelSerializer.write_snapshot`
+(pure host I/O, safe on a background thread) so
+`parallel.elastic.FaultTolerantTrainer` can checkpoint asynchronously
+at step cadence (CheckFreq-style).
+
+Orbax-style sharded async checkpointing for the distributed path lives
+in `deeplearning4j_tpu.parallel.checkpoint`; this is the single-host
+format.
 """
 from __future__ import annotations
 
@@ -51,28 +64,72 @@ def _npz_bytes(arrs: Dict[str, np.ndarray]) -> bytes:
     return buf.getvalue()
 
 
+def snapshot_training_state(model, cursor: Optional[dict] = None,
+                            extra: Optional[Dict[str, np.ndarray]] = None,
+                            save_updater: bool = True) -> dict:
+    """Host-owned copy of the full resumable training state. This is
+    the ONLY part of a checkpoint that must happen inside the step
+    cadence (it forces the device→host copy); the returned dict is
+    plain numpy/str and can be written to disk from any thread.
+
+    ``cursor`` is the training-loop position (JSON-able; see
+    FaultTolerantTrainer), ``extra`` a flat ``{key: ndarray}`` of
+    runtime state outside the model (gradient-sharing residuals …)."""
+    snap = {
+        "conf_json": model.conf.to_json(),
+        "params": _flatten_tree(model._params),
+        "net_state": (_flatten_tree(model._net_state)
+                      if model._net_state else None),
+        "opt_state": (_flatten_tree(model._opt_state)
+                      if save_updater and model._opt_state is not None
+                      else None),
+        "extra": ({k: np.array(v, copy=True) for k, v in extra.items()}
+                  if extra else None),
+        "meta": {
+            "step": model._step,
+            "epoch": model._epoch,
+            "model_type": type(model).__name__,
+            "format_version": 2,
+        },
+    }
+    rng = getattr(model, "_rng", None)
+    if rng is not None:
+        # the PRNG key is load-bearing for bit-exact resume: fit()
+        # splits it once per batch, so restoring it replays the exact
+        # per-step subkey stream the uninterrupted run would have seen
+        snap["meta"]["rng"] = np.asarray(rng).tolist()
+    if cursor is not None:
+        snap["meta"]["cursor"] = cursor
+    return snap
+
+
 class ModelSerializer:
     """Ref: ModelSerializer.writeModel / restoreMultiLayerNetwork."""
 
     @staticmethod
     def write_model(model, path: str, save_updater: bool = True,
                     normalizer=None):
-        meta = {
-            "step": model._step,
-            "epoch": model._epoch,
-            "model_type": type(model).__name__,
-            "format_version": 1,
-        }
+        ModelSerializer.write_snapshot(
+            snapshot_training_state(model, save_updater=save_updater),
+            path, normalizer=normalizer)
+
+    @staticmethod
+    def write_snapshot(snap: dict, path: str, normalizer=None):
+        """Write a :func:`snapshot_training_state` dict. Pure host
+        I/O — no model access, so a background checkpoint thread can
+        run this while training continues on the captured-at snapshot."""
         with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
-            z.writestr("configuration.json", model.conf.to_json())
-            z.writestr("params.npz", _npz_bytes(_flatten_tree(model._params)))
-            if model._net_state:
-                z.writestr("state.npz", _npz_bytes(_flatten_tree(model._net_state)))
-            if save_updater and model._opt_state is not None:
-                z.writestr("updater.npz", _npz_bytes(_flatten_tree(model._opt_state)))
+            z.writestr("configuration.json", snap["conf_json"])
+            z.writestr("params.npz", _npz_bytes(snap["params"]))
+            if snap.get("net_state"):
+                z.writestr("state.npz", _npz_bytes(snap["net_state"]))
+            if snap.get("opt_state") is not None:
+                z.writestr("updater.npz", _npz_bytes(snap["opt_state"]))
+            if snap.get("extra"):
+                z.writestr("extra.npz", _npz_bytes(snap["extra"]))
             if normalizer is not None:
                 z.writestr("normalizer.json", json.dumps(normalizer))
-            z.writestr("meta.json", json.dumps(meta))
+            z.writestr("meta.json", json.dumps(snap["meta"]))
 
     @staticmethod
     def restore(path: str, load_updater: bool = True):
@@ -88,6 +145,39 @@ class ModelSerializer:
             path, load_updater)
 
     @staticmethod
+    def _restore_common(model, z: zipfile.ZipFile, load_updater: bool):
+        """Shared tail of both restore paths: params/state/updater
+        trees, counters, and the format-v2 resume state (PRNG key,
+        loop cursor, extra runtime arrays)."""
+        params_flat = dict(np.load(io.BytesIO(z.read("params.npz"))))
+        model._params = _unflatten_like(model._params, params_flat)
+        names = z.namelist()
+        if "state.npz" in names and model._net_state:
+            model._net_state = _unflatten_like(
+                model._net_state,
+                dict(np.load(io.BytesIO(z.read("state.npz")))))
+        if load_updater and "updater.npz" in names:
+            model._opt_state = _unflatten_like(
+                model._opt_state,
+                dict(np.load(io.BytesIO(z.read("updater.npz")))))
+        meta = json.loads(z.read("meta.json").decode())
+        model._step = meta.get("step", 0)
+        model._epoch = meta.get("epoch", 0)
+        if meta.get("rng") is not None and hasattr(model, "_rng"):
+            model._rng = jax.numpy.asarray(
+                np.asarray(meta["rng"],
+                           dtype=np.asarray(model._rng).dtype))
+        # loop cursor + out-of-model runtime state ride on the model as
+        # private attributes: resume() keeps returning just the model
+        # (API unchanged), and the consumers (FaultTolerantTrainer's
+        # fast-forward, ParallelWrapper's accumulator re-init) pop them
+        model._resume_cursor = meta.get("cursor")
+        model._resume_extra = (
+            dict(np.load(io.BytesIO(z.read("extra.npz"))))
+            if "extra.npz" in names else None)
+        return model
+
+    @staticmethod
     def restore_computation_graph(path: str, load_updater: bool = True):
         from ..nn.graph import (ComputationGraph,
                                 ComputationGraphConfiguration)
@@ -95,21 +185,7 @@ class ModelSerializer:
             conf = ComputationGraphConfiguration.from_json(
                 z.read("configuration.json").decode())
             model = ComputationGraph(conf).init()
-            params_flat = dict(np.load(io.BytesIO(z.read("params.npz"))))
-            model._params = _unflatten_like(model._params, params_flat)
-            names = z.namelist()
-            if "state.npz" in names and model._net_state:
-                model._net_state = _unflatten_like(
-                    model._net_state,
-                    dict(np.load(io.BytesIO(z.read("state.npz")))))
-            if load_updater and "updater.npz" in names:
-                model._opt_state = _unflatten_like(
-                    model._opt_state,
-                    dict(np.load(io.BytesIO(z.read("updater.npz")))))
-            meta = json.loads(z.read("meta.json").decode())
-            model._step = meta.get("step", 0)
-            model._epoch = meta.get("epoch", 0)
-        return model
+            return ModelSerializer._restore_common(model, z, load_updater)
 
     @staticmethod
     def restore_multi_layer_network(path: str, load_updater: bool = True):
@@ -119,19 +195,7 @@ class ModelSerializer:
             conf = MultiLayerConfiguration.from_json(
                 z.read("configuration.json").decode())
             model = MultiLayerNetwork(conf).init()
-            params_flat = dict(np.load(io.BytesIO(z.read("params.npz"))))
-            model._params = _unflatten_like(model._params, params_flat)
-            names = z.namelist()
-            if "state.npz" in names and model._net_state:
-                model._net_state = _unflatten_like(
-                    model._net_state, dict(np.load(io.BytesIO(z.read("state.npz")))))
-            if load_updater and "updater.npz" in names:
-                model._opt_state = _unflatten_like(
-                    model._opt_state, dict(np.load(io.BytesIO(z.read("updater.npz")))))
-            meta = json.loads(z.read("meta.json").decode())
-            model._step = meta.get("step", 0)
-            model._epoch = meta.get("epoch", 0)
-        return model
+            return ModelSerializer._restore_common(model, z, load_updater)
 
     @staticmethod
     def restore_normalizer(path: str) -> Optional[dict]:

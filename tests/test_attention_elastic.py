@@ -34,7 +34,14 @@ class TestAttentionLayers:
     def test_transformer_stack_learns(self, np_rng):
         X, Y = _seq_task(np_rng)
         net = _transformer_net().init()
-        net.fit(ArrayDataSetIterator(X, Y, batch=32), epochs=25)
+        # 60 epochs, not 25: at 25 this run sits mid-descent and lands
+        # within a hair of the 0.85 bar (measured 0.82 on this CPU,
+        # >0.85 on the hardware it was recorded on — a float-ordering
+        # flake, not a modelling one). By 60 epochs the task is fully
+        # separable and the net reaches 1.0 train accuracy across the
+        # lr/seed neighbourhood (probed 3e-3/5e-3/1e-2), so the 0.85
+        # bar has real margin on any backend.
+        net.fit(ArrayDataSetIterator(X, Y, batch=32), epochs=60)
         assert net.evaluate(
             ArrayDataSetIterator(X, Y, batch=32)).accuracy() > 0.85
 

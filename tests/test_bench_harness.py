@@ -148,3 +148,69 @@ def test_check_bench_regression_new_metric_is_reported_not_crashed():
     r = cbr.compare(rec2, bad, 0.2)
     assert [e["metric"] for e in r["regressions"]] == \
         ["generation_paged_tokens_per_sec"]
+
+
+def test_training_chaos_scenario_harness_runs_on_cpu():
+    """ISSUE 5 bench satellite at tiny scale (2 epochs = 128 steps):
+    the supervised chaos run must absorb its scripted preemption,
+    restart + resume, finish the full schedule, and land on params
+    BIT-IDENTICAL to the uninterrupted clean run."""
+    import bench
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable, "-c", bench.TRAINING_CHAOS_CODE,
+                        "2"],
+                       capture_output=True, text=True, timeout=600,
+                       env=env, cwd=ROOT)
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = [l for l in r.stdout.splitlines() if l.startswith("{")][-1]
+    res = json.loads(line)
+    assert res["steps_per_sec"] > 0
+    assert res["preempted"] is True and res["preemptions"] == 1
+    assert res["total_steps"] == 128          # schedule completed
+    assert res["async_checkpoints"] >= 1      # cadence really async
+    assert res["params_identical_to_clean"] is True
+
+
+def test_check_bench_regression_list_mode():
+    """ISSUE 5 satellite: --list prints every gated metric with its
+    recorded-vs-fresh presence, so a new metric's unguarded window is
+    auditable without reading the BENCH JSON blobs."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "cbr3", os.path.join(ROOT, "tools", "check_bench_regression.py"))
+    cbr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cbr)
+    rec = {"value": 100.0,
+           "extra": {"generation": {"tokens_per_sec": 500.0}}}
+    fresh = {"value": 100.0,
+             "extra": {"generation": {"tokens_per_sec": 480.0},
+                       "training_chaos": {"steps_per_sec": 120.0}}}
+    rows = {r["metric"]: r for r in cbr.list_metrics(rec, fresh)}
+    assert set(rows) == set(cbr.METRICS.values())  # every gated metric
+    assert rows["headline_samples_per_sec"]["status"] == "gated"
+    assert rows["generation_tokens_per_sec"]["status"] == "gated"
+    assert rows["generation_tokens_per_sec"]["fresh"] == 480.0
+    tc = rows["training_chaos_steps_per_sec"]
+    assert tc["recorded"] is None and tc["fresh"] == 120.0
+    assert tc["status"].startswith("new, skipped")
+    # without a fresh run the same metric still shows as unguarded
+    rows2 = {r["metric"]: r for r in cbr.list_metrics(rec, None)}
+    assert rows2["training_chaos_steps_per_sec"]["status"].startswith(
+        "new, skipped")
+    # and the CLI path: --list with --fresh exits 0, prints the table
+    import io
+    from contextlib import redirect_stdout
+    import tempfile
+    with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                     delete=False) as f:
+        json.dump(fresh, f)
+        fpath = f.name
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cbr.main(["--list", "--fresh", fpath])
+    os.unlink(fpath)
+    assert rc == 0
+    out = json.loads(buf.getvalue())
+    assert any(m["metric"] == "training_chaos_steps_per_sec"
+               for m in out["metrics"])

@@ -110,7 +110,12 @@ class TestA3C:
         a3c = A3C(lambda i: CartPole(max_steps=200, seed=i),
                   A3CConfiguration(seed=0, n_envs=8, n_step=16,
                                    learning_rate=7e-3))
-        a3c.train(updates=150)
+        # 300 updates, not 150: 150 stops mid-learning-curve, where
+        # the late-window mean is ~19-29 depending on backend float
+        # ordering — a coin flip against the bars below. At 300 the
+        # run is well past the knee (late ~80-105 across lr
+        # 7e-3/1e-2 on CPU), so the same bars hold with real margin.
+        a3c.train(updates=300)
         rewards = a3c.episode_rewards
         early = np.mean(rewards[:10])
         late = np.mean(rewards[-10:])

@@ -15,6 +15,14 @@ Usage::
     python tools/check_bench_regression.py             # runs bench.py
     python tools/check_bench_regression.py --fresh out.json
     python tools/check_bench_regression.py --threshold 0.3
+    python tools/check_bench_regression.py --list      # audit metrics
+    python tools/check_bench_regression.py --list --fresh out.json
+
+``--list`` prints every gated metric name with its recorded-baseline
+and (if ``--fresh`` is given) fresh-run presence — so a newly added
+metric's "new, skipped until a baseline records it" status is
+auditable without reading the JSON blobs. It never runs bench.py and
+never gates.
 """
 from __future__ import annotations
 
@@ -42,6 +50,11 @@ METRICS = {
     # until the next BENCH_*.json records a baseline, gated after
     ("extra", "generation", "chaos_tokens_per_sec"):
         "generation_chaos_tokens_per_sec",
+    # training steps/sec with ~1% injected transient step faults + one
+    # scripted preemption/resume mid-run (ISSUE 5): "new, skipped"
+    # until the next BENCH_*.json records a baseline, gated after
+    ("extra", "training_chaos", "steps_per_sec"):
+        "training_chaos_steps_per_sec",
     ("extra", "word2vec", "tokens_per_sec"): "word2vec_tokens_per_sec",
     ("extra", "etl_pipeline", "rows_per_sec"): "etl_rows_per_sec",
 }
@@ -138,6 +151,29 @@ def compare(recorded: dict, fresh: dict, threshold: float) -> dict:
     return {"regressions": regressions, "ok": ok, "skipped": skipped}
 
 
+def list_metrics(recorded: dict, fresh: dict = None) -> list:
+    """Rows for ``--list``: every gated metric name with its
+    recorded / fresh presence and the resulting gate status."""
+    rows = []
+    for path, name in METRICS.items():
+        old = _dig(recorded, path)
+        new = _dig(fresh, path) if fresh is not None else None
+        if old is not None and old > 0:
+            status = "gated"
+        elif old is not None:
+            status = "recorded baseline non-positive, skipped"
+        elif new is not None or fresh is None:
+            status = "new, skipped until a BENCH_*.json records it"
+        else:
+            status = "absent from both"
+        rows.append({"metric": name,
+                     "path": ".".join(path),
+                     "recorded": old,
+                     "fresh": new,
+                     "status": status})
+    return rows
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--fresh", help="path to a pre-existing fresh bench "
@@ -146,8 +182,20 @@ def main(argv=None) -> int:
                     help="allowed fractional drop (default 0.20)")
     ap.add_argument("--timeout", type=int, default=7200,
                     help="bench.py timeout in seconds")
+    ap.add_argument("--list", action="store_true",
+                    help="print recorded-vs-fresh gated metric names "
+                    "and exit 0 (never runs bench.py, never gates)")
     args = ap.parse_args(argv)
     rec_path, recorded = latest_recorded()
+    if args.list:
+        fresh = None
+        if args.fresh:
+            with open(args.fresh) as f:
+                fresh = _parse_record(json.load(f), args.fresh)
+        rows = list_metrics(recorded, fresh)
+        print(json.dumps({"baseline_file": os.path.basename(rec_path),
+                          "metrics": rows}, indent=2))
+        return 0
     if args.fresh:
         with open(args.fresh) as f:
             fresh = _parse_record(json.load(f), args.fresh)
