@@ -37,7 +37,11 @@ HTTP surface::
                                        ({"stream": true} -> chunked
                                        newline-delimited JSON tokens)
     GET  /v1/models                    registry listing
-    GET  /stats                        serving metrics per model
+    GET  /stats                        serving metrics per model, plus
+                                       a compact top-level "summary"
+                                       (per-model live occupancy /
+                                       queue depth / draining flag)
+                                       for routers and load balancers
     GET  /health                       legacy summary (always 200)
     GET  /healthz                      liveness: 503 when any engine
                                        loop is wedged (stall watchdog)
@@ -58,6 +62,14 @@ SIGTERM via :meth:`InferenceServer.install_signal_handlers` — flips
 readiness off, finishes in-flight work, then joins the scheduler
 threads. ``faults.{retries,recoveries,quarantined,drains}`` counters
 surface per model at ``GET /stats``.
+
+Fleet tier (:mod:`.fleet`, docs/serving.md "Running a fleet"): N
+replicas of this server go behind a :class:`~.fleet.FleetRouter` —
+occupancy-aware routing on the ``/stats`` summary, health-gated
+membership via ``/healthz``/``/readyz``, straggler hedging under a
+token-bucket retry budget, and :meth:`~.fleet.ReplicaFleet.
+rolling_restart` extending the single-replica zero-loss drain
+guarantee fleet-wide.
 
 Generation (see :mod:`.generation`): causal LMs registered via
 ``register_generator`` decode token-by-token under iteration-level
@@ -87,6 +99,8 @@ from .batcher import (DeadlineExceededError, DrainingError, MicroBatcher,
 from .engine import ClientError, InferenceEngine, ServingError, next_bucket
 from .faults import (CorruptedStateFault, FaultInjector,
                      PoisonRequestError, TransientFault)
+from .fleet import (FleetError, FleetMetrics, FleetRouter,
+                    NoReplicasError, Replica, ReplicaFleet)
 from .generation import GenerationEngine
 from .kvcache import KVCache, SlotTable
 from .metrics import GenerationMetrics, ServingMetrics, profiler_sections
@@ -102,6 +116,8 @@ __all__ = [
     "ClientError", "ServingError", "QueueFullError",
     "DeadlineExceededError", "DrainingError", "FaultInjector",
     "TransientFault", "CorruptedStateFault", "PoisonRequestError",
+    "ReplicaFleet", "FleetRouter", "Replica", "FleetMetrics",
+    "FleetError", "NoReplicasError",
     "next_bucket", "export_stablehlo",
 ]
 
@@ -284,6 +300,7 @@ class InferenceServer:
                                headers={"Retry-After": "1"})
                     return
                 req = None
+                result = None
                 try:
                     try:
                         req = json.loads(raw)
@@ -297,18 +314,30 @@ class InferenceServer:
                             # become a terminal error chunk instead
                             it = server._generate_stream(name, req)
                             self._stream_ndjson(it)
-                        else:
-                            self._json(server._generate(name, req))
+                            return
+                        result = server._generate(name, req)
                     else:
-                        self._json(server._predict(name, req))
+                        result = server._predict(name, req)
                 except Exception as e:  # noqa: BLE001
                     code = _status_for(e)
                     version = (req.get("version")
                                if isinstance(req, dict) else None)
                     server._count_error(name, code, version)
-                    self._json({"error": str(e)}, code,
-                               headers=({"Retry-After": "1"}
-                                        if code == 503 else None))
+                    try:
+                        self._json({"error": str(e)}, code,
+                                   headers=({"Retry-After": "1"}
+                                            if code == 503 else None))
+                    except OSError:
+                        self.close_connection = True
+                    return
+                try:
+                    self._json(result)
+                except OSError:
+                    # the client hung up while the (possibly slow)
+                    # request computed — routine once routers time out
+                    # and abandon sockets, not a server error; a
+                    # traceback per occurrence would spam stderr
+                    self.close_connection = True
 
             def _stream_ndjson(self, it):
                 """Chunked transfer-encoded newline-delimited JSON: one
@@ -575,10 +604,30 @@ class InferenceServer:
         return True
 
     def stats(self) -> dict:
-        return {"models": self.registry.stats(),
+        return {"summary": self.summary(),
+                "models": self.registry.stats(),
                 "profiler": profiler_sections()}
 
+    def summary(self) -> dict:
+        """Compact machine-readable routing summary, also embedded as
+        the ``summary`` key of ``GET /stats``: per-model live
+        occupancy / queue depth / draining flag plus a server-level
+        ``load`` total — what :class:`~.fleet.FleetRouter` (or any
+        external load balancer) reads to pick a replica without
+        parsing nested histogram snapshots."""
+        models = self.registry.summary()
+        return {"ready": self.ready(),
+                "draining": not self.ready(),
+                "load": sum(m["load"] for m in models.values()),
+                "models": models}
+
     def stop(self):
+        # readiness off FIRST: handler threads still in flight when the
+        # listener stops would otherwise race the registry teardown and
+        # answer 404 ("unknown model") — a lie that a router would pass
+        # through as terminal. Shedding 503 + Retry-After instead keeps
+        # even a hard (drain-less) stop retryable upstream.
+        self._ready = False
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._owns_registry:

@@ -231,6 +231,15 @@ class MicroBatcher:
         feed = feeds[0] if len(feeds) == 1 else _concat_results(feeds)
         self.metrics.inc("batches")
         self.metrics.batch_hist.record(rows)
+        # live-occupancy gauge for the /stats summary: rows on the
+        # device RIGHT NOW (a fleet router reads it to steer load)
+        self.metrics.inflight = rows
+        try:
+            self._execute_inner(batch, rows, feed)
+        finally:
+            self.metrics.inflight = 0
+
+    def _execute_inner(self, batch, rows, feed):
         backoff = self.retry_backoff_ms / 1e3
         attempt = 0
         while True:
@@ -269,6 +278,13 @@ class MicroBatcher:
             r.result = _slice(res, lo, lo + r.n)
             lo += r.n
             r.event.set()
+
+    @property
+    def draining(self) -> bool:
+        """True once :meth:`drain` has started (new submits shed with
+        503 + Retry-After). Surfaced in the /stats summary so external
+        load balancers steer away without parsing error counters."""
+        return self._draining
 
     def alive(self) -> bool:
         """Liveness for ``/healthz``: False only when the scheduler is

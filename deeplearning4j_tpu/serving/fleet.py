@@ -1,0 +1,1121 @@
+"""Replica fleet tier: occupancy-aware routing, straggler hedging, and
+zero-loss rolling restarts over N engine replicas.
+
+One :class:`~.InferenceServer` is a replica, not a service. The
+reference stack's distributed layer exists precisely to run one
+logical workload across a churning fleet of workers (Spark training
+master + Aeron parameter server, SURVEY §1); this module is the
+serving-side equivalent: N in-process (or remote) ``InferenceServer``
+replicas behind a :class:`FleetRouter`, hermetically testable on CPU
+because the replicas already speak stdlib HTTP on loopback.
+
+Layers::
+
+    HTTP clients ──► FleetRouter ──► Replica (InferenceServer) x N
+                        │               ▲
+                        └── ReplicaFleet┘  (membership, health polls,
+                                            cordon, rolling restart)
+
+- :class:`ReplicaFleet` — membership + health. A poll loop reads each
+  replica's ``GET /healthz`` and the compact ``summary`` block of
+  ``GET /stats`` (live occupancy, queue depth, draining flag). A
+  replica that fails ``eject_after`` consecutive polls — connection
+  refused, or ``/healthz`` 503 because a scheduler loop is wedged —
+  is EJECTED from routing; it is re-admitted automatically on the
+  first clean poll. Draining replicas stay members (their in-flight
+  work must finish) but stop receiving new work.
+- :class:`FleetRouter` — request routing. Picks the eligible replica
+  with the lowest occupancy score (router-local in-flight count plus
+  the last-polled ``summary.load`` = queued + active rows/slots), NOT
+  round-robin, so a replica bogged down by slow requests or direct
+  traffic naturally stops attracting load. A 503 shed / draining
+  answer or a connection failure is retried against another replica
+  (the PR 4 ``Retry-After`` contract, finally honored by an actual
+  peer); slow predicts are HEDGED: after ``hedge_after_ms`` with no
+  response the same request is re-issued to a second replica and the
+  first response wins, under a token-bucket retry budget so hedges
+  can never amplify an overload (`The Tail at Scale`, PAPERS.md).
+- :meth:`ReplicaFleet.rolling_restart` — the fleet-wide extension of
+  PR 4's single-replica zero-loss drain: one replica at a time is
+  cordoned (router steers new work away), drained (in-flight work
+  finishes), stopped, rebuilt via its ``factory``, health-checked,
+  and re-admitted. Requests racing into the drain window get 503 +
+  ``Retry-After`` from the replica and are transparently retried by
+  the router against a live peer — the fleet as a whole loses zero
+  accepted requests and, with deterministic seeds, returns
+  bit-identical outputs to a restart-free run (test-asserted).
+
+Everything is observable at the router's ``GET /stats``: per-replica
+occupancy/state plus fleet counters (``requests``, ``responses``,
+``hedges``/``hedges_won``/``hedge_budget_denied``, ``retries``,
+``requests_lost``, ``ejections``, ``readmissions``, ``restarts``).
+
+Docs: ``docs/serving.md`` "Running a fleet".
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from ..faults import poll_until_idle
+from ..profiler import Reservoir
+from .engine import ServingError
+
+#: transport-level failures that justify trying another replica — the
+#: predict path is stateless and generation is seed-deterministic, so
+#: re-executing elsewhere is always semantically safe. NOTE: a socket
+#: TIMEOUT (TimeoutError ⊂ OSError) is carved back out by the callers:
+#: the replica is still WORKING on the request, so re-dispatching
+#: would run it twice concurrently and penalize a healthy replica —
+#: timeouts map to a terminal 504 instead
+_RETRYABLE_EXC = (ConnectionError, OSError, http.client.HTTPException)
+
+
+def _timeout_response(timeout_s: float):
+    """Terminal (status, headers, body) for a router-side socket
+    timeout: 504, never retried, never counted against the replica."""
+    return (504, {}, json.dumps(
+        {"error": f"no replica response within {timeout_s:g}s "
+                  "(router socket timeout)"}).encode())
+
+_JSON_HEADERS = {"Content-Type": "application/json"}
+
+
+class FleetError(ServingError):
+    """Fleet-level failure (no replica could take the request)."""
+
+
+class NoReplicasError(FleetError):
+    """No eligible replica is available (HTTP 503 + Retry-After)."""
+
+
+def _get_json(host: str, port: int, path: str,
+              timeout: float) -> Tuple[int, Dict]:
+    """One GET on a fresh connection -> (status, parsed body or {})."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        r = conn.getresponse()
+        raw = r.read()
+        try:
+            body = json.loads(raw) if raw else {}
+        except ValueError:
+            body = {}
+        return r.status, body
+    finally:
+        conn.close()
+
+
+class FleetMetrics:
+    """Fleet-level counters (same threading discipline as
+    :class:`~.metrics.ServingMetrics`: scalar counters via
+    :meth:`inc`, never ``+=`` — HTTP handler threads, hedge arms, the
+    poll loop, and rolling restarts all write here)."""
+
+    def __init__(self, latency_window: int = 8192):
+        self._lock = threading.Lock()
+        self.requests = 0            # client requests entering the router
+        self.responses = 0           # terminal 2xx returned
+        self.client_errors = 0       # terminal 4xx passed through
+        self.server_errors = 0       # terminal 5xx passed through
+        self.routed = 0              # dispatch attempts to replicas
+        self.retries = 0             # re-dispatches after 503/conn fail
+        self.hedges = 0              # hedge arms launched
+        self.hedges_won = 0          # hedge arm answered first
+        self.hedge_budget_denied = 0  # hedge wanted, budget empty
+        self.requests_lost = 0       # retryable failure, no replica left
+        self.ejections = 0           # health-gated removals
+        self.readmissions = 0        # recoveries back into routing
+        self.restarts = 0            # rolling-restart cycles completed
+        self.streams = 0             # streaming generations proxied
+        self.latency_ms = Reservoir(latency_window)
+
+    def inc(self, field: str, n: int = 1):
+        """Thread-safe counter increment."""
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def snapshot(self) -> Dict:
+        return {
+            "requests": self.requests,
+            "responses": self.responses,
+            "client_errors": self.client_errors,
+            "server_errors": self.server_errors,
+            "routed": self.routed,
+            "retries": self.retries,
+            "hedges": self.hedges,
+            "hedges_won": self.hedges_won,
+            "hedge_budget_denied": self.hedge_budget_denied,
+            "requests_lost": self.requests_lost,
+            "ejections": self.ejections,
+            "readmissions": self.readmissions,
+            "restarts": self.restarts,
+            "streams": self.streams,
+            "latency_ms": {k: round(v, 3) for k, v in
+                           self.latency_ms.snapshot().items()},
+        }
+
+
+class Replica:
+    """One fleet member: address + live routing state.
+
+    In-process replicas carry their :class:`~.InferenceServer` in
+    ``server`` and (for rolling restarts) a zero-arg ``factory`` that
+    builds a fresh, warmed server. Remote replicas are just
+    (host, port) — they participate in routing and health but cannot
+    be restarted by :meth:`ReplicaFleet.rolling_restart`.
+    """
+
+    def __init__(self, replica_id: str, host: str, port: int,
+                 server=None, factory: Optional[Callable[[], Any]] = None):
+        self.id = replica_id
+        self.host = host
+        self.port = int(port)
+        self.server = server
+        self.factory = factory
+        self._lock = threading.Lock()
+        # membership state (poll loop + router failure notes mutate it)
+        self.admitted = True      # health-gated: False = ejected
+        self.cordoned = False     # operator/rolling-restart exclusion
+        self.ready = True         # replica-side readiness (draining?)
+        self.fails = 0            # consecutive failed polls/dispatches
+        self.ejected_ever = False
+        # routing state
+        self.in_flight = 0        # router-tracked live dispatches
+        self.routed = 0           # total dispatches sent here
+        self.summary: Dict = {}   # last-polled /stats summary block
+        self.last_poll: Optional[float] = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def eligible(self) -> bool:
+        """May receive NEW work right now."""
+        return self.admitted and not self.cordoned and self.ready
+
+    def score(self) -> int:
+        """Occupancy score the router minimizes: the router's own
+        live in-flight count (instantaneous) plus the replica's
+        last-polled ``summary.load`` (queued + active rows/slots —
+        includes traffic from other routers or direct clients). The
+        two overlap while a poll is stale; the ordering they induce is
+        what matters, not the absolute value."""
+        return self.in_flight + int(self.summary.get("load", 0))
+
+    def begin(self):
+        with self._lock:
+            self.in_flight += 1
+            self.routed += 1
+
+    def end(self):
+        with self._lock:
+            self.in_flight -= 1
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "id": self.id,
+                "address": self.address,
+                "admitted": self.admitted,
+                "cordoned": self.cordoned,
+                "ready": self.ready,
+                "eligible": self.eligible(),
+                "fails": self.fails,
+                "in_flight": self.in_flight,
+                "requests_routed": self.routed,
+                "score": self.in_flight + int(self.summary.get("load", 0)),
+                "summary": self.summary,
+            }
+
+
+class ReplicaFleet:
+    """Membership + health for a set of replicas.
+
+    ``poll_interval_s`` drives the background health loop (pass
+    ``None`` to disable it and call :meth:`poll_now` explicitly —
+    deterministic tests do). ``eject_after`` consecutive failed polls
+    (connection failure or a wedged ``/healthz``) eject a replica from
+    routing; the first clean poll re-admits it.
+    """
+
+    def __init__(self, poll_interval_s: Optional[float] = 0.25,
+                 eject_after: int = 2, probe_timeout_s: float = 5.0):
+        self.metrics = FleetMetrics()
+        self.eject_after = int(eject_after)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.poll_interval_s = poll_interval_s
+        self._lock = threading.Lock()
+        self._replicas: List[Replica] = []
+        self._next_id = 0
+        self._running = True
+        self._poll_thread: Optional[threading.Thread] = None
+        if poll_interval_s is not None:
+            self._poll_thread = threading.Thread(
+                target=self._poll_loop, daemon=True, name="fleet-health")
+            self._poll_thread.start()
+
+    # -- membership ----------------------------------------------------
+    def add(self, server=None, host: Optional[str] = None,
+            port: Optional[int] = None,
+            factory: Optional[Callable[[], Any]] = None,
+            replica_id: Optional[str] = None) -> Replica:
+        """Register a replica: an in-process ``InferenceServer`` (pass
+        ``server=``, plus ``factory=`` to make it restartable), or a
+        remote one (pass ``host=``/``port=``)."""
+        if server is not None:
+            host, port = server.host, server.port
+        if host is None or port is None:
+            raise ValueError("pass server= or host=/port=")
+        with self._lock:
+            if replica_id is None:
+                replica_id = f"r{self._next_id}"
+                self._next_id += 1
+            if any(r.id == replica_id for r in self._replicas):
+                raise ValueError(f"replica id {replica_id!r} already "
+                                 "registered")
+            rep = Replica(replica_id, host, port, server=server,
+                          factory=factory)
+            self._replicas.append(rep)
+            return rep
+
+    def remove(self, replica_id: str) -> Replica:
+        with self._lock:
+            for i, r in enumerate(self._replicas):
+                if r.id == replica_id:
+                    return self._replicas.pop(i)
+        raise KeyError(f"unknown replica {replica_id!r}")
+
+    def get(self, replica_id: str) -> Replica:
+        with self._lock:
+            for r in self._replicas:
+                if r.id == replica_id:
+                    return r
+        raise KeyError(f"unknown replica {replica_id!r}")
+
+    def replicas(self) -> List[Replica]:
+        with self._lock:
+            return list(self._replicas)
+
+    def eligible(self) -> List[Replica]:
+        return [r for r in self.replicas() if r.eligible()]
+
+    def cordon(self, replica_id: str):
+        """Exclude a replica from NEW work (in-flight work finishes);
+        the rolling restart's first move, also useful by hand."""
+        self.get(replica_id).cordoned = True
+
+    def uncordon(self, replica_id: str):
+        self.get(replica_id).cordoned = False
+
+    # -- health --------------------------------------------------------
+    def _poll_loop(self):
+        while self._running:
+            try:
+                self.poll_now()
+            except Exception:   # noqa: BLE001 — health must not die
+                pass
+            time.sleep(self.poll_interval_s)
+
+    def poll_now(self):
+        """One synchronous health/occupancy sweep over every replica
+        (the background loop calls this; tests and operators can too
+        for a deterministic refresh)."""
+        for rep in self.replicas():
+            self._poll_replica(rep)
+
+    def _poll_replica(self, rep: Replica):
+        ok = False
+        summary: Dict = {}
+        try:
+            hz, _ = _get_json(rep.host, rep.port, "/healthz",
+                              self.probe_timeout_s)
+            st, stats = _get_json(rep.host, rep.port, "/stats",
+                                  self.probe_timeout_s)
+            # a wedged scheduler (healthz 503) is as ejectable as a
+            # dead socket; /stats failing means we can't route on it
+            ok = hz == 200 and st == 200
+            if ok:
+                summary = stats.get("summary") or {}
+        except _RETRYABLE_EXC:
+            ok = False
+        rep.last_poll = time.monotonic()
+        if ok:
+            readmit = False
+            with rep._lock:
+                rep.summary = summary
+                rep.ready = bool(summary.get("ready", True))
+                rep.fails = 0
+                if not rep.admitted:
+                    rep.admitted = True
+                    readmit = True
+            if readmit:
+                self.metrics.inc("readmissions")
+        elif not rep.cordoned:
+            # a cordoned replica is EXPECTED to be dark (it is being
+            # restarted); counting that window as an ejection would
+            # turn every rolling restart into a fake health incident
+            self.note_failure(rep)
+
+    def note_failure(self, rep: Replica):
+        """Record one failed contact (poll or live dispatch); ejects
+        after ``eject_after`` consecutive failures. The router calls
+        this on connection errors so ejection doesn't wait for the
+        next poll tick. Cordoned replicas are exempt here too: a
+        racer that picked the victim just before the cordon and then
+        hit its dead port must not turn a rolling restart into a
+        fake ejection."""
+        if rep.cordoned:
+            return
+        eject = False
+        with rep._lock:
+            rep.fails += 1
+            if rep.admitted and rep.fails >= self.eject_after:
+                rep.admitted = False
+                rep.ejected_ever = True
+                eject = True
+        if eject:
+            self.metrics.inc("ejections")
+
+    # -- rolling restart ----------------------------------------------
+    def rolling_restart(self, drain_timeout_s: float = 30.0,
+                        ready_timeout_s: float = 120.0) -> bool:
+        """Restart every restartable replica ONE AT A TIME with zero
+        accepted-request loss: cordon (router steers new work away,
+        racers get 503 + Retry-After and are retried elsewhere), wait
+        for router-tracked in-flight work to finish, ``drain()`` +
+        ``stop()`` the server, rebuild it via ``factory`` (which
+        should warm the new server before returning), wait until the
+        new process answers ``/readyz`` and ``/healthz``, re-admit,
+        uncordon, move on. Replicas without a ``factory`` (remote, or
+        added without one) are skipped. Returns True when every
+        restarted replica drained cleanly and came back ready within
+        its budget."""
+        ok_all = True
+        for rep in self.replicas():
+            if rep.factory is None:
+                continue
+            self.cordon(rep.id)
+            try:
+                # the router decrements in_flight only after a
+                # replica's response is fully back, so this wait plus
+                # the server-side drain covers every accepted request
+                poll_until_idle(lambda: rep.in_flight == 0,
+                                drain_timeout_s)
+                clean = True
+                if rep.server is not None:
+                    clean = bool(rep.server.drain(drain_timeout_s))
+                    rep.server.stop()
+                new = rep.factory()
+                with rep._lock:
+                    rep.server = new
+                    rep.host = new.host
+                    rep.port = int(new.port)
+                    rep.summary = {}
+                ready = self._wait_ready(rep, ready_timeout_s)
+                with rep._lock:
+                    rep.fails = 0
+                    # a replacement that never answered /readyz within
+                    # its budget must NOT be force-admitted: leave it
+                    # ejected (the poll loop re-admits the moment it
+                    # comes good; without a poll loop the False return
+                    # is the operator's signal)
+                    rep.admitted = ready
+                    rep.ready = ready
+                    if not ready:
+                        rep.ejected_ever = True
+                self.metrics.inc("restarts")
+                if not ready:
+                    self.metrics.inc("ejections")
+                ok_all = ok_all and clean and ready
+            except Exception:   # noqa: BLE001 — a failed rebuild
+                # (factory raise, drain blow-up) must not leave a
+                # dead address looking eligible, and must not abort
+                # the restarts of the replicas AFTER this one
+                with rep._lock:
+                    rep.admitted = False
+                    rep.ready = False
+                    rep.ejected_ever = True
+                self.metrics.inc("ejections")
+                ok_all = False
+            finally:
+                self.uncordon(rep.id)
+        return ok_all
+
+    def _wait_ready(self, rep: Replica, timeout_s: float) -> bool:
+        def probe() -> bool:
+            try:
+                rz, _ = _get_json(rep.host, rep.port, "/readyz",
+                                  self.probe_timeout_s)
+                hz, _ = _get_json(rep.host, rep.port, "/healthz",
+                                  self.probe_timeout_s)
+                return rz == 200 and hz == 200
+            except _RETRYABLE_EXC:
+                return False
+        return poll_until_idle(probe, timeout_s, quiet_obs=1)
+
+    def snapshot(self) -> Dict:
+        reps = [r.snapshot() for r in self.replicas()]
+        s = self.metrics.snapshot()
+        s["replicas"] = reps
+        s["eligible_replicas"] = sum(1 for r in reps if r["eligible"])
+        s["fleet_load"] = sum(r["score"] for r in reps)
+        return s
+
+    def stop(self, stop_replicas: bool = False):
+        self._running = False
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=5.0)
+        if stop_replicas:
+            for rep in self.replicas():
+                if rep.server is not None:
+                    rep.server.stop()
+
+
+class _FleetStream:
+    """Iterator over a proxied ndjson stream. :meth:`close` (also run
+    by ``__del__`` and on exhaustion) closes the upstream connection —
+    aborting the generation and freeing the backing replica's
+    slot/blocks — and releases the router's in-flight count. A bare
+    generator could leak the in-flight count if abandoned before the
+    first ``next()``; this class cannot."""
+
+    def __init__(self, rep: Replica, conn, resp):
+        self._rep = rep
+        self._conn = conn
+        self._resp = resp
+        self._closed = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Dict:
+        if self._closed:
+            raise StopIteration
+        try:
+            line = self._resp.readline()
+            while line and not line.strip():
+                line = self._resp.readline()
+        except Exception:
+            self.close()
+            raise
+        if not line:
+            self.close()
+            raise StopIteration
+        return json.loads(line)
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._conn.close()
+        self._rep.end()
+
+    def __del__(self):
+        self.close()
+
+
+class _ConnPool:
+    """Keep-alive HTTP connections to replicas, checked out per
+    request (one connection is never shared by two threads at once).
+    Bounded per address; a restarted replica usually changes port, and
+    a stale keep-alive on the same port surfaces as a retryable error
+    handled by the caller."""
+
+    def __init__(self, timeout_s: float, max_per_key: int = 32):
+        self._lock = threading.Lock()
+        self._idle: Dict[Tuple[str, int], List] = {}
+        self.timeout_s = float(timeout_s)
+        self.max_per_key = int(max_per_key)
+
+    def take(self, host: str, port: int):
+        with self._lock:
+            stack = self._idle.get((host, port))
+            if stack:
+                return stack.pop()
+        return http.client.HTTPConnection(host, port,
+                                          timeout=self.timeout_s)
+
+    def give(self, host: str, port: int, conn):
+        with self._lock:
+            stack = self._idle.setdefault((host, port), [])
+            if len(stack) < self.max_per_key:
+                stack.append(conn)
+                return
+        conn.close()
+
+    def prune(self, live_keys):
+        """Close and drop idle connections to addresses no longer in
+        the fleet — every rolling restart moves a replica to a fresh
+        ephemeral port, and without pruning the old address' stack
+        would strand up to ``max_per_key`` open sockets forever."""
+        with self._lock:
+            dead = [k for k in self._idle if k not in live_keys]
+            stacks = [self._idle.pop(k) for k in dead]
+        for stack in stacks:
+            for conn in stack:
+                conn.close()
+
+    def close_all(self):
+        with self._lock:
+            stacks, self._idle = self._idle, {}
+        for stack in stacks.values():
+            for conn in stack:
+                conn.close()
+
+
+class FleetRouter:
+    """Occupancy-aware request router over a :class:`ReplicaFleet`.
+
+    Python surface: :meth:`post` (predict/generate JSON in, (status,
+    body) out), :meth:`stream` (streamed generation as an iterator of
+    parsed ndjson objects), :meth:`stats`. HTTP surface (optional,
+    :meth:`serve`): the same route table as one replica — ``POST
+    /predict``, ``/generate``, ``/v1/models/<name>/predict|generate``
+    — plus fleet-level ``GET /stats``, ``/healthz``, ``/readyz``,
+    and a proxied ``GET /v1/models``, so a fleet drops in wherever a
+    single replica stood.
+
+    Hedging (predict only — it is stateless, so duplicating work is
+    always safe): when the chosen replica hasn't answered within
+    ``hedge_after_ms``, the SAME request is issued to the
+    next-best replica and the first response wins. A token bucket
+    caps amplification: ``hedge_budget_burst`` tokens to start,
+    refilled ``hedge_budget_ratio`` per completed request, one token
+    per hedge — so hedges can never exceed ``burst + ratio *
+    requests`` no matter how sick the fleet is. ``hedge_after_ms=None``
+    (default) disables hedging.
+
+    Shed retry: a 503 (queue full / draining) or a connection failure
+    excludes that replica for this request and retries the next-best
+    one, up to ``max_attempts`` (default: every currently-eligible
+    replica once). Only transport-level and shed failures are
+    retried; 400/404/500/504 are the request's own fate and pass
+    through unchanged.
+    """
+
+    def __init__(self, fleet: ReplicaFleet,
+                 hedge_after_ms: Optional[float] = None,
+                 hedge_budget_ratio: float = 0.1,
+                 hedge_budget_burst: float = 4.0,
+                 max_attempts: Optional[int] = None,
+                 timeout_s: float = 60.0):
+        self.fleet = fleet
+        self.metrics = fleet.metrics
+        self.hedge_after_ms = (None if hedge_after_ms is None
+                               else float(hedge_after_ms))
+        self.hedge_budget_ratio = float(hedge_budget_ratio)
+        self.hedge_budget_burst = float(hedge_budget_burst)
+        self.max_attempts = max_attempts
+        self.timeout_s = float(timeout_s)
+        self._budget_lock = threading.Lock()
+        self._budget = self.hedge_budget_burst
+        self._pool = _ConnPool(timeout_s)
+        self._live_addrs: Set[Tuple[str, int]] = set()
+        self._rr = 0               # tie-break rotation among equals
+        self._rr_lock = threading.Lock()
+        self.httpd = None
+        self.host: Optional[str] = None
+        self.port: Optional[int] = None
+        self._http_thread: Optional[threading.Thread] = None
+
+    # -- replica selection --------------------------------------------
+    def _pick(self, excluded: Set[str]) -> Optional[Replica]:
+        reps = self.fleet.replicas()
+        addrs = {(r.host, r.port) for r in reps}
+        if addrs != self._live_addrs:
+            # membership/port change (restart, eject+rebuild): drop
+            # pooled keep-alives to addresses that no longer exist
+            self._live_addrs = addrs
+            self._pool.prune(addrs)
+        cands = [r for r in reps
+                 if r.eligible() and r.id not in excluded]
+        if not cands:
+            return None
+        with self._rr_lock:
+            self._rr += 1
+            base = self._rr
+        # min occupancy score; rotate among score ties so equal
+        # replicas share load instead of the list head taking it all
+        n = len(cands)
+        best = min(range(n),
+                   key=lambda i: (cands[i].score(), (i + base) % n))
+        return cands[best]
+
+    # -- hedge budget --------------------------------------------------
+    def _take_budget(self) -> bool:
+        with self._budget_lock:
+            if self._budget >= 1.0:
+                self._budget -= 1.0
+                return True
+        self.metrics.inc("hedge_budget_denied")
+        return False
+
+    def _refill_budget(self):
+        with self._budget_lock:
+            self._budget = min(self.hedge_budget_burst,
+                               self._budget + self.hedge_budget_ratio)
+
+    # -- transport -----------------------------------------------------
+    def _roundtrip(self, rep: Replica, path: str, body: bytes):
+        """One POST to one replica -> (status, headers, data). Retries
+        exactly once on a stale keep-alive connection; raises a
+        retryable exception when the replica is genuinely
+        unreachable."""
+        for fresh in (False, True):
+            conn = (http.client.HTTPConnection(rep.host, rep.port,
+                                               timeout=self.timeout_s)
+                    if fresh else self._pool.take(rep.host, rep.port))
+            try:
+                conn.request("POST", path, body=body,
+                             headers=_JSON_HEADERS)
+                resp = conn.getresponse()
+                data = resp.read()
+            except _RETRYABLE_EXC as e:
+                conn.close()
+                # a timeout means the replica is still computing —
+                # retrying on a fresh connection would double the work
+                if fresh or isinstance(e, TimeoutError):
+                    raise
+                continue
+            self._pool.give(rep.host, rep.port, conn)
+            return resp.status, dict(resp.getheaders()), data
+        raise ConnectionError("unreachable")   # not reached
+
+    def _tracked(self, rep: Replica, path: str, body: bytes):
+        rep.begin()
+        self.metrics.inc("routed")
+        try:
+            return self._roundtrip(rep, path, body)
+        finally:
+            rep.end()
+
+    @staticmethod
+    def _retryable(out) -> bool:
+        """A result worth trying another replica for: transport
+        failure, or an explicit shed/draining 503."""
+        return isinstance(out, Exception) or out[0] == 503
+
+    # -- dispatch ------------------------------------------------------
+    def post(self, path: str, payload) -> Tuple[int, Dict]:
+        """Route one JSON request; returns (status, parsed body).
+        Retries sheds/connection failures against other replicas;
+        hedges slow predicts. 503 with no replica left to try counts
+        as ``requests_lost``."""
+        status, _hdrs, data = self.post_raw(path,
+                                            json.dumps(payload).encode())
+        try:
+            body = json.loads(data) if data else {}
+        except ValueError:
+            body = {"error": "unparseable replica response"}
+        return status, body
+
+    def post_raw(self, path: str, body: bytes):
+        """Bytes-in/bytes-out dispatch (the HTTP front-end's path):
+        returns (status, response headers, response bytes)."""
+        self.metrics.inc("requests")
+        hedge = (self.hedge_after_ms is not None
+                 and not path.rstrip("/").endswith("/generate")
+                 and path != "/generate")
+        t0 = time.perf_counter()
+        excluded: Set[str] = set()
+        last = None
+        attempts = 0
+        max_attempts = self.max_attempts or max(1, len(self.fleet.eligible()))
+        while attempts < max_attempts:
+            rep = self._pick(excluded)
+            if rep is None:
+                break
+            attempts += 1
+            if attempts > 1:
+                self.metrics.inc("retries")
+            out = (self._attempt_hedged(rep, path, body, excluded)
+                   if hedge else self._attempt_plain(rep, path, body,
+                                                     excluded))
+            if self._retryable(out):
+                last = out
+                continue
+            status, hdrs, data = out
+            self._refill_budget()
+            self.metrics.latency_ms.record(
+                (time.perf_counter() - t0) * 1e3)
+            if 200 <= status < 300:
+                self.metrics.inc("responses")
+            elif status < 500:
+                self.metrics.inc("client_errors")
+            else:
+                self.metrics.inc("server_errors")
+            return status, hdrs, data
+        # every eligible replica shed or failed: the request is LOST
+        # from the fleet's point of view (the client may retry later)
+        self._refill_budget()
+        self.metrics.inc("requests_lost")
+        if isinstance(last, tuple):
+            status, hdrs, data = last
+            hdrs.setdefault("Retry-After", "1")
+            return status, hdrs, data
+        return 503, {"Retry-After": "1"}, json.dumps(
+            {"error": "no replica available"}).encode()
+
+    def _attempt_plain(self, rep: Replica, path: str, body: bytes,
+                       excluded: Set[str]):
+        """Single-arm dispatch in the calling thread."""
+        try:
+            out = self._tracked(rep, path, body)
+        except _RETRYABLE_EXC as e:
+            if isinstance(e, TimeoutError):
+                # the replica is still working — re-dispatching would
+                # run the request twice and smear a healthy replica
+                return _timeout_response(self.timeout_s)
+            self.fleet.note_failure(rep)
+            excluded.add(rep.id)
+            return e
+        if out[0] == 503:
+            excluded.add(rep.id)
+        return out
+
+    def _attempt_hedged(self, rep: Replica, path: str, body: bytes,
+                        excluded: Set[str]):
+        """Primary dispatch with an optional hedge arm: wait
+        ``hedge_after_ms`` for the primary; if silent, re-issue to the
+        next-best replica (budget permitting) and take whichever
+        answers first. Returns the winning (status, headers, data),
+        or a retryable failure when every launched arm failed."""
+        results: "queue.Queue" = queue.Queue()
+
+        def run(r: Replica):
+            try:
+                out = self._tracked(r, path, body)
+            except _RETRYABLE_EXC as e:
+                if isinstance(e, TimeoutError):
+                    out = _timeout_response(self.timeout_s)
+                else:
+                    self.fleet.note_failure(r)
+                    out = e
+            results.put((r, out))
+
+        threading.Thread(target=run, args=(rep,), daemon=True,
+                         name="fleet-primary").start()
+        arms = 1
+        first = None
+        try:
+            first = results.get(timeout=self.hedge_after_ms / 1e3)
+        except queue.Empty:
+            h = self._pick(excluded | {rep.id})
+            if h is not None and self._take_budget():
+                self.metrics.inc("hedges")
+                threading.Thread(target=run, args=(h,), daemon=True,
+                                 name="fleet-hedge").start()
+                arms += 1
+        if first is None:
+            first = results.get()
+        r1, out1 = first
+        winner = first
+        if self._retryable(out1) and arms > 1:
+            # first arrival failed retryably — the other arm may still
+            # deliver; losing its answer would turn a hedge into a loss
+            winner = results.get()
+        rwin, out = winner
+        if self._retryable(out):
+            excluded.add(r1.id)
+            excluded.add(rwin.id)
+            return out
+        if rwin is not rep:
+            self.metrics.inc("hedges_won")
+        # the losing arm (if any) finishes in the background and its
+        # response is discarded — that waste is exactly what the
+        # budget bounds
+        return out
+
+    # -- streaming -----------------------------------------------------
+    def open_stream(self, path: str, body: bytes):
+        """Route a streaming generation: returns
+        ``("stream", replica, conn, resp)`` with the response open
+        (the caller MUST call ``conn.close()`` + ``replica.end()``
+        when done — closing mid-stream is how a client disconnect
+        propagates and frees the replica's slot/blocks), or
+        ``("response", status, headers, data)`` for admission
+        failures after retries."""
+        self.metrics.inc("requests")
+        excluded: Set[str] = set()
+        last = None
+        attempts = 0
+        max_attempts = self.max_attempts or max(1, len(self.fleet.eligible()))
+        while attempts < max_attempts:
+            rep = self._pick(excluded)
+            if rep is None:
+                break
+            attempts += 1
+            if attempts > 1:
+                self.metrics.inc("retries")
+            rep.begin()
+            self.metrics.inc("routed")
+            conn = http.client.HTTPConnection(rep.host, rep.port,
+                                              timeout=self.timeout_s)
+            try:
+                conn.request("POST", path, body=body,
+                             headers=_JSON_HEADERS)
+                resp = conn.getresponse()
+            except _RETRYABLE_EXC as e:
+                conn.close()
+                rep.end()
+                if isinstance(e, TimeoutError):
+                    st, hdrs, data = _timeout_response(self.timeout_s)
+                    self.metrics.inc("server_errors")
+                    return ("response", st, hdrs, data)
+                self.fleet.note_failure(rep)
+                excluded.add(rep.id)
+                last = None
+                continue
+            if resp.status != 200:
+                data = resp.read()
+                conn.close()
+                rep.end()
+                if resp.status == 503:
+                    excluded.add(rep.id)
+                    last = (resp.status, dict(resp.getheaders()), data)
+                    continue
+                if 400 <= resp.status < 500:
+                    self.metrics.inc("client_errors")
+                else:
+                    self.metrics.inc("server_errors")
+                return ("response", resp.status,
+                        dict(resp.getheaders()), data)
+            self.metrics.inc("streams")
+            return ("stream", rep, conn, resp)
+        self.metrics.inc("requests_lost")
+        if last is not None:
+            st, hdrs, data = last
+            hdrs.setdefault("Retry-After", "1")
+            return ("response", st, hdrs, data)
+        return ("response", 503, {"Retry-After": "1"},
+                json.dumps({"error": "no replica available"}).encode())
+
+    def stream(self, path: str, payload):
+        """Streamed generation through the fleet: yields parsed ndjson
+        objects. ``close()`` on the generator (or abandoning it)
+        closes the upstream connection, which frees the backing
+        replica's slot/blocks exactly like a direct client
+        disconnect."""
+        if isinstance(payload, dict):
+            payload = dict(payload, stream=True)
+        opened = self.open_stream(path, json.dumps(payload).encode())
+        if opened[0] == "response":
+            _, status, _hdrs, data = opened
+            try:
+                body = json.loads(data) if data else {}
+            except ValueError:
+                body = {}
+            msg = (f"stream admission failed ({status}): "
+                   f"{body.get('error', '?')}")
+            raise NoReplicasError(msg) if status == 503 \
+                else FleetError(msg)
+        _, rep, conn, resp = opened
+        return _FleetStream(rep, conn, resp)
+
+    # -- observability -------------------------------------------------
+    def stats(self) -> Dict:
+        """Fleet counters + per-replica state/occupancy — the fleet
+        analogue of a replica's ``GET /stats``."""
+        return {"fleet": self.fleet.snapshot()}
+
+    def healthy(self) -> bool:
+        """Router liveness: at least one admitted replica."""
+        return any(r.admitted for r in self.fleet.replicas())
+
+    def ready(self) -> bool:
+        """Router readiness: at least one eligible replica."""
+        return bool(self.fleet.eligible())
+
+    # -- HTTP front-end ------------------------------------------------
+    def serve(self, host: str = "127.0.0.1", port: int = 0,
+              max_body_bytes: int = 256 * 1024 * 1024):
+        """Start the fleet's own HTTP listener (same route table as a
+        replica, fleet-level probes/stats) and return (host, port)."""
+        router = self
+
+        class _Server(ThreadingHTTPServer):
+            request_queue_size = 128
+            daemon_threads = True
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, obj, code=200, headers=None):
+                body = (obj if isinstance(obj, bytes)
+                        else json.dumps(obj).encode())
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    for k, v in (headers or {}).items():
+                        self.send_header(k, v)
+                    self.end_headers()
+                    self.wfile.write(body)
+                except OSError:
+                    # the client gave up (its own timeout) while the
+                    # dispatch ran — routine, not a router error, and
+                    # must not traceback-spam stderr per occurrence
+                    self.close_connection = True
+
+            def do_GET(self):
+                try:
+                    if self.path == "/stats":
+                        self._json(router.stats())
+                    elif self.path == "/healthz":
+                        ok = router.healthy()
+                        self._json({"status": "ok" if ok else
+                                    "no replicas"}, 200 if ok else 503)
+                    elif self.path == "/readyz":
+                        if router.ready():
+                            self._json({"ready": True})
+                        else:
+                            self._json({"ready": False,
+                                        "reason": "no eligible replica"},
+                                       503, headers={"Retry-After": "1"})
+                    elif self.path in ("/v1/models", "/v1/models/"):
+                        rep = router._pick(set())
+                        if rep is None:
+                            self._json({"error": "no replica available"},
+                                       503, headers={"Retry-After": "1"})
+                        else:
+                            st, body = _get_json(
+                                rep.host, rep.port, "/v1/models",
+                                router.timeout_s)
+                            self._json(body, st)
+                    else:
+                        self._json({"error": "not found"}, 404)
+                except Exception as e:   # noqa: BLE001
+                    self._json({"error": str(e)}, 500)
+
+            def do_POST(self):
+                # same keep-alive body discipline as InferenceServer:
+                # bad/oversized bodies must not desync or OOM
+                if self.headers.get("Transfer-Encoding"):
+                    self._json({"error": "Transfer-Encoding not "
+                                "supported; send Content-Length"}, 501)
+                    self.close_connection = True
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                except (TypeError, ValueError):
+                    n = -1
+                if n < 0:
+                    self._json({"error": "bad Content-Length"}, 400)
+                    self.close_connection = True
+                    return
+                if n > max_body_bytes:
+                    self._json({"error": "request body too large"}, 413)
+                    self.close_connection = True
+                    return
+                raw = self.rfile.read(n)
+                streaming = False
+                # only generate routes can stream — don't pay a json
+                # parse of (possibly huge) predict bodies just to
+                # sniff a flag they can't carry
+                if self.path == "/generate" or \
+                        self.path.rstrip("/").endswith("/generate"):
+                    try:
+                        req = json.loads(raw)
+                        streaming = bool(isinstance(req, dict)
+                                         and req.get("stream"))
+                    except ValueError:
+                        pass   # replica answers 400; just forward
+                if streaming:
+                    self._proxy_stream(raw)
+                    return
+                status, hdrs, data = router.post_raw(self.path, raw)
+                extra = {}
+                if "Retry-After" in hdrs:
+                    extra["Retry-After"] = hdrs["Retry-After"]
+                self._json(data, status, headers=extra)
+
+            def _proxy_stream(self, raw: bytes):
+                opened = router.open_stream(self.path, raw)
+                if opened[0] == "response":
+                    _, status, hdrs, data = opened
+                    extra = {}
+                    if "Retry-After" in hdrs:
+                        extra["Retry-After"] = hdrs["Retry-After"]
+                    self._json(data, status, headers=extra)
+                    return
+                _, rep, conn, resp = opened
+                try:
+                    try:
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/x-ndjson")
+                        self.send_header("Transfer-Encoding", "chunked")
+                        self.end_headers()
+                    except OSError:
+                        self.close_connection = True
+                        return
+                    # upstream READ and downstream WRITE failures are
+                    # different events and must not be conflated: a
+                    # dying replica (IncompleteRead — an HTTPException,
+                    # NOT an OSError — or a read timeout) leaves a LIVE
+                    # client that is owed the same in-band error chunk
+                    # the replica-direct path delivers; a vanished
+                    # client just needs the upstream closed (which
+                    # aborts the generation and frees its slot/blocks)
+                    err = None
+                    while True:
+                        try:
+                            line = resp.readline()
+                        except _RETRYABLE_EXC as e:
+                            err = {"error": "replica stream failed: "
+                                            f"{type(e).__name__}: {e}",
+                                   "status": 500, "done": True}
+                            break
+                        if not line:
+                            break
+                        if not line.strip():
+                            continue
+                        try:
+                            self.wfile.write(
+                                f"{len(line):X}\r\n".encode()
+                                + line + b"\r\n")
+                            self.wfile.flush()
+                        except OSError:
+                            # downstream client vanished mid-stream
+                            self.close_connection = True
+                            return
+                    try:
+                        if err is not None:
+                            data = (json.dumps(err) + "\n").encode()
+                            self.wfile.write(
+                                f"{len(data):X}\r\n".encode()
+                                + data + b"\r\n")
+                        self.wfile.write(b"0\r\n\r\n")
+                    except OSError:
+                        self.close_connection = True
+                finally:
+                    conn.close()
+                    rep.end()
+
+        self.httpd = _Server((host, port), Handler)
+        self.host = self.httpd.server_address[0]
+        self.port = self.httpd.server_address[1]
+        self._http_thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True,
+            name="fleet-http")
+        self._http_thread.start()
+        return self.host, self.port
+
+    def stop(self):
+        """Stop the router's HTTP listener (if started) and drop
+        pooled connections. Replicas and the fleet poll loop are
+        owned by :class:`ReplicaFleet` — stop them there."""
+        if self.httpd is not None:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+            self.httpd = None
+        self._pool.close_all()

@@ -1431,6 +1431,14 @@ class GenerationEngine:
         instead of paying a fresh compile set per fault scenario."""
         self._faults = injector
 
+    @property
+    def draining(self) -> bool:
+        """True once :meth:`drain` has started (new submissions shed
+        with 503 + Retry-After). Surfaced in the /stats summary so
+        external load balancers steer away without parsing error
+        counters."""
+        return self._draining
+
     def alive(self) -> bool:
         """Liveness for ``/healthz``: False only when the scheduler is
         WEDGED — thread dead while it should be running, or no

@@ -41,6 +41,7 @@ class ServingMetrics:
         self.device_ms = Reservoir(latency_window)     # device call
         self.queue_depth = 0       # gauge, updated by the batcher
         self.queue_max = 0
+        self.inflight = 0          # gauge: rows in the device call NOW
         # engine compile cache
         self.compiles = 0
         self.cache_hits = 0
@@ -74,6 +75,7 @@ class ServingMetrics:
             },
             "queue_depth": self.queue_depth,
             "queue_max": self.queue_max,
+            "inflight": self.inflight,
             "batches": self.batches,
             "mean_batch": round(self.mean_batch(), 3),
             "batch_hist": self.batch_hist.snapshot(),

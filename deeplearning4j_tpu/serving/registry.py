@@ -57,11 +57,18 @@ class ServedModel:
             return self.batcher.submit(inputs, outputs,
                                        timeout_ms=timeout_ms)
         # direct path (batching=False): synchronous, so timeout_ms has
-        # no queue to bound — but request metrics must still flow
+        # no queue to bound — but request metrics must still flow,
+        # including the live-occupancy gauge the /stats summary feeds
+        # to routers (without it a saturated unbatched replica would
+        # read as idle and keep attracting fleet traffic)
         m = self.metrics
         m.inc("requests")
         t0 = time.perf_counter()
-        res = self.engine.predict(inputs, outputs)
+        m.inc("inflight")
+        try:
+            res = self.engine.predict(inputs, outputs)
+        finally:
+            m.inc("inflight", -1)
         m.inc("responses")
         m.latency_ms.record((time.perf_counter() - t0) * 1e3)
         return res
@@ -92,6 +99,26 @@ class ServedModel:
         s["model_class"] = type(self.model).__name__
         s["batching"] = self.batcher is not None
         return s
+
+    def summary(self) -> Dict:
+        """Compact machine-readable routing summary (the ``summary``
+        block of ``GET /stats``): live occupancy, queue depth, and the
+        draining flag — everything a load balancer needs to pick a
+        replica, with no histogram parsing. ``load`` is the one-number
+        backlog score routers sort by (queued + on-device rows)."""
+        m = self.metrics
+        cap = (self.batcher.max_batch_size if self.batcher is not None
+               else self.engine.max_batch_size)
+        active = m.inflight
+        return {"mode": "predict",
+                "queue_depth": m.queue_depth,
+                "queue_max": m.queue_max,
+                "active": active,
+                "capacity": cap,
+                "occupancy": round(active / cap, 4) if cap else 0.0,
+                "draining": bool(self.batcher is not None
+                                 and self.batcher.draining),
+                "load": m.queue_depth + active}
 
 
 class ServedGenerator:
@@ -146,6 +173,23 @@ class ServedGenerator:
         s["model_class"] = type(self.model).__name__
         s["serving_mode"] = "generation"
         return s
+
+    def summary(self) -> Dict:
+        """Compact routing summary (see :meth:`ServedModel.summary`):
+        for generation the live occupancy is ACTIVE KV-CACHE SLOTS —
+        a request holds its slot for its whole decode lifetime, so
+        slots are the capacity a router must balance."""
+        m = self.metrics
+        cap = m.num_slots
+        active = m.active_slots
+        return {"mode": "generation",
+                "queue_depth": m.queue_depth,
+                "queue_max": m.queue_max,
+                "active": active,
+                "capacity": cap,
+                "occupancy": round(active / cap, 4) if cap else 0.0,
+                "draining": self.engine.draining,
+                "load": m.queue_depth + active}
 
 
 class ModelRegistry:
@@ -259,6 +303,18 @@ class ModelRegistry:
                 items.extend((f"{name}@{v}", served)
                              for v, served in vs.items() if v != latest)
         return {key: served.stats() for key, served in items}
+
+    def summary(self) -> Dict:
+        """Per-model routing summaries, keyed like :meth:`stats`
+        (latest under the bare name, older under name@v)."""
+        with self._lock:
+            items = []
+            for name, vs in self._models.items():
+                latest = max(vs)
+                items.append((name, vs[latest]))
+                items.extend((f"{name}@{v}", served)
+                             for v, served in vs.items() if v != latest)
+        return {key: served.summary() for key, served in items}
 
     def health(self) -> Dict[str, bool]:
         """Liveness per served model (``/healthz``), keyed like

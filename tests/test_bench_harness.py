@@ -90,6 +90,36 @@ def test_generation_scenario_harness_runs_on_cpu():
     assert res["chaos_recoveries"] >= 1
 
 
+def test_fleet_scenario_harness_runs_on_cpu():
+    """ISSUE 6 bench satellite at tiny scale (small MLP, 3 requests
+    per client): the fleet scenario must complete its scripted rolling
+    restart mid-traffic with ZERO client-visible failures and zero
+    router-lost requests — the fleet-wide zero-loss bar — while still
+    producing the gated requests/sec number."""
+    import bench
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable, "-c", bench.FLEET_CODE,
+                        "64", "3"],
+                       capture_output=True, text=True, timeout=600,
+                       env=env, cwd=ROOT)
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = [l for l in r.stdout.splitlines() if l.startswith("{")][-1]
+    res = json.loads(line)
+    assert res["requests_per_sec"] > 0
+    assert res["requests_total"] == 48       # 16 clients x 3
+    assert res["zero_loss"] is True
+    assert res["client_failures"] == 0 and res["requests_lost"] == 0
+    assert res["restart_clean"] is True and res["restarts"] == 3
+    # budget bound counts the WARMUP pass's completed requests too
+    # (the same router refills 0.05/request across both passes):
+    # 4 burst + 0.05 * (32 warmup + 48 measured) = 8
+    assert res["hedges"] <= 8
+    # overlap is asserted at full scale via the recorded baseline;
+    # here just require the honesty field to be present
+    assert isinstance(res["restart_within_traffic"], bool)
+
+
 def test_check_bench_regression_comparator():
     """tools/check_bench_regression.py: >20% drops fail, equal or
     missing metrics don't (missing is reported as skipped)."""

@@ -55,6 +55,9 @@ METRICS = {
     # until the next BENCH_*.json records a baseline, gated after
     ("extra", "training_chaos", "steps_per_sec"):
         "training_chaos_steps_per_sec",
+    # fleet requests/sec through the occupancy-aware router with one
+    # scripted zero-loss rolling restart mid-run (ISSUE 6)
+    ("extra", "fleet", "requests_per_sec"): "fleet_rps",
     ("extra", "word2vec", "tokens_per_sec"): "word2vec_tokens_per_sec",
     ("extra", "etl_pipeline", "rows_per_sec"): "etl_rows_per_sec",
 }
