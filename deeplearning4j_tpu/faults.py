@@ -116,12 +116,25 @@ class FaultInjector:
     from a per-seam seeded stream.
     ``plan``: ``{seam: [call indices]}`` — fire exactly on those
     1-based invocation counts of that seam (deterministic scripting
-    for tests; composes with ``rates``).
+    for tests; composes with ``rates``). For multi-worker runs a plan
+    entry may instead be ``{worker: [call indices]}`` — the indices
+    then count THAT worker's own calls of the seam (callers pass
+    ``fire(seam, worker=w)``), so "preempt exactly worker 2 at its 5th
+    step" is scriptable and the other workers' streams are untouched.
     ``corrupting``: seams whose fires raise
     :class:`CorruptedStateFault` instead of :class:`TransientFault`.
     ``slow_ms``: ``{seam: milliseconds}`` — a fire at one of these
     seams SLEEPS instead of raising (per-seam tail latency; models a
     slow disk at ``checkpoint_io``, a slow device at ``device_step``).
+
+    Worker scoping: ``fire(seam, worker=w)`` keeps a per-(seam, worker)
+    call counter and a per-(seed, seam, worker) random stream, so each
+    worker's fault pattern is independent of the others' interleaving —
+    the fleet-wide analog of the per-seam-stream rule above. A flat
+    plan list applies to EVERY worker (each at its own call counts);
+    the dict form targets workers individually. Worker-scoped calls
+    also bump the seam's aggregate counters, so ``snapshot()`` totals
+    stay meaningful either way.
     """
 
     def __init__(self, seed: int = 0,
@@ -132,11 +145,20 @@ class FaultInjector:
                  slow_ms: Optional[Dict[str, float]] = None):
         self.seed = int(seed)
         self.rates = {s: float(p) for s, p in (rates or {}).items()}
-        self.plan = {s: frozenset(int(i) for i in idx)
-                     for s, idx in (plan or {}).items()}
+        self.plan = {}
+        self.worker_plan: Dict[str, Dict[int, frozenset]] = {}
+        for s, idx in (plan or {}).items():
+            if isinstance(idx, dict):
+                self.worker_plan[s] = {
+                    int(w): frozenset(int(i) for i in ii)
+                    for w, ii in idx.items()}
+                self.plan[s] = frozenset()
+            else:
+                self.plan[s] = frozenset(int(i) for i in idx)
         self.corrupting = frozenset(corrupting)
         self.slow_ms = {s: float(ms) for s, ms in (slow_ms or {}).items()}
         unknown = [s for s in (set(self.rates) | set(self.plan)
+                               | set(self.worker_plan)
                                | self.corrupting | set(self.slow_ms))
                    if s not in SEAMS]
         if unknown:
@@ -157,21 +179,55 @@ class FaultInjector:
         self._rngs = {s: np.random.RandomState(
             (self.seed * 1_000_003 + zlib.crc32(s.encode())) & 0xFFFFFFFF)
             for s in self.rates}
+        # worker-scoped counters/streams, materialized on first use
+        self._wcalls: Dict[tuple, int] = {}
+        self._wfired: Dict[tuple, int] = {}
+        self._wrngs: Dict[tuple, np.random.RandomState] = {}
 
-    def fire(self, seam: str) -> bool:
+    def _worker_rng(self, seam: str, worker: int) -> np.random.RandomState:
+        key = (seam, worker)
+        rng = self._wrngs.get(key)
+        if rng is None:
+            rng = np.random.RandomState(
+                (self.seed * 1_000_003
+                 + zlib.crc32(f"{seam}#{worker}".encode())) & 0xFFFFFFFF)
+            self._wrngs[key] = rng
+        return rng
+
+    def fire(self, seam: str, worker: Optional[int] = None) -> bool:
         """Consult the injector at ``seam``. Returns False (no fault)
         or True (``latency``/``slow_ms`` seams slept /
         ``client_disconnect`` should be interpreted by the caller);
-        the error seams raise instead of returning True."""
+        the error seams raise instead of returning True. With
+        ``worker=``, call counts and random draws come from that
+        worker's OWN stream (see class docstring)."""
         if seam not in self._calls:
             raise ValueError(f"unknown seam {seam!r}")
         with self._lock:
             self._calls[seam] += 1
-            n = self._calls[seam]
-            hit = n in self.plan.get(seam, ())
-            if not hit and seam in self.rates:
-                hit = bool(self._rngs[seam].random_sample()
-                           < self.rates[seam])
+            if worker is None:
+                n = self._calls[seam]
+                hit = n in self.plan.get(seam, ())
+                if not hit and seam in self.rates:
+                    hit = bool(self._rngs[seam].random_sample()
+                               < self.rates[seam])
+            else:
+                worker = int(worker)
+                key = (seam, worker)
+                n = self._wcalls.get(key, 0) + 1
+                self._wcalls[key] = n
+                wplan = self.worker_plan.get(seam)
+                if wplan is not None:
+                    hit = n in wplan.get(worker, ())
+                else:
+                    # a flat plan applies to every worker, each
+                    # counting its own calls
+                    hit = n in self.plan.get(seam, ())
+                if not hit and seam in self.rates:
+                    hit = bool(self._worker_rng(seam, worker)
+                               .random_sample() < self.rates[seam])
+                if hit:
+                    self._wfired[key] = self._wfired.get(key, 0) + 1
             if not hit:
                 return False
             self._fired[seam] += 1
@@ -195,10 +251,19 @@ class FaultInjector:
 
     def snapshot(self) -> Dict:
         """Per-seam call/fire counters (for tests and the bench chaos
-        probes' reports)."""
+        probes' reports). ``by_worker`` appears once any worker-scoped
+        call happened: ``{seam: {worker: {"calls": n, "fired": m}}}``."""
         with self._lock:
-            return {"calls": dict(self._calls),
-                    "fired": dict(self._fired)}
+            out = {"calls": dict(self._calls),
+                   "fired": dict(self._fired)}
+            if self._wcalls:
+                by = {}
+                for (seam, w), n in self._wcalls.items():
+                    by.setdefault(seam, {})[w] = {
+                        "calls": n,
+                        "fired": self._wfired.get((seam, w), 0)}
+                out["by_worker"] = by
+            return out
 
 
 def poll_until_idle(is_idle: Callable[[], bool], timeout_s: float,

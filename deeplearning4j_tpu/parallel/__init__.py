@@ -72,6 +72,59 @@ def make_mesh(devices: Optional[Sequence] = None, data: Optional[int] = None,
     return Mesh(arr, ("data", "model"))
 
 
+def rebucket_worker_array(arr: np.ndarray, new_w: int) -> np.ndarray:
+    """Re-bucket a per-worker state array ``[W, ...]`` onto ``new_w``
+    workers (elastic re-meshing of gradient-sharing residuals /
+    per-worker updater moments).
+
+    The rule is MASS-PRESERVING for the quantity the training math
+    actually consumes — the per-step pmean contribution
+    ``(1/W) * sum_w state_w``:
+
+    - shrink, ``W % new_w == 0``: each new worker takes the MEAN of its
+      group of ``W/new_w`` old workers
+      (``(1/W') * sum mean-groups == (1/W) * sum``);
+    - grow, ``new_w % W == 0``: each old worker's state is REPLICATED
+      to its ``new_w/W`` children (same identity, mirrored);
+    - non-divisible shapes: global mean replicated to every new worker
+      (the coarsest mass-preserving map).
+
+    Same-shape resume never reaches this function, so the bit-exact
+    guarantee is untouched; re-meshed resume is a documented-tolerance
+    contract instead (averaging Adam moments / error-feedback residuals
+    is an approximation — see docs/distributed.md)."""
+    arr = np.asarray(arr)
+    w = arr.shape[0]
+    new_w = int(new_w)
+    if new_w < 1:
+        raise ValueError(f"new_w must be >= 1, got {new_w}")
+    if w == new_w:
+        return arr
+    if w % new_w == 0:
+        g = w // new_w
+        out = arr.reshape((new_w, g) + arr.shape[1:]).mean(axis=1)
+    elif new_w % w == 0:
+        out = np.repeat(arr, new_w // w, axis=0)
+    else:
+        out = np.broadcast_to(arr.mean(axis=0, keepdims=True),
+                              (new_w,) + arr.shape[1:])
+    return np.ascontiguousarray(out).astype(arr.dtype, copy=False)
+
+
+def _commit_model_state(model, sharding: NamedSharding):
+    """Commit params/opt/net state to the mesh BEFORE the first step
+    dispatch. Load-bearing for the zero-post-warmup-recompile contract:
+    a resume() leaves numpy-restored (uncommitted) arrays on the model,
+    and an uncommitted first call keys a second pjit dispatch entry
+    against the committed outputs of every later call. One definition
+    shared by the dense and compressed step builders."""
+    model._params = jax.device_put(model._params, sharding)
+    if model._opt_state is not None:
+        model._opt_state = jax.device_put(model._opt_state, sharding)
+    if model._net_state:
+        model._net_state = jax.device_put(model._net_state, sharding)
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
@@ -96,6 +149,7 @@ def jit_sharded_step(model, mesh: Mesh, axis: str = "data",
         model.init()
     repl = replicated(mesh)
     data = batch_sharded(mesh, axis)
+    _commit_model_state(model, repl)
     outs = (repl, repl, repl, None) + ((None,) if guard else ())
     return jax.jit(
         model._make_step_fn(guard=guard),
@@ -219,6 +273,10 @@ class ParallelWrapper:
         self.accumulator = accumulator
         self._sharded_step = None
         self._step_cache = {}   # guard flag -> compiled step
+        #: (from_workers, to_workers) of the last elastic re-mesh this
+        #: wrapper performed while consuming checkpoint state; None
+        #: when every restore so far was same-shape (bit-exact)
+        self.last_remesh = None
 
     @property
     def num_workers(self) -> int:
@@ -273,12 +331,35 @@ class ParallelWrapper:
                          for k, v in _flatten_tree(acc.opt_state).items()})
         return flat
 
+    def _rebucket_flat(self, flat):
+        """Re-bucket a flat dict of per-worker ``[W, ...]`` arrays onto
+        this wrapper's worker count when the checkpoint was written by
+        a DIFFERENT fleet shape (elastic re-meshing). Records the
+        transition in ``self.last_remesh`` so tests/telemetry can
+        assert whether a resume re-meshed or restored bitwise."""
+        if not flat:
+            return flat
+        ndev = self.num_workers
+        widths = {np.asarray(v).shape[0] for v in flat.values()}
+        if len(widths) != 1:
+            raise ValueError(
+                f"inconsistent per-worker leading axes in checkpoint "
+                f"extra state: {sorted(widths)}")
+        w = widths.pop()
+        if w == ndev:
+            return flat
+        self.last_remesh = (int(w), int(ndev))
+        return {k: rebucket_worker_array(v, ndev)
+                for k, v in flat.items()}
+
     def load_extra_checkpoint_state(self, flat):
         """Inverse of :meth:`extra_checkpoint_state`: restore the
         accumulator's device state from a checkpoint/rollback
         snapshot. Requires the carried state to exist already (the
         step builder initializes it, consuming ``model._resume_extra``
-        on first build after a resume)."""
+        on first build after a resume). Per-worker arrays written by a
+        different worker count are re-bucketed onto this wrapper's
+        mesh (:func:`rebucket_worker_array`) — elastic re-meshing."""
         acc = self.accumulator
         if acc is None or acc.residuals is None or not flat:
             return
@@ -288,19 +369,30 @@ class ParallelWrapper:
         if not gs:
             return
         data_sh = NamedSharding(self.mesh, P("data"))
-        res_flat = {k[len("residuals/"):]: v for k, v in gs.items()
-                    if k.startswith("residuals/")}
+        res_flat = self._rebucket_flat(
+            {k[len("residuals/"):]: v for k, v in gs.items()
+             if k.startswith("residuals/")})
         if res_flat:
             acc.residuals = jax.device_put(
                 _unflatten_like(acc.residuals, res_flat), data_sh)
+        # the scalar carries are COMMITTED to the mesh like
+        # _init_accumulator_state's: an uncommitted first-call
+        # threshold re-keys the pjit dispatch cache against the
+        # committed outputs of every later call — a phantom second
+        # cache entry that breaks the zero-post-warmup-recompile
+        # contract right after a resume
+        repl_sh = NamedSharding(self.mesh, P())
         if "threshold" in gs:
-            acc.threshold = jnp.asarray(np.asarray(gs["threshold"]),
-                                        jnp.float32)
+            acc.threshold = jax.device_put(
+                jnp.asarray(np.asarray(gs["threshold"]), jnp.float32),
+                repl_sh)
         if "last_sparsity" in gs:
-            acc.last_sparsity = jnp.asarray(
-                np.asarray(gs["last_sparsity"]), jnp.float32)
-        opt_flat = {k[len("opt_state/"):]: v for k, v in gs.items()
-                    if k.startswith("opt_state/")}
+            acc.last_sparsity = jax.device_put(
+                jnp.asarray(np.asarray(gs["last_sparsity"]),
+                            jnp.float32), repl_sh)
+        opt_flat = self._rebucket_flat(
+            {k[len("opt_state/"):]: v for k, v in gs.items()
+             if k.startswith("opt_state/")})
         if opt_flat and acc.opt_state is not None:
             acc.opt_state = jax.device_put(
                 _unflatten_like(acc.opt_state, opt_flat), data_sh)
@@ -314,15 +406,9 @@ class ParallelWrapper:
         m, acc, mesh, ndev = (self.model, self.accumulator, self.mesh,
                               self.num_workers)
         # commit the model state (and the scalar carries below) to the
-        # mesh NOW: otherwise the step's first dispatch sees
-        # uncommitted host arrays and every later one sees committed
-        # outputs — two pjit cache signatures for one program
+        # mesh NOW — see _commit_model_state
         repl_sh = NamedSharding(mesh, P())
-        m._params = jax.device_put(m._params, repl_sh)
-        if m._opt_state is not None:
-            m._opt_state = jax.device_put(m._opt_state, repl_sh)
-        if m._net_state:
-            m._net_state = jax.device_put(m._net_state, repl_sh)
+        _commit_model_state(m, repl_sh)
         zeros = jax.tree_util.tree_map(
             lambda p: jnp.zeros((ndev,) + p.shape, p.dtype), m._params)
         acc.residuals = jax.device_put(

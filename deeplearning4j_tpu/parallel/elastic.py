@@ -34,13 +34,48 @@ RESILIENT step mode (CheckFreq / Bamboo / Varuna shapes; see
   at the next STEP boundary (not the next epoch), via the same
   flip-a-flag-in-the-handler / do-the-work-outside treatment as the
   serving SIGTERM wiring.
+
+And on top of that, the ELASTIC multi-worker layer (ROADMAP item 3 —
+the training-side twin of the serving fleet tier; ref: the reference's
+whole Spark + Aeron distributed stack exists to train through a
+churning worker fleet, SURVEY §1 L2):
+
+- **coordinated preemption**: pass ``coordinator=``
+  (:class:`~.multihost.PreemptionCoordinator`) and ONE worker's
+  SIGTERM / injected :class:`~..faults.PreemptionFault` broadcasts a
+  fleet-wide notice; every worker's supervised loop observes it at its
+  next step boundary, flushes its own step-granular checkpoint, and
+  raises — the whole fleet drains at a consistent step instead of one
+  worker checkpointing while the others die mid-stream. The handler
+  stays flag-only; the broadcast happens on the loop thread.
+- **sharded checkpoints (format v3)**: ``sharded_checkpoints=True``
+  writes a ``checkpoint_epochE[_stepS].ckpt/`` DIRECTORY — one
+  per-worker shard zip (the gradient-sharing residuals / per-worker
+  updater moments are sliced so shard *w* holds worker *w*'s slab;
+  model-wide entries are distributed by key) plus a ``manifest.json``
+  that commits LAST. The whole write rides the pid-unique-temp +
+  fsync + atomic-rename + dir-fsync discipline, so a crash anywhere
+  mid-multi-shard-write leaves either the previous checkpoint or a
+  never-listed temp — a torn v3 checkpoint is unrepresentable to
+  ``list_checkpoints``/``resume``.
+- **elastic re-meshing on resume**: a W-worker v3 checkpoint restores
+  onto a W′-worker fleet — ``resume()`` reassembles the global state,
+  and the resuming ``ParallelWrapper`` re-buckets the per-worker
+  arrays (:func:`..parallel.rebucket_worker_array`, mass-preserving
+  group-mean on shrink / replication on growth) at step-build time.
+  Same-shape resume stays BIT-EXACT; re-meshed resume converges to the
+  fixed-shape trajectory within the documented tolerance
+  (docs/distributed.md), with zero post-warmup recompiles after the
+  re-meshed step rebuild.
 """
 from __future__ import annotations
 
 import contextlib
 import glob
+import json
 import os
 import re
+import shutil
 import signal
 import threading
 import time
@@ -51,7 +86,11 @@ import jax.numpy as jnp
 
 from ..faults import (FaultInjector, PreemptionFault,  # noqa: F401
                       TransientFault)
-from ..util.serializer import ModelSerializer, snapshot_training_state
+from ..util.serializer import (MANIFEST_NAME, CheckpointFormatError,  # noqa: F401
+                               ModelSerializer, shard_name,
+                               shard_training_snapshot,
+                               snapshot_training_state, write_shard)
+from .multihost import PreemptionCoordinator, split_data_cursor  # noqa: F401
 from .resilience import (AsyncCheckpointWriter, TrainingAnomalyError,
                          TrainingSupervisor)
 
@@ -68,13 +107,15 @@ def _pid_alive(pid: int) -> bool:
     return True
 
 
-#: completed-checkpoint filename filter AND sort key. Matches both the
-#: epoch-boundary form (`checkpoint_epoch3.zip` = 3 epochs done) and
-#: the step-granular form (`checkpoint_epoch3_step120.zip` = mid
-#: epoch-index 3, 120 optimizer steps done). Sorting by (epoch, step)
-#: is chronological: a mid-epoch-3 checkpoint (3, S) sits after the
-#: epoch-3 boundary (3, 0) and before the epoch-4 boundary (4, 0).
-_CKPT_RE = re.compile(r"checkpoint_epoch(\d+)(?:_step(\d+))?\.zip$")
+#: completed-checkpoint filename filter AND sort key. Matches the
+#: epoch-boundary form (`checkpoint_epoch3.zip` = 3 epochs done), the
+#: step-granular form (`checkpoint_epoch3_step120.zip` = mid
+#: epoch-index 3, 120 optimizer steps done), and the format-v3 SHARD
+#: DIRECTORY forms of both (`checkpoint_epoch3_step120.ckpt/`).
+#: Sorting by (epoch, step) is chronological: a mid-epoch-3 checkpoint
+#: (3, S) sits after the epoch-3 boundary (3, 0) and before the
+#: epoch-4 boundary (4, 0) — regardless of format.
+_CKPT_RE = re.compile(r"checkpoint_epoch(\d+)(?:_step(\d+))?\.(?:zip|ckpt)$")
 
 
 class FaultTolerantTrainer:
@@ -116,7 +157,10 @@ class FaultTolerantTrainer:
                  anomaly_guard: bool = False,
                  rollback_after: int = 3,
                  snapshot_every_n_steps: Optional[int] = None,
-                 wrapper=None):
+                 wrapper=None,
+                 sharded_checkpoints: bool = False,
+                 coordinator: Optional[PreemptionCoordinator] = None,
+                 worker_id: Optional[int] = None):
         self.model = model
         self.dir = checkpoint_dir
         self.save_every = max(1, save_every_n_epochs)
@@ -126,6 +170,9 @@ class FaultTolerantTrainer:
         self.async_write = bool(async_write)
         self.injector = fault_injector
         self.wrapper = wrapper
+        self.sharded_checkpoints = bool(sharded_checkpoints)
+        self.coordinator = coordinator
+        self.worker_id = (None if worker_id is None else int(worker_id))
         if wrapper is not None and wrapper.model is not model:
             raise ValueError("wrapper.model must be the trainer's model")
         self._step_mode = bool(self.save_every_n_steps
@@ -152,6 +199,7 @@ class FaultTolerantTrainer:
         self._writer: Optional[AsyncCheckpointWriter] = None
         self._step_fns = {}
         # preemption coordination (PreemptionHandler + preempt seam)
+        self._coord_gen0: Optional[float] = None
         self._loop_active = False
         self._preempt_requested = threading.Event()
         self._preempt_handler = None
@@ -161,27 +209,56 @@ class FaultTolerantTrainer:
         os.makedirs(checkpoint_dir, exist_ok=True)
 
     # -- checkpoint management -----------------------------------------
+    @property
+    def _ext(self) -> str:
+        return "ckpt" if self.sharded_checkpoints else "zip"
+
     def _ckpt_path(self, epoch: int) -> str:
-        return os.path.join(self.dir, f"checkpoint_epoch{epoch}.zip")
+        return os.path.join(self.dir,
+                            f"checkpoint_epoch{epoch}.{self._ext}")
 
     def _step_ckpt_path(self, epoch: int, step: int) -> str:
         return os.path.join(
-            self.dir, f"checkpoint_epoch{epoch}_step{step}.zip")
+            self.dir, f"checkpoint_epoch{epoch}_step{step}.{self._ext}")
+
+    def _num_shards(self) -> int:
+        """v3 shard count: the wrapper's worker count (the per-worker
+        state's leading axis), 1 for a plain single-worker trainer."""
+        return (self.wrapper.num_workers if self.wrapper is not None
+                else 1)
 
     @staticmethod
     def list_checkpoints(directory: str) -> List[str]:
-        """Completed checkpoints only, oldest -> newest. The regex is a
-        FULL filename filter, not just a sort key: temp files from an
-        interrupted write (``*.zip.tmp.*``) and any stray file must
-        never be listed — resume() loads the last entry, and keep-last
-        pruning deletes the first ones."""
-        paths = [p for p in
-                 glob.glob(os.path.join(directory, "checkpoint_epoch*.zip"))
-                 if _CKPT_RE.search(p)]
+        """Completed checkpoints only, oldest -> newest — v2 zips and
+        v3 shard directories interleaved chronologically. The regex is
+        a FULL filename filter, not just a sort key: temp files/dirs
+        from an interrupted write (``*.tmp.<pid>``) and any stray file
+        must never be listed — resume() loads the last entry, and
+        keep-last pruning deletes the first ones. A ``.ckpt`` directory
+        additionally needs its ``manifest.json`` — the writer commits
+        the manifest last, so its absence means a torn multi-shard
+        write that must never be surfaced as resumable."""
+        paths = []
+        for p in glob.glob(os.path.join(directory, "checkpoint_epoch*")):
+            if not _CKPT_RE.search(p):
+                continue
+            if p.endswith(".ckpt") and not (
+                    os.path.isdir(p)
+                    and os.path.isfile(os.path.join(p, MANIFEST_NAME))):
+                continue
+            paths.append(p)
 
         def key(p):
             m = _CKPT_RE.search(p)
-            return (int(m.group(1)), int(m.group(2) or 0))
+            try:
+                mtime = os.path.getmtime(p)
+            except OSError:
+                mtime = 0.0
+            # mtime tiebreaks a same-(epoch, step) opposite-format twin
+            # pair: the writer removes its stale twin, but a crash in
+            # that window (or an EPERM on the remove) can leave both —
+            # the NEWER write must win deterministically, not glob order
+            return (int(m.group(1)), int(m.group(2) or 0), mtime)
         return sorted(paths, key=key)
 
     def _write_atomic(self, snap: dict, path: str):
@@ -190,16 +267,23 @@ class FaultTolerantTrainer:
         fsync, then rotation + stale-temp sweep. Fires the
         ``checkpoint_io`` seam (bounded retry on transient fires — a
         failed write attempt never touches the live checkpoint, the
-        temp machinery guarantees that). Runs on the async writer
-        thread in step mode, inline otherwise."""
+        temp machinery guarantees that; a failed SHARDED attempt is
+        restarted whole, its temp dir discarded). Runs on the async
+        writer thread in step mode, inline otherwise."""
         t0 = time.perf_counter()
         sup = self.supervisor
         attempt = 0
         while True:
             try:
-                if self.injector is not None:
-                    self.injector.fire("checkpoint_io")
-                self._write_once(snap, path)
+                if self.sharded_checkpoints:
+                    # the seam fires INSIDE, once per shard (worker-
+                    # scoped) + once before the manifest commit — the
+                    # torn-write crash windows tests script against
+                    self._write_sharded_once(snap, path)
+                else:
+                    if self.injector is not None:
+                        self.injector.fire("checkpoint_io")
+                    self._write_once(snap, path)
                 break
             except TransientFault:
                 sup.retries.inc()
@@ -209,6 +293,8 @@ class FaultTolerantTrainer:
                 # (max_step_retries / retry_backoff_ms)
                 time.sleep(sup.retry_backoff_ms * (2 ** attempt) / 1e3)
                 attempt += 1
+        if self.sharded_checkpoints:
+            sup.sharded_checkpoints.inc()
         self._prune_and_sweep()
         # single-writer by construction (the async worker, or the loop
         # thread after _writer.wait()), so += cannot lose increments
@@ -234,11 +320,12 @@ class FaultTolerantTrainer:
             # entry is still only in the page cache, and for a NEW
             # checkpoint name a power loss could lose the file
             # entirely despite the write having returned success
-            dfd = os.open(self.dir, os.O_RDONLY)
-            try:
-                os.fsync(dfd)
-            finally:
-                os.close(dfd)
+            self._fsync_dir(self.dir)
+            # remove a stale opposite-format twin (same (epoch, step)
+            # sort key — it could shadow this write at resume)
+            twin = path[:-len(".zip")] + ".ckpt"
+            if os.path.isdir(twin):
+                shutil.rmtree(twin, ignore_errors=True)
         except BaseException:
             # never leave a half-written temp behind on failure
             try:
@@ -247,11 +334,105 @@ class FaultTolerantTrainer:
                 pass
             raise
 
+    def _fsync_dir(self, d: str):
+        dfd = os.open(d, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+
+    def _write_sharded_once(self, snap: dict, path: str):
+        """One format-v3 write attempt: a pid-unique TEMP DIRECTORY in
+        the checkpoint dir, each shard written via its own inner temp +
+        fsync + rename, the manifest committed LAST (also temp+rename),
+        the temp dir fsynced, and finally the whole directory renamed
+        to the live name + parent fsync. Kill the process between ANY
+        two of those operations and ``list_checkpoints`` sees either
+        the previous checkpoint or nothing: a ``*.tmp.<pid>`` dir is
+        never listed, and a directory without a manifest is rejected
+        even if it somehow lands at the live name."""
+        w = self._num_shards()
+        shards, manifest = shard_training_snapshot(snap, w)
+        pid = os.getpid()
+        tmp_dir = f"{path}.tmp.{pid}"
+        try:
+            os.makedirs(tmp_dir, exist_ok=True)
+            for i, shard in enumerate(shards):
+                if self.injector is not None:
+                    # worker-scoped: "crash between shard i-1 and i"
+                    # is scriptable per worker
+                    self.injector.fire("checkpoint_io", worker=i)
+                fname = shard_name(i)
+                tmp = os.path.join(tmp_dir, f"{fname}.tmp.{pid}")
+                write_shard(shard, tmp)
+                with open(tmp, "rb+") as f:
+                    os.fsync(f.fileno())
+                final = os.path.join(tmp_dir, fname)
+                os.replace(tmp, final)
+                manifest["shards"][i]["bytes"] = os.path.getsize(final)
+                manifest["shards"][i]["entries"] = {
+                    s: len(shard[s]) for s in
+                    ("params", "net_state", "opt_state", "extra")}
+            if self.injector is not None:
+                # the last-shard -> manifest-commit window
+                self.injector.fire("checkpoint_io")
+            mtmp = os.path.join(tmp_dir, f"{MANIFEST_NAME}.tmp.{pid}")
+            with open(mtmp, "w") as f:
+                json.dump(manifest, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(mtmp, os.path.join(tmp_dir, MANIFEST_NAME))
+            self._fsync_dir(tmp_dir)
+            doomed = None
+            if os.path.exists(path):
+                # re-writing an existing checkpoint name (re-run of a
+                # completed schedule): dirs cannot be replaced
+                # atomically, so step the old one ASIDE (one cheap
+                # rename) rather than rmtree-ing it first — a kill in
+                # the rename/rename window leaves a complete committed
+                # checkpoint at the .old name, and the sweep renames it
+                # BACK if the live name never landed (a keep_last=1 run
+                # must never lose its only checkpoint to this window)
+                doomed = f"{path}.old.{os.getpid()}"
+                os.rename(path, doomed)
+            try:
+                os.rename(tmp_dir, path)
+            except BaseException:
+                if doomed is not None:
+                    os.rename(doomed, path)   # un-step the old one
+                raise
+            self._fsync_dir(self.dir)
+            if doomed is not None:
+                shutil.rmtree(doomed, ignore_errors=True)
+            # a now-stale opposite-FORMAT twin (checkpoint_epochE.zip
+            # next to this .ckpt) would sort as the same (epoch, step)
+            # key and could shadow this write at resume — remove it
+            twin = path[:-len(".ckpt")] + ".zip"
+            if os.path.exists(twin):
+                try:
+                    os.remove(twin)
+                except OSError:
+                    pass
+        except BaseException:
+            # never leave this attempt's partial shard dir behind on an
+            # in-process failure; a process CRASH leaves it for the
+            # stale-temp sweep (dead-pid rule)
+            shutil.rmtree(tmp_dir, ignore_errors=True)
+            raise
+
+    @staticmethod
+    def _temp_pid_alive(path: str) -> bool:
+        pid_s = path.rsplit(".", 1)[-1]
+        return pid_s.isdigit() and _pid_alive(int(pid_s))
+
     def _prune_and_sweep(self):
         ckpts = self.list_checkpoints(self.dir)
         for old in ckpts[:-self.keep_last] if self.keep_last else []:
             try:
-                os.remove(old)
+                if os.path.isdir(old):
+                    shutil.rmtree(old)
+                else:
+                    os.remove(old)
             except OSError:
                 pass  # a concurrent writer's rotation got there first
         # sweep temp corpses from CRASHED earlier runs (ours was
@@ -263,8 +444,45 @@ class FaultTolerantTrainer:
         # and deleting it would destroy that checkpoint
         for stale in glob.glob(os.path.join(
                 self.dir, "checkpoint_epoch*.zip.tmp.*")):
-            pid_s = stale.rsplit(".", 1)[-1]
-            if pid_s.isdigit() and _pid_alive(int(pid_s)):
+            if self._temp_pid_alive(stale):
+                continue
+            try:
+                os.remove(stale)
+            except OSError:
+                pass
+        # same rule for SHARDED temps: a dead writer's partial shard
+        # DIRECTORY (and everything in it — completed shards, inner
+        # shard temps, an uncommitted manifest) goes; a live concurrent
+        # writer's is spared wholesale — its inner temps belong to that
+        # live pid by construction (the dir name and the inner temp
+        # names embed the same writer pid)
+        for stale in glob.glob(os.path.join(
+                self.dir, "checkpoint_epoch*.ckpt.tmp.*")):
+            if self._temp_pid_alive(stale):
+                continue
+            shutil.rmtree(stale, ignore_errors=True)
+        # a dead writer's stepped-aside old checkpoint (`*.ckpt.old.
+        # <pid>` — see _write_sharded_once's rewrite path): if the live
+        # name never landed, the .old dir is the ONLY copy of that
+        # checkpoint — rename it back instead of sweeping it
+        for stale in glob.glob(os.path.join(
+                self.dir, "checkpoint_epoch*.ckpt.old.*")):
+            if self._temp_pid_alive(stale):
+                continue
+            base = stale.rsplit(".old.", 1)[0]
+            try:
+                if os.path.exists(base):
+                    shutil.rmtree(stale, ignore_errors=True)
+                else:
+                    os.rename(stale, base)
+            except OSError:
+                pass
+        # and orphaned per-shard temps inside COMMITTED directories
+        # (manually repaired / rsynced layouts; a normal commit renames
+        # every inner temp before the manifest lands)
+        for stale in glob.glob(os.path.join(
+                self.dir, "checkpoint_epoch*.ckpt", "*.tmp.*")):
+            if self._temp_pid_alive(stale):
                 continue
             try:
                 os.remove(stale)
@@ -352,6 +570,11 @@ class FaultTolerantTrainer:
         m._resume_cursor = None
         mesh_ctx = (self.wrapper.mesh if self.wrapper is not None
                     else contextlib.nullcontext())
+        # coordinated preemption: notices are generation-based — only
+        # a token newer than THIS fit's start counts, so a restarted
+        # fleet does not re-preempt itself off last run's sentinel
+        if self.coordinator is not None:
+            self._coord_gen0 = self.coordinator.generation()
         self._loop_active = True
         try:
             with mesh_ctx:
@@ -395,6 +618,7 @@ class FaultTolerantTrainer:
                 if self._preempt_requested.is_set():
                     self._preempt_requested.clear()
                     sup.preemptions.inc()
+                    self._signal_fleet()
                     self._flush_step_checkpoint()
                     handler, self._preempt_handler = \
                         self._preempt_handler, None
@@ -508,17 +732,25 @@ class FaultTolerantTrainer:
                     snap=sup.last_good)
             sup.checkpoint_stall_s += time.perf_counter() - t0
         # preemption checks ride the step boundary: the injected seam
-        # (scripted chaos) and the SIGTERM flag (real platform notice)
+        # (scripted chaos), the SIGTERM flag (real platform notice),
+        # and the fleet coordination channel (ANOTHER worker's notice).
+        # A locally-originated preemption broadcasts BEFORE flushing,
+        # so the rest of the fleet overlaps its flushes with ours
         if self.injector is not None:
             try:
-                self.injector.fire("preempt")
+                if self.worker_id is not None:
+                    self.injector.fire("preempt", worker=self.worker_id)
+                else:
+                    self.injector.fire("preempt")
             except PreemptionFault:
                 sup.preemptions.inc()
+                self._signal_fleet()
                 self._flush_step_checkpoint()
                 raise
         if self._preempt_requested.is_set():
             self._preempt_requested.clear()
             sup.preemptions.inc()
+            self._signal_fleet()
             self._flush_step_checkpoint()
             handler, self._preempt_handler = self._preempt_handler, None
             if handler is not None:
@@ -529,6 +761,21 @@ class FaultTolerantTrainer:
                 handler.finish_preemption(self._preempt_signum)
             raise PreemptionFault(
                 f"preempted at step {m._step}; step-granular "
+                "checkpoint flushed")
+        if (self.coordinator is not None
+                and self._coord_gen0 is not None
+                and self.coordinator.generation() > self._coord_gen0):
+            # fleet-wide drain: some OTHER worker is being preempted —
+            # flush our own step-granular checkpoint at this boundary
+            # and exit the same way, so the whole fleet stops at a
+            # consistent, resumable step
+            sup.preemptions.inc()
+            sup.preempts_received.inc()
+            self._flush_step_checkpoint()
+            raise PreemptionFault(
+                f"coordinated preemption at step {m._step} (fleet "
+                f"notice from worker "
+                f"{self.coordinator.last_source!r}); step-granular "
                 "checkpoint flushed")
 
     def _checkpoint(self, path: str, snap: Optional[dict] = None):
@@ -546,6 +793,20 @@ class FaultTolerantTrainer:
         else:
             self._write_atomic(snap, path)
             sup.sync_checkpoints.inc()
+
+    def _signal_fleet(self):
+        """Broadcast a locally-originated preemption over the
+        coordination channel (no-op without one). Runs on the LOOP
+        thread — the signal handler itself stays flag-only. The token
+        bump also marks our own gen0 as stale, but every locally-
+        originated path raises before re-checking the channel, so we
+        never double-count our own notice."""
+        if self.coordinator is None:
+            return
+        self.supervisor.preempts_broadcast.inc()
+        source = (self.worker_id if self.worker_id is not None
+                  else os.getpid())
+        self.coordinator.signal(source=source)
 
     def _flush_step_checkpoint(self):
         """Synchronous, durable, step-granular flush — the preemption
@@ -585,15 +846,23 @@ class FaultTolerantTrainer:
     def resume(checkpoint_dir: str):
         """Restore the latest completed checkpoint (ref: the restarted
         worker's params+updater refetch, technicalref.md:115-135).
-        Format-v2 checkpoints restore the PRNG key and leave the loop
+        Handles v1/v2 zip files AND v3 shard directories; the recorded
+        format version is validated up front, so an unknown/future
+        checkpoint fails with an actionable
+        :class:`~...util.serializer.CheckpointFormatError` (path +
+        found/expected versions) instead of a KeyError mid-parse.
+        Format-v2+ checkpoints restore the PRNG key and leave the loop
         cursor + extra runtime state on the model for the next
         ``fit()`` / ``ParallelWrapper`` to consume — resume is then
-        bit-exact, mid-epoch included."""
+        bit-exact, mid-epoch included; a v3 checkpoint restored by a
+        DIFFERENT worker count is re-bucketed by the resuming wrapper
+        (elastic re-meshing, documented-tolerance contract)."""
         ckpts = FaultTolerantTrainer.list_checkpoints(checkpoint_dir)
         if not ckpts:
             raise FileNotFoundError(
                 f"no checkpoints in {checkpoint_dir}")
-        # dispatches on the saved model_type (MLN vs ComputationGraph)
+        # restore() validates the format first thing and dispatches on
+        # the saved model_type (MLN vs ComputationGraph)
         return ModelSerializer.restore(ckpts[-1])
 
 
@@ -628,13 +897,28 @@ class PreemptionHandler:
     runners / frameworks keep their own cleanup), marks
     ``preempted`` for the training loop to observe, and is
     installable only from the main thread (signal module rule) —
-    elsewhere it degrades to a no-op with ``installed=False``."""
+    elsewhere it degrades to a no-op with ``installed=False``.
+
+    Pass ``coordinator=`` (a
+    :class:`~.multihost.PreemptionCoordinator`, installed onto the
+    trainer if it has none) and this worker's SIGTERM becomes a
+    FLEET-WIDE drain: the handler contract stays flag-only — the
+    supervised loop broadcasts over the channel on its own thread at
+    the next step boundary, every other worker's loop observes the
+    notice at ITS next boundary, and each flushes its own
+    step-granular checkpoint before exiting. Outside the supervised
+    loop (epoch path) the broadcast happens right after the inline
+    save."""
 
     def __init__(self, trainer: FaultTolerantTrainer,
                  signals=(signal.SIGTERM, signal.SIGINT),
                  on_preempt: Optional[Callable] = None,
-                 reraise: bool = True):
+                 reraise: bool = True,
+                 coordinator: Optional[PreemptionCoordinator] = None):
         self.trainer = trainer
+        if coordinator is not None and trainer.coordinator is None:
+            trainer.coordinator = coordinator
+        self.coordinator = coordinator or trainer.coordinator
         self.signals = tuple(signals)
         self.on_preempt = on_preempt
         self.reraise = reraise
@@ -662,6 +946,10 @@ class PreemptionHandler:
         if not getattr(tr, "_saving", False) and \
                 not os.path.exists(tr._ckpt_path(epoch)):
             tr._save(epoch)
+        # epoch path: broadcast AFTER the inline save (the main thread
+        # is blocked in this handler anyway; the supervised loop's
+        # flag path broadcasts from the loop thread instead)
+        tr._signal_fleet()
         self.finish_preemption(signum, frame)
 
     def finish_preemption(self, signum, frame=None):

@@ -88,7 +88,13 @@ class TrainingSupervisor:
         self.rollbacks = Counter()
         self.async_checkpoints = Counter()
         self.sync_checkpoints = Counter()
+        self.sharded_checkpoints = Counter()   # format-v3 directory writes
         self.preemptions = Counter()
+        # coordinated-preemption accounting: broadcasts this worker
+        # ORIGINATED (its own SIGTERM / injected preempt) vs notices it
+        # RECEIVED over the coordination channel (another worker's)
+        self.preempts_broadcast = Counter()
+        self.preempts_received = Counter()
         self.checkpoint_stall_s = 0.0   # step-loop time spent in
         self.checkpoint_write_s = 0.0   # snapshot+submit vs background
         self._consecutive = 0
@@ -225,7 +231,10 @@ class TrainingSupervisor:
             "rollbacks": self.rollbacks.value(),
             "async_checkpoints": self.async_checkpoints.value(),
             "sync_checkpoints": self.sync_checkpoints.value(),
+            "sharded_checkpoints": self.sharded_checkpoints.value(),
             "preemptions": self.preemptions.value(),
+            "preempts_broadcast": self.preempts_broadcast.value(),
+            "preempts_received": self.preempts_received.value(),
             "checkpoint_stall_s": round(self.checkpoint_stall_s, 6),
             "checkpoint_write_s": round(self.checkpoint_write_s, 6),
         }

@@ -19,9 +19,25 @@ that must pause training) and :meth:`ModelSerializer.write_snapshot`
 `parallel.elastic.FaultTolerantTrainer` can checkpoint asynchronously
 at step cadence (CheckFreq-style).
 
-Orbax-style sharded async checkpointing for the distributed path lives
-in `deeplearning4j_tpu.parallel.checkpoint`; this is the single-host
-format.
+Format version 3 (elastic multi-worker training) is a **shard
+directory** instead of a single zip: `checkpoint_epochE[_stepS].ckpt/`
+holding one `shard_NNNNN.zip` per worker plus a `manifest.json` that
+commits LAST. Model-wide flat entries (params / updater / net state)
+are distributed across the shards by key; per-worker arrays — anything
+in `extra` whose leading axis equals the worker count, i.e. the
+gradient-sharing residuals and per-worker updater moments — are sliced
+so shard *w* holds exactly worker *w*'s slab (Orbax-style: each host
+writes only its own state, nothing gathers to one process). The
+manifest records the format version, worker count, full meta
+(step/epoch/PRNG/cursor), config JSON, the worker-sliced key list, and
+the shard file table — `merge_shard_snapshots` reassembles a bitwise-
+identical v2-shaped snapshot from it, and
+`parallel.ParallelWrapper` re-buckets the per-worker arrays when the
+resuming fleet has a different worker count (elastic re-meshing).
+The crash-safe write discipline (pid-unique temp dir, per-shard fsync
++ rename, manifest last, directory rename) lives in
+`parallel.elastic.FaultTolerantTrainer`; this module owns the pure
+content functions.
 """
 from __future__ import annotations
 
@@ -29,10 +45,34 @@ import io
 import json
 import os
 import zipfile
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
+
+#: zip (single-file) checkpoint versions this build reads; shard
+#: directories are exactly :data:`SHARDED_FORMAT_VERSION`.
+SUPPORTED_FILE_FORMATS = (1, 2)
+SHARDED_FORMAT_VERSION = 3
+MANIFEST_NAME = "manifest.json"
+
+
+class CheckpointFormatError(RuntimeError):
+    """A checkpoint's recorded format version (or structure) is not one
+    this build understands — raised with the path and the
+    expected/found versions so the on-call runbook has something to act
+    on, instead of a KeyError deep inside npz parsing."""
+
+    def __init__(self, path: str, found, expected):
+        self.path = path
+        self.found = found
+        self.expected = expected
+        super().__init__(
+            f"unsupported checkpoint format at {path}: found "
+            f"format_version={found!r}, this build supports {expected} "
+            "(v1/v2 single-file zips, v3 shard directories). Inspect it "
+            "with tools/inspect_checkpoint.py; a newer-format checkpoint "
+            "needs a newer build to resume.")
 
 
 def _flatten_tree(tree) -> Dict[str, np.ndarray]:
@@ -103,6 +143,155 @@ def snapshot_training_state(model, cursor: Optional[dict] = None,
     return snap
 
 
+def shard_name(i: int) -> str:
+    return f"shard_{i:05d}.zip"
+
+
+def shard_training_snapshot(snap: dict, num_workers: int
+                            ) -> Tuple[List[dict], dict]:
+    """Split a :func:`snapshot_training_state` dict into ``num_workers``
+    per-worker shard dicts plus the manifest skeleton (format v3).
+
+    - **Per-worker arrays** (``extra`` entries whose leading axis equals
+      the worker count — gradient-sharing residuals, per-worker updater
+      moments) are SLICED: shard *w* gets worker *w*'s slab with the
+      leading axis dropped. This is the load-bearing part: each worker
+      writes only its own state, and re-meshing re-buckets exactly
+      these keys.
+    - **Model-wide flat entries** (params / updater / net state, plus
+      any non-sliced extra) are distributed across shards by sorted key
+      round-robin — deterministic, and no shard must hold the whole
+      model (the once-models-outgrow-host-RAM requirement).
+
+    ``merge_shard_snapshots`` is the exact inverse; slicing + stacking
+    round-trips bitwise, so a same-shape resume stays bit-exact."""
+    w = int(num_workers)
+    if w < 1:
+        raise ValueError(f"num_workers must be >= 1, got {num_workers}")
+    shards = [{"params": {}, "net_state": {}, "opt_state": {},
+               "extra": {}, "meta": {"shard": i, "num_workers": w,
+                                     "format_version":
+                                         SHARDED_FORMAT_VERSION}}
+              for i in range(w)]
+    worker_sliced = []
+    extra = snap.get("extra") or {}
+    for k in sorted(extra):
+        arr = np.asarray(extra[k])
+        if arr.ndim >= 1 and arr.shape[0] == w:
+            worker_sliced.append(k)
+            for i in range(w):
+                shards[i]["extra"][k] = np.array(arr[i], copy=True)
+    spill = [k for k in sorted(extra) if k not in worker_sliced]
+    for section in ("params", "net_state", "opt_state"):
+        flat = snap.get(section)
+        for j, k in enumerate(sorted(flat or {})):
+            shards[j % w][section][k] = flat[k]
+    for j, k in enumerate(spill):
+        # worker-count-independent extras (adaptive threshold, last
+        # sparsity) round-robin like the model-wide sections
+        shards[j % w]["extra"][k] = extra[k]
+    meta = dict(snap["meta"])
+    meta["format_version"] = SHARDED_FORMAT_VERSION
+    manifest = {
+        "format_version": SHARDED_FORMAT_VERSION,
+        "num_workers": w,
+        "meta": meta,
+        "conf_json": snap["conf_json"],
+        "sections_present": {
+            "net_state": bool(snap.get("net_state")),
+            "opt_state": snap.get("opt_state") is not None,
+            "extra": bool(extra),
+        },
+        "worker_sliced": worker_sliced,
+        # file/bytes columns are filled in by the writer as each shard
+        # lands — the manifest commits last, after every shard is
+        # durable, so its presence IS the not-torn marker
+        "shards": [{"file": shard_name(i)} for i in range(w)],
+    }
+    return shards, manifest
+
+
+def write_shard(shard: dict, path: str):
+    """One shard zip: npz members for each non-empty section + a tiny
+    meta.json. Pure host I/O (background-writer safe)."""
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        for section, member in (("params", "params.npz"),
+                                ("net_state", "state.npz"),
+                                ("opt_state", "updater.npz"),
+                                ("extra", "extra.npz")):
+            if shard.get(section):
+                z.writestr(member, _npz_bytes(shard[section]))
+        z.writestr("meta.json", json.dumps(shard["meta"]))
+
+
+def read_shard(path: str) -> dict:
+    with zipfile.ZipFile(path) as z:
+        names = z.namelist()
+        out = {"meta": json.loads(z.read("meta.json").decode())}
+        for section, member in (("params", "params.npz"),
+                                ("net_state", "state.npz"),
+                                ("opt_state", "updater.npz"),
+                                ("extra", "extra.npz")):
+            out[section] = (dict(np.load(io.BytesIO(z.read(member))))
+                            if member in names else {})
+    return out
+
+
+def read_manifest(directory: str) -> dict:
+    """Load + validate a v3 shard directory's manifest. A directory
+    without a (complete) manifest is a torn write — the writer commits
+    the manifest last — and must never be resumed."""
+    mpath = os.path.join(directory, MANIFEST_NAME)
+    if not os.path.isfile(mpath):
+        raise CheckpointFormatError(
+            directory, "<no manifest.json — torn or foreign directory>",
+            SUPPORTED_FILE_FORMATS + (SHARDED_FORMAT_VERSION,))
+    with open(mpath) as f:
+        manifest = json.load(f)
+    fv = manifest.get("format_version")
+    if fv != SHARDED_FORMAT_VERSION:
+        raise CheckpointFormatError(directory, fv,
+                                    (SHARDED_FORMAT_VERSION,))
+    # stamp the origin so downstream errors (shard-count mismatch in
+    # merge_shard_snapshots) can name the offending checkpoint
+    manifest["_path"] = directory
+    return manifest
+
+
+def merge_shard_snapshots(manifest: dict, shards: List[dict]) -> dict:
+    """Inverse of :func:`shard_training_snapshot`: reassemble the
+    v2-shaped snapshot dict. Worker-sliced extras are re-stacked in
+    shard order (bitwise identical to what was sliced); everything else
+    is a dict union."""
+    w = int(manifest["num_workers"])
+    if len(shards) != w:
+        raise CheckpointFormatError(
+            manifest.get("_path", "<sharded checkpoint>"),
+            f"{len(shards)} shards for num_workers={w}",
+            (SHARDED_FORMAT_VERSION,))
+    present = manifest.get("sections_present", {})
+    snap = {"conf_json": manifest["conf_json"],
+            "meta": dict(manifest["meta"]),
+            "params": {}, "net_state": {}, "opt_state": {}, "extra": {}}
+    sliced = set(manifest.get("worker_sliced", ()))
+    for sh in shards:
+        for section in ("params", "net_state", "opt_state"):
+            snap[section].update(sh.get(section) or {})
+        for k, v in (sh.get("extra") or {}).items():
+            if k not in sliced:
+                snap["extra"][k] = v
+    for k in sliced:
+        snap["extra"][k] = np.stack(
+            [np.asarray(sh["extra"][k]) for sh in shards])
+    if not present.get("net_state", bool(snap["net_state"])):
+        snap["net_state"] = None
+    if not present.get("opt_state", bool(snap["opt_state"])):
+        snap["opt_state"] = None
+    if not present.get("extra", bool(snap["extra"])):
+        snap["extra"] = None
+    return snap
+
+
 class ModelSerializer:
     """Ref: ModelSerializer.writeModel / restoreMultiLayerNetwork."""
 
@@ -132,10 +321,32 @@ class ModelSerializer:
             z.writestr("meta.json", json.dumps(snap["meta"]))
 
     @staticmethod
+    def validate_format(path: str) -> int:
+        """Check the recorded format version BEFORE touching payloads,
+        so an unknown/future checkpoint fails with an actionable
+        :class:`CheckpointFormatError` (path + found + expected)
+        instead of a KeyError mid-parse. Returns the version."""
+        if os.path.isdir(path):
+            return int(read_manifest(path)["format_version"])
+        with zipfile.ZipFile(path) as z:
+            meta = json.loads(z.read("meta.json").decode())
+        fv = meta.get("format_version", 1)   # pre-v2 files carried none
+        if fv not in SUPPORTED_FILE_FORMATS:
+            raise CheckpointFormatError(
+                path, fv,
+                SUPPORTED_FILE_FORMATS + (SHARDED_FORMAT_VERSION,))
+        return int(fv)
+
+    @staticmethod
     def restore(path: str, load_updater: bool = True):
         """Dispatch on the model_type recorded at save time (ref:
         ModelSerializer.restoreMultiLayerNetwork vs
-        restoreComputationGraph overloads)."""
+        restoreComputationGraph overloads). ``path`` may be a v1/v2
+        zip or a v3 shard directory; the format version is validated
+        up front either way."""
+        ModelSerializer.validate_format(path)
+        if os.path.isdir(path):
+            return ModelSerializer.restore_sharded(path, load_updater)
         with zipfile.ZipFile(path) as z:
             meta = json.loads(z.read("meta.json").decode())
         if meta.get("model_type") == "ComputationGraph":
@@ -145,22 +356,46 @@ class ModelSerializer:
             path, load_updater)
 
     @staticmethod
-    def _restore_common(model, z: zipfile.ZipFile, load_updater: bool):
-        """Shared tail of both restore paths: params/state/updater
-        trees, counters, and the format-v2 resume state (PRNG key,
-        loop cursor, extra runtime arrays)."""
-        params_flat = dict(np.load(io.BytesIO(z.read("params.npz"))))
-        model._params = _unflatten_like(model._params, params_flat)
-        names = z.namelist()
-        if "state.npz" in names and model._net_state:
-            model._net_state = _unflatten_like(
-                model._net_state,
-                dict(np.load(io.BytesIO(z.read("state.npz")))))
-        if load_updater and "updater.npz" in names:
-            model._opt_state = _unflatten_like(
-                model._opt_state,
-                dict(np.load(io.BytesIO(z.read("updater.npz")))))
-        meta = json.loads(z.read("meta.json").decode())
+    def restore_sharded(directory: str, load_updater: bool = True):
+        """Restore a v3 shard directory: read every shard, reassemble
+        the v2-shaped snapshot, rebuild the model from the manifest's
+        config. Per-worker arrays come back stacked ``[W, ...]`` in
+        ``model._resume_extra``; a resuming fleet of a DIFFERENT size
+        re-buckets them at step-build time (ParallelWrapper)."""
+        manifest = read_manifest(directory)
+        shards = [read_shard(os.path.join(directory, s["file"]))
+                  for s in manifest["shards"]]
+        snap = merge_shard_snapshots(manifest, shards)
+        model = ModelSerializer._model_from_conf(
+            snap["conf_json"], snap["meta"].get("model_type"))
+        return ModelSerializer._restore_from_snapshot(model, snap,
+                                                      load_updater)
+
+    @staticmethod
+    def _model_from_conf(conf_json: str, model_type: Optional[str]):
+        if model_type == "ComputationGraph":
+            from ..nn.graph import (ComputationGraph,
+                                    ComputationGraphConfiguration)
+            return ComputationGraph(
+                ComputationGraphConfiguration.from_json(conf_json)).init()
+        from ..nn.conf import MultiLayerConfiguration
+        from ..nn.multilayer import MultiLayerNetwork
+        return MultiLayerNetwork(
+            MultiLayerConfiguration.from_json(conf_json)).init()
+
+    @staticmethod
+    def _restore_from_snapshot(model, snap: dict, load_updater: bool):
+        """Shared tail of every restore path (zip or shard directory):
+        params/state/updater trees, counters, and the format-v2+ resume
+        state (PRNG key, loop cursor, extra runtime arrays)."""
+        model._params = _unflatten_like(model._params, snap["params"])
+        if snap.get("net_state") and model._net_state:
+            model._net_state = _unflatten_like(model._net_state,
+                                               snap["net_state"])
+        if load_updater and snap.get("opt_state"):
+            model._opt_state = _unflatten_like(model._opt_state,
+                                               snap["opt_state"])
+        meta = snap["meta"]
         model._step = meta.get("step", 0)
         model._epoch = meta.get("epoch", 0)
         if meta.get("rng") is not None and hasattr(model, "_rng"):
@@ -172,10 +407,25 @@ class ModelSerializer:
         # (API unchanged), and the consumers (FaultTolerantTrainer's
         # fast-forward, ParallelWrapper's accumulator re-init) pop them
         model._resume_cursor = meta.get("cursor")
-        model._resume_extra = (
-            dict(np.load(io.BytesIO(z.read("extra.npz"))))
-            if "extra.npz" in names else None)
+        model._resume_extra = (dict(snap["extra"])
+                               if snap.get("extra") else None)
         return model
+
+    @staticmethod
+    def _restore_common(model, z: zipfile.ZipFile, load_updater: bool):
+        names = z.namelist()
+        snap = {
+            "params": dict(np.load(io.BytesIO(z.read("params.npz")))),
+            "net_state": (dict(np.load(io.BytesIO(z.read("state.npz"))))
+                          if "state.npz" in names else None),
+            "opt_state": (dict(np.load(io.BytesIO(z.read("updater.npz"))))
+                          if "updater.npz" in names else None),
+            "extra": (dict(np.load(io.BytesIO(z.read("extra.npz"))))
+                      if "extra.npz" in names else None),
+            "meta": json.loads(z.read("meta.json").decode()),
+        }
+        return ModelSerializer._restore_from_snapshot(model, snap,
+                                                      load_updater)
 
     @staticmethod
     def restore_computation_graph(path: str, load_updater: bool = True):

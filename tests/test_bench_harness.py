@@ -244,3 +244,61 @@ def test_check_bench_regression_list_mode():
     out = json.loads(buf.getvalue())
     assert any(m["metric"] == "training_chaos_steps_per_sec"
                for m in out["metrics"])
+
+
+def test_training_elastic_leg_runs_on_cpu():
+    """ISSUE 7 bench satellite at tiny scale (2 epochs = 128 steps):
+    the elastic leg must preempt its 4-worker compressed run, resume
+    RE-MESHED onto 2 workers with sharded (v3) checkpoints, finish the
+    schedule, and land within the documented tolerance of the
+    fixed-shape trajectory."""
+    import bench
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    r = subprocess.run([sys.executable, "-c",
+                        bench.TRAINING_ELASTIC_CODE, "2"],
+                       capture_output=True, text=True, timeout=600,
+                       env=env, cwd=ROOT)
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = [l for l in r.stdout.splitlines() if l.startswith("{")][-1]
+    res = json.loads(line)
+    assert res["elastic_steps_per_sec"] > 0
+    assert res["elastic_preempted"] is True
+    assert res["elastic_remeshed"] == [4, 2]
+    assert res["elastic_total_steps"] == 128      # schedule completed
+    assert res["elastic_sharded_checkpoints"] >= 1
+    assert res["elastic_resume_wall_s"] > 0
+    # docs/distributed.md's re-mesh tolerance contract
+    assert res["elastic_params_rel_err_vs_fixed_shape"] <= 0.05
+
+
+def test_training_elastic_metric_is_gated():
+    """The elastic leg's steps/sec is wired into the regression gate:
+    "new, skipped" until a BENCH_*.json records it, gated after."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "cbr4", os.path.join(ROOT, "tools", "check_bench_regression.py"))
+    cbr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cbr)
+    assert ("extra", "training_chaos", "elastic_steps_per_sec") \
+        in cbr.METRICS
+    rec = {"value": 100.0,
+           "extra": {"training_chaos": {"steps_per_sec": 120.0}}}
+    fresh = {"value": 100.0,
+             "extra": {"training_chaos": {"steps_per_sec": 120.0,
+                                          "elastic_steps_per_sec": 50.0}}}
+    r = cbr.compare(rec, fresh, 0.2)
+    assert not r["regressions"]
+    news = [e for e in r["skipped"] if e.get("note", "").startswith("new")]
+    assert any(e["metric"] == "training_elastic_steps_per_sec"
+               for e in news)
+    # and gated once recorded
+    rec2 = {"value": 100.0,
+            "extra": {"training_chaos": {"steps_per_sec": 120.0,
+                                         "elastic_steps_per_sec": 50.0}}}
+    bad = {"value": 100.0,
+           "extra": {"training_chaos": {"steps_per_sec": 120.0,
+                                        "elastic_steps_per_sec": 30.0}}}
+    r2 = cbr.compare(rec2, bad, 0.2)
+    assert [e["metric"] for e in r2["regressions"]] == \
+        ["training_elastic_steps_per_sec"]

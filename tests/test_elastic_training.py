@@ -1,0 +1,852 @@
+"""Elastic multi-worker training (ISSUE 7): coordinated preemption
+over a fleet-wide channel, sharded format-v3 checkpoints with a
+manifest-last commit, and elastic re-meshing resume (W -> W' with
+re-bucketed gradient-sharing state).
+
+Acceptance asserted here, all on the CPU backend:
+- multi-worker kill-and-resume at UNCHANGED worker count is bit-exact
+  vs the uninterrupted run (plain + both compressed wrapper modes),
+  through sharded checkpoints;
+- 8->4 and 4->8 re-meshed resume converges within the documented
+  tolerance (docs/distributed.md: rel L2 param distance <= 0.05) of
+  the fixed-shape trajectory, with zero post-warmup recompiles after
+  the re-meshed step rebuild;
+- torn sharded writes (faults between shard writes, and between the
+  last shard and the manifest commit) are never listed or resumed.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import zipfile
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+from deeplearning4j_tpu.faults import (FaultInjector, PreemptionFault,
+                                       TransientFault)
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel import (GradientSharingAccumulator,
+                                         ParallelWrapper,
+                                         rebucket_worker_array)
+from deeplearning4j_tpu.parallel.elastic import (FaultTolerantTrainer,
+                                                 PreemptionHandler)
+from deeplearning4j_tpu.parallel.multihost import (PreemptionCoordinator,
+                                                   split_data_cursor)
+from deeplearning4j_tpu.util.serializer import (CheckpointFormatError,
+                                                ModelSerializer)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: the documented re-mesh tolerance (docs/distributed.md): relative L2
+#: parameter distance of a re-meshed resume vs the fixed-shape run
+REMESH_REL_TOL = 0.05
+
+
+def _mlp(seed=0):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=2, loss="mcxent",
+                               activation="softmax"))
+            .input_type_feed_forward(4).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _arrays(n=64, seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.rand(n, 4).astype(np.float32)
+    return X, np.eye(2, dtype=np.float32)[rs.randint(0, 2, n)]
+
+
+def _it(X, Y, batch=16):
+    return ArrayDataSetIterator(X, Y, batch=batch, shuffle=True, seed=3)
+
+
+def _leaves(m):
+    return [np.array(a, copy=True)
+            for a in jax.tree_util.tree_leaves(m._params)]
+
+
+def _same(a, b):
+    return all(np.array_equal(x, y) for x, y in zip(a, b))
+
+
+def _flat(m):
+    return np.concatenate([a.ravel() for a in _leaves(m)])
+
+
+def _rel(a, b):
+    return float(np.linalg.norm(a - b) / np.linalg.norm(a))
+
+
+def _wrapped(model, workers, mode):
+    acc = (None if mode == "plain"
+           else GradientSharingAccumulator(mode=mode))
+    return ParallelWrapper(model, workers=workers, accumulator=acc)
+
+
+class TestPerWorkerInjectorSeams:
+    def test_worker_plan_targets_one_worker_at_its_own_count(self):
+        inj = FaultInjector(plan={"preempt": {1: [3]}})
+        # worker 0 never fires, no matter how many calls
+        for _ in range(6):
+            assert inj.fire("preempt", worker=0) is False
+        # worker 1 fires at ITS 3rd call — independent of worker 0's
+        assert inj.fire("preempt", worker=1) is False
+        assert inj.fire("preempt", worker=1) is False
+        with pytest.raises(PreemptionFault):
+            inj.fire("preempt", worker=1)
+        snap = inj.snapshot()
+        assert snap["fired"]["preempt"] == 1
+        assert snap["by_worker"]["preempt"][1]["fired"] == 1
+        assert snap["by_worker"]["preempt"][0]["fired"] == 0
+
+    def test_flat_plan_applies_per_worker_independently(self):
+        inj = FaultInjector(plan={"checkpoint_io": [2]})
+        assert inj.fire("checkpoint_io", worker=0) is False
+        assert inj.fire("checkpoint_io", worker=1) is False
+        # each worker's OWN 2nd call fires
+        with pytest.raises(TransientFault):
+            inj.fire("checkpoint_io", worker=0)
+        with pytest.raises(TransientFault):
+            inj.fire("checkpoint_io", worker=1)
+
+    def test_worker_streams_deterministic_and_independent(self):
+        def pattern(order):
+            inj = FaultInjector(seed=5, rates={"train_step": 0.5})
+            out = {0: [], 1: []}
+            for w in order:
+                try:
+                    inj.fire("train_step", worker=w)
+                    out[w].append("ok")
+                except TransientFault:
+                    out[w].append("fault")
+            return out
+        a = pattern([0] * 8 + [1] * 8)
+        # interleaving the workers' calls must not change either stream
+        b = pattern([0, 1] * 8)
+        assert a == b
+        assert "fault" in a[0] + a[1]        # the rate actually fires
+
+    def test_worker_scoped_unknown_seam_still_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault seams"):
+            FaultInjector(plan={"nope": {0: [1]}})
+
+
+class TestPreemptionCoordinator:
+    def test_generation_monotonic_and_reset(self, tmp_path):
+        c = PreemptionCoordinator()
+        g0 = c.generation()
+        t1 = c.signal(source=3)
+        assert c.generation() == t1 > g0
+        t2 = c.signal(source=4)
+        assert t2 > t1 and c.last_source == 4
+        c.reset()
+        assert c.generation() == 0.0
+
+    def test_file_channel_crosses_instances(self, tmp_path):
+        a = PreemptionCoordinator(channel_dir=str(tmp_path))
+        b = PreemptionCoordinator(channel_dir=str(tmp_path))
+        gb0 = b.generation()
+        a.signal(source="worker-a")
+        assert b.generation() > gb0          # saw the sentinel
+        assert b.last_source == "worker-a"
+        assert os.path.isfile(tmp_path / PreemptionCoordinator.SENTINEL)
+        b.reset()                            # clears the file too
+        assert not os.path.isfile(tmp_path / PreemptionCoordinator.SENTINEL)
+
+    def test_fresh_signaller_never_regresses_the_sentinel(self, tmp_path):
+        """A FRESH coordinator (operator shell / restarted process,
+        _gen=0) signalling into a channel whose sentinel carries a
+        HIGHER token (clock-skewed writer) must absorb the file first —
+        otherwise it would overwrite the sentinel with a lower token
+        and the notice would be invisible to workers whose gen0 came
+        from the file."""
+        a = PreemptionCoordinator(channel_dir=str(tmp_path))
+        a.signal(source="skewed")
+        # simulate a far-future writer
+        path = tmp_path / PreemptionCoordinator.SENTINEL
+        data = json.loads(path.read_text())
+        future = data["token"] + 3600.0
+        path.write_text(json.dumps(dict(data, token=future)))
+        fresh = PreemptionCoordinator(channel_dir=str(tmp_path))
+        tok = fresh.signal(source="operator")
+        assert tok > future
+        b = PreemptionCoordinator(channel_dir=str(tmp_path))
+        assert b.generation() == tok
+
+    def test_stale_notice_ignored_by_new_fit(self, tmp_path):
+        """A sentinel predating fit() must not preempt the restarted
+        fleet — the trainer compares against the token captured at its
+        own start."""
+        coord = PreemptionCoordinator(channel_dir=str(tmp_path / "ch"))
+        coord.signal(source="previous-life")
+        X, Y = _arrays()
+        m = _mlp()
+        tr = FaultTolerantTrainer(m, str(tmp_path / "ck"),
+                                  save_every_n_steps=100,
+                                  coordinator=coord)
+        tr.fit(_it(X, Y), epochs=1)          # completes, no preemption
+        assert tr.supervisor.preemptions.value() == 0
+
+    def test_split_data_cursor(self):
+        cur = {"epoch": 2, "batches_into_epoch": 7,
+               "iterator": {"epoch": 2}}
+        parts = split_data_cursor(cur, 4)
+        assert len(parts) == 4
+        for i, p in enumerate(parts):
+            # same GLOBAL position for every worker; coordinates ride
+            # alongside so input pipelines can re-derive their slice
+            assert p["epoch"] == 2 and p["batches_into_epoch"] == 7
+            assert p["worker"] == i and p["num_workers"] == 4
+        assert split_data_cursor(None, 3) == [None, None, None]
+        with pytest.raises(ValueError):
+            split_data_cursor(cur, 0)
+
+
+class TestCoordinatedPreemption:
+    @staticmethod
+    def _fleet_injector():
+        """Preempt exactly worker 1 at ITS 4th step; every worker's
+        train_step sleeps a few ms (slow_ms fires return, not raise) so
+        no thread can race through its whole schedule before the
+        originator reaches step 4 and the broadcast lands."""
+        return FaultInjector(plan={"preempt": {1: [4]}},
+                             rates={"train_step": 1.0},
+                             slow_ms={"train_step": 4.0})
+
+    def _run_fleet(self, base, coord, injector, n_workers=3, epochs=4):
+        """N plain trainers (threads) sharing one coordinator + one
+        worker-scoped injector. A first-step barrier holds everyone
+        until every worker has COMPILED and run one step — without it,
+        a worker whose compile finished early could sprint through its
+        whole schedule before the originator ever reaches its preempt
+        step. Returns (models, trainers, outcomes)."""
+        X, Y = _arrays(n=96)
+        models = [_mlp() for _ in range(n_workers)]
+        barrier = threading.Barrier(n_workers)
+
+        class SyncFirstStep:
+            def __init__(self):
+                self.passed = False
+
+            def iteration_done(self, m, step, epoch):
+                if not self.passed:
+                    self.passed = True
+                    barrier.wait(timeout=90)
+        for m in models:
+            m.set_listeners(SyncFirstStep())
+        trainers = [FaultTolerantTrainer(
+            models[i], str(base / f"w{i}"), save_every_n_steps=100,
+            fault_injector=injector, coordinator=coord, worker_id=i)
+            for i in range(n_workers)]
+        outcomes = [None] * n_workers
+
+        def run(i):
+            try:
+                trainers[i].fit(_it(X, Y, batch=8), epochs=epochs)
+                outcomes[i] = "done"
+            except PreemptionFault:
+                outcomes[i] = "preempted"
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(n_workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        return models, trainers, outcomes
+
+    def test_one_workers_preempt_drains_the_fleet(self, tmp_path):
+        """The tentpole's coordination clause: an injected
+        PreemptionFault on ONE worker makes EVERY worker flush a
+        step-granular checkpoint at its next boundary and exit with
+        PreemptionFault — nobody dies checkpoint-less."""
+        coord = PreemptionCoordinator()
+        models, trainers, outcomes = self._run_fleet(
+            tmp_path, coord, self._fleet_injector())
+        assert outcomes == ["preempted"] * 3, outcomes
+        # the originator broadcast once; the others received the notice
+        assert trainers[1].supervisor.preempts_broadcast.value() == 1
+        for i in (0, 2):
+            assert trainers[i].supervisor.preempts_received.value() == 1
+            assert trainers[i].supervisor.preempts_broadcast.value() == 0
+        # every worker has a STEP-granular checkpoint to restart from
+        for i in range(3):
+            names = [os.path.basename(p) for p in
+                     FaultTolerantTrainer.list_checkpoints(
+                         str(tmp_path / f"w{i}"))]
+            assert names and "_step" in names[-1], (i, names)
+
+    def test_fleet_resume_is_bit_exact_per_worker(self, tmp_path):
+        """Kill-and-resume across the COORDINATED stop replays each
+        worker's uninterrupted trajectory bit-exactly (the PR 5
+        guarantee extended fleet-wide)."""
+        X, Y = _arrays(n=96)
+        refs = []
+        for i in range(3):
+            mr = _mlp()
+            FaultTolerantTrainer(mr, str(tmp_path / f"ref{i}"),
+                                 save_every_n_steps=100).fit(
+                _it(X, Y, batch=8), epochs=4)
+            refs.append(mr)
+        coord = PreemptionCoordinator()
+        _, _, outcomes = self._run_fleet(tmp_path, coord,
+                                         self._fleet_injector())
+        assert outcomes == ["preempted"] * 3
+        for i in range(3):
+            m = FaultTolerantTrainer.resume(str(tmp_path / f"w{i}"))
+            FaultTolerantTrainer(m, str(tmp_path / f"w{i}"),
+                                 save_every_n_steps=100).fit(
+                _it(X, Y, batch=8), epochs=4)
+            assert _same(_leaves(refs[i]), _leaves(m)), \
+                f"worker {i} diverged after coordinated resume"
+
+    def test_sigterm_broadcasts_through_handler_channel(self, tmp_path):
+        """PreemptionHandler(coordinator=): a real SIGTERM on the
+        main-thread worker drains a background worker too. The handler
+        contract stays flag-only — the broadcast happens on the loop
+        thread at the step boundary."""
+        X, Y = _arrays(n=96)
+        coord = PreemptionCoordinator(channel_dir=str(tmp_path / "ch"))
+        # background worker: long schedule (slowed a few ms/step so it
+        # cannot finish before the main worker's SIGTERM at step 3),
+        # observes the channel
+        m_bg = _mlp()
+        tr_bg = FaultTolerantTrainer(
+            m_bg, str(tmp_path / "bg"), save_every_n_steps=100,
+            coordinator=coord, worker_id=1,
+            fault_injector=FaultInjector(rates={"train_step": 1.0},
+                                         slow_ms={"train_step": 4.0}))
+        bg_out = []
+
+        def run_bg():
+            try:
+                tr_bg.fit(_it(X, Y, batch=8), epochs=4)
+                bg_out.append("done")
+            except PreemptionFault:
+                bg_out.append("preempted")
+        bg = threading.Thread(target=run_bg)
+        # main-thread worker: SIGTERM delivered from a listener at
+        # step 3 (mid-loop — the frame an in-handler save could
+        # deadlock in)
+        m = _mlp()
+        tr = FaultTolerantTrainer(m, str(tmp_path / "main"),
+                                  save_every_n_steps=100, worker_id=0)
+        sent = []
+
+        class KillAtStep3:
+            def iteration_done(self, mm, step, epoch):
+                if step == 3 and not sent:
+                    sent.append(True)
+                    os.kill(os.getpid(), signal.SIGTERM)
+        m.set_listeners(KillAtStep3())
+        bg.start()
+        try:
+            with PreemptionHandler(tr, signals=(signal.SIGTERM,),
+                                   reraise=False,
+                                   coordinator=coord) as h:
+                with pytest.raises(PreemptionFault):
+                    tr.fit(_it(X, Y, batch=8), epochs=4)
+        finally:
+            bg.join(timeout=120)
+        assert h.preempted
+        assert tr.coordinator is coord       # handler installed it
+        assert bg_out == ["preempted"]
+        assert tr_bg.supervisor.preempts_received.value() == 1
+        # both flushed step-granular checkpoints
+        for d in ("main", "bg"):
+            names = [os.path.basename(p) for p in
+                     FaultTolerantTrainer.list_checkpoints(
+                         str(tmp_path / d))]
+            assert names and "_step" in names[-1], (d, names)
+
+
+@pytest.mark.parametrize("mode", ["plain", "update", "gradient"])
+class TestShardedCheckpointsBitExact:
+    """Same-shape kill-and-resume through format-v3 sharded
+    checkpoints stays BIT-EXACT — plain wrapper and both compressed
+    modes (the acceptance's unchanged-worker-count clause)."""
+
+    def test_kill_and_resume_bit_exact(self, tmp_path, mode):
+        X, Y = _arrays(n=64)
+        # uninterrupted reference (same sharded-checkpoint trainer)
+        mA = _mlp()
+        trA = FaultTolerantTrainer(
+            mA, str(tmp_path / "a"), save_every_n_steps=3,
+            wrapper=_wrapped(mA, 4, mode), sharded_checkpoints=True)
+        trA.fit(_it(X, Y), epochs=3)
+        assert trA.supervisor.sharded_checkpoints.value() >= 1
+        # killed mid-epoch by a scripted preemption
+        mB = _mlp()
+        trB = FaultTolerantTrainer(
+            mB, str(tmp_path / "b"), save_every_n_steps=3,
+            wrapper=_wrapped(mB, 4, mode), sharded_checkpoints=True,
+            fault_injector=FaultInjector(plan={"preempt": [7]}))
+        with pytest.raises(PreemptionFault):
+            trB.fit(_it(X, Y), epochs=3)
+        # restart: v3 restore, fresh wrapper at the SAME worker count
+        mC = FaultTolerantTrainer.resume(str(tmp_path / "b"))
+        assert mC._step == 7
+        pwC = _wrapped(mC, 4, mode)
+        trC = FaultTolerantTrainer(mC, str(tmp_path / "b"),
+                                   save_every_n_steps=3, wrapper=pwC,
+                                   sharded_checkpoints=True)
+        trC.fit(_it(X, Y), epochs=3)
+        assert pwC.last_remesh is None       # same shape = no re-mesh
+        assert mA._step == mC._step == 12
+        assert _same(_leaves(mA), _leaves(mC)), \
+            f"{mode}: sharded same-shape resume diverged"
+
+
+class TestShardedCheckpointLayout:
+    def _fit_sharded(self, d, steps=3, epochs=2, workers=4,
+                     injector=None, **kw):
+        X, Y = _arrays(n=64)
+        m = _mlp()
+        pw = ParallelWrapper(
+            m, workers=workers,
+            accumulator=GradientSharingAccumulator(mode="update"))
+        tr = FaultTolerantTrainer(m, d, save_every_n_steps=steps,
+                                  wrapper=pw, sharded_checkpoints=True,
+                                  fault_injector=injector, **kw)
+        return m, pw, tr, _it(X, Y)
+
+    def test_directory_layout_and_manifest(self, tmp_path):
+        m, pw, tr, it = self._fit_sharded(str(tmp_path))
+        tr.fit(it, epochs=2)
+        last = FaultTolerantTrainer.list_checkpoints(str(tmp_path))[-1]
+        assert last.endswith(".ckpt") and os.path.isdir(last)
+        files = sorted(os.listdir(last))
+        assert files == ["manifest.json"] + [
+            f"shard_{i:05d}.zip" for i in range(4)]
+        with open(os.path.join(last, "manifest.json")) as f:
+            man = json.load(f)
+        assert man["format_version"] == 3
+        assert man["num_workers"] == 4
+        assert man["meta"]["step"] == m._step
+        assert man["meta"]["cursor"]["epoch"] == 2
+        # per-worker arrays are the worker-sliced set
+        assert any(k.startswith("gradient_sharing/residuals/")
+                   for k in man["worker_sliced"])
+        assert any(k.startswith("gradient_sharing/opt_state/")
+                   for k in man["worker_sliced"])
+        assert "gradient_sharing/threshold" not in man["worker_sliced"]
+        for entry in man["shards"]:
+            p = os.path.join(last, entry["file"])
+            assert os.path.getsize(p) == entry["bytes"]
+            assert sum(entry["entries"].values()) > 0
+        # model-wide entries are DISTRIBUTED, not mirrored: no shard
+        # holds everything (the models-outgrow-host-RAM requirement)
+        total_params = sum(e["entries"]["params"] for e in man["shards"])
+        assert total_params == 4             # 2 layers x W,b
+        assert max(e["entries"]["params"] for e in man["shards"]) < 4
+
+    def test_mixed_v2_v3_listing_and_migration(self, tmp_path):
+        """A directory holding BOTH formats lists chronologically, and
+        a v2 checkpoint resumes into a sharded-checkpoint trainer —
+        the v2->v3 migration path is just 'resume and keep going'."""
+        X, Y = _arrays(n=64)
+        m = _mlp()
+        # epoch 1 written as a v2 zip
+        FaultTolerantTrainer(m, str(tmp_path), save_every_n_steps=100,
+                             wrapper=ParallelWrapper(m, workers=4)).fit(
+            _it(X, Y), epochs=1)
+        # resume, continue with SHARDED checkpoints to epoch 3
+        m2 = FaultTolerantTrainer.resume(str(tmp_path))
+        pw2 = ParallelWrapper(m2, workers=4)
+        FaultTolerantTrainer(m2, str(tmp_path), save_every_n_steps=100,
+                             wrapper=pw2, sharded_checkpoints=True).fit(
+            _it(X, Y), epochs=3)
+        names = [os.path.basename(p) for p in
+                 FaultTolerantTrainer.list_checkpoints(str(tmp_path))]
+        assert names == ["checkpoint_epoch1.zip",
+                         "checkpoint_epoch2.ckpt",
+                         "checkpoint_epoch3.ckpt"]
+        assert FaultTolerantTrainer.resume(str(tmp_path))._epoch == 3
+
+    def test_torn_between_shard_writes_never_listed(self, tmp_path):
+        """checkpoint_io fault on shard 2's write with no retries: the
+        'crash' lands between shard writes. list_checkpoints must not
+        surface the partial; resume falls back to the previous good
+        checkpoint."""
+        inj = FaultInjector(plan={"checkpoint_io": {2: [2]}})
+        m, pw, tr, it = self._fit_sharded(
+            str(tmp_path), injector=inj, async_write=False,
+            max_step_retries=0)
+        with pytest.raises(TransientFault):
+            tr.fit(it, epochs=2)
+        good = FaultTolerantTrainer.list_checkpoints(str(tmp_path))
+        # the first cadence checkpoint (step 3) succeeded — shard 2's
+        # 2nd call is the SECOND checkpoint's write (step 6)
+        assert [os.path.basename(p) for p in good] == \
+            ["checkpoint_epoch0_step3.ckpt"]
+        assert FaultTolerantTrainer.resume(str(tmp_path))._step == 3
+
+    def test_torn_before_manifest_commit_never_listed(self, tmp_path):
+        """Fault in the last-shard -> manifest window (the global
+        checkpoint_io fire after all 4 worker-scoped shard fires):
+        every shard is durable, the manifest is not — the checkpoint
+        must still be invisible."""
+        # per checkpoint attempt: 4 worker-scoped fires then 1 global;
+        # the global counter counts them all, so call #10 is the
+        # SECOND checkpoint's manifest fire
+        inj = FaultInjector(plan={"checkpoint_io": [10]})
+        m, pw, tr, it = self._fit_sharded(
+            str(tmp_path), injector=inj, async_write=False,
+            max_step_retries=0)
+        with pytest.raises(TransientFault):
+            tr.fit(it, epochs=2)
+        good = [os.path.basename(p) for p in
+                FaultTolerantTrainer.list_checkpoints(str(tmp_path))]
+        assert good == ["checkpoint_epoch0_step3.ckpt"]
+        assert FaultTolerantTrainer.resume(str(tmp_path))._step == 3
+
+    def test_manifestless_directory_is_invisible_and_diagnosable(
+            self, tmp_path):
+        """A torn directory that somehow landed at the LIVE name (e.g.
+        a partial rsync) is still rejected: the manifest is the commit
+        marker, not the directory rename."""
+        m, pw, tr, it = self._fit_sharded(str(tmp_path))
+        tr.fit(it, epochs=1)
+        good = FaultTolerantTrainer.list_checkpoints(str(tmp_path))[-1]
+        torn = os.path.join(str(tmp_path), "checkpoint_epoch9.ckpt")
+        os.makedirs(torn)
+        with open(os.path.join(torn, "shard_00000.zip"), "wb") as f:
+            f.write(b"partial")
+        assert FaultTolerantTrainer.list_checkpoints(
+            str(tmp_path))[-1] == good        # torn dir not listed
+        with pytest.raises(CheckpointFormatError, match="manifest"):
+            ModelSerializer.restore(torn)
+
+    def test_shard_temp_sweep_dead_swept_live_spared(self, tmp_path):
+        """Satellite 1: the stale-temp sweep extended to shard temps —
+        a dead writer's orphaned partial shard DIRECTORY (and an
+        orphaned inner shard temp) are swept; a live concurrent
+        writer's are spared (same embedded-pid rule as monolithic
+        temps)."""
+        m, pw, tr, it = self._fit_sharded(str(tmp_path))
+        tr.fit(it, epochs=1)
+        dead_pid = 999999999
+        live_pid = os.getpid()
+        # dead writer's partial checkpoint dir with an inner temp
+        dead_dir = str(tmp_path / f"checkpoint_epoch8.ckpt.tmp.{dead_pid}")
+        os.makedirs(dead_dir)
+        open(os.path.join(dead_dir, "shard_00000.zip"), "wb").close()
+        # live concurrent writer's partial dir
+        live_dir = str(tmp_path / f"checkpoint_epoch8.ckpt.tmp.{live_pid}")
+        os.makedirs(live_dir)
+        open(os.path.join(live_dir, "shard_00000.zip"), "wb").close()
+        # orphaned dead-pid shard temp inside a COMMITTED dir
+        committed = FaultTolerantTrainer.list_checkpoints(
+            str(tmp_path))[-1]
+        dead_inner = os.path.join(committed,
+                                  f"shard_00009.zip.tmp.{dead_pid}")
+        open(dead_inner, "wb").close()
+        live_inner = os.path.join(committed,
+                                  f"shard_00008.zip.tmp.{live_pid}")
+        open(live_inner, "wb").close()
+        tr._prune_and_sweep()
+        assert not os.path.exists(dead_dir)      # dead dir swept
+        assert os.path.isdir(live_dir)           # live dir spared
+        assert not os.path.exists(dead_inner)    # dead inner temp swept
+        assert os.path.exists(live_inner)        # live inner temp spared
+
+    def test_stranded_old_checkpoint_is_renamed_back(self, tmp_path):
+        """The rewrite path steps an existing checkpoint ASIDE
+        (`*.ckpt.old.<pid>`) instead of rmtree-ing it before the new
+        dir lands. If a kill strands the .old copy with the live name
+        missing, the sweep must rename it BACK — with keep_last=1 it
+        can be the only durable training state."""
+        m, pw, tr, it = self._fit_sharded(str(tmp_path))
+        tr.fit(it, epochs=1)
+        live = FaultTolerantTrainer.list_checkpoints(str(tmp_path))[-1]
+        # simulate the crash window: live name stepped aside by a
+        # now-dead writer, replacement never landed
+        stranded = f"{live}.old.999999999"
+        os.rename(live, stranded)
+        assert live not in FaultTolerantTrainer.list_checkpoints(
+            str(tmp_path))
+        tr._prune_and_sweep()
+        assert FaultTolerantTrainer.list_checkpoints(
+            str(tmp_path))[-1] == live       # recovered, resumable
+        assert not os.path.exists(stranded)
+        # ...while a LIVE writer's .old (ours, mid-rewrite) is spared
+        aside = f"{live}.old.{os.getpid()}"
+        os.makedirs(aside)
+        tr._prune_and_sweep()
+        assert os.path.isdir(aside)
+
+    def test_format_rewrite_removes_stale_twin(self, tmp_path):
+        """A checkpoint re-written in the OTHER format must delete its
+        same-(epoch, step) twin — otherwise the stale twin ties in the
+        listing sort and can shadow the fresh state at resume."""
+        X, Y = _arrays(n=64)
+        m = _mlp()
+        FaultTolerantTrainer(m, str(tmp_path),
+                             save_every_n_steps=100).fit(
+            _it(X, Y), epochs=1)             # checkpoint_epoch1.zip
+        assert os.path.exists(tmp_path / "checkpoint_epoch1.zip")
+        m2 = _mlp()
+        pw2 = ParallelWrapper(m2, workers=4)
+        FaultTolerantTrainer(m2, str(tmp_path), save_every_n_steps=100,
+                             wrapper=pw2, sharded_checkpoints=True).fit(
+            _it(X, Y), epochs=1)             # checkpoint_epoch1.ckpt
+        names = [os.path.basename(p) for p in
+                 FaultTolerantTrainer.list_checkpoints(str(tmp_path))]
+        assert names == ["checkpoint_epoch1.ckpt"]   # twin removed
+        # and the reverse direction: v2 rewrite removes the .ckpt twin
+        m3 = _mlp()
+        FaultTolerantTrainer(m3, str(tmp_path),
+                             save_every_n_steps=100).fit(
+            _it(X, Y), epochs=1)
+        names = [os.path.basename(p) for p in
+                 FaultTolerantTrainer.list_checkpoints(str(tmp_path))]
+        assert names == ["checkpoint_epoch1.zip"]
+
+    def test_keep_last_prunes_shard_directories(self, tmp_path):
+        m, pw, tr, it = self._fit_sharded(str(tmp_path), steps=2,
+                                          keep_last=2)
+        tr.fit(it, epochs=2)
+        ckpts = FaultTolerantTrainer.list_checkpoints(str(tmp_path))
+        assert len(ckpts) == 2
+        assert all(os.path.isdir(p) for p in ckpts)
+
+
+class TestElasticRemesh:
+    def _run_fixed(self, d, mode, workers, epochs=3):
+        X, Y = _arrays(n=64)
+        m = _mlp()
+        pw = _wrapped(m, workers, mode)
+        FaultTolerantTrainer(m, d, save_every_n_steps=4, wrapper=pw,
+                             sharded_checkpoints=True).fit(
+            _it(X, Y), epochs=epochs)
+        return m
+
+    @pytest.mark.parametrize("w_from,w_to", [(8, 4), (4, 8)])
+    @pytest.mark.parametrize("mode", ["update", "gradient"])
+    def test_remeshed_resume_within_tolerance(self, tmp_path, mode,
+                                              w_from, w_to):
+        """The acceptance's changed-shape clause: preempt a W-worker
+        compressed run, resume onto W' workers — the re-bucketed run
+        finishes the schedule and lands within the documented
+        tolerance of the fixed-shape trajectory, with zero post-warmup
+        recompiles after the re-meshed step rebuild."""
+        X, Y = _arrays(n=64)
+        ref = self._run_fixed(str(tmp_path / "ref"), mode, w_from)
+        # preempted at step 7 (mid-epoch 1) on the ORIGINAL fleet
+        mB = _mlp()
+        trB = FaultTolerantTrainer(
+            mB, str(tmp_path / "b"), save_every_n_steps=4,
+            wrapper=_wrapped(mB, w_from, mode), sharded_checkpoints=True,
+            fault_injector=FaultInjector(plan={"preempt": [7]}))
+        with pytest.raises(PreemptionFault):
+            trB.fit(_it(X, Y), epochs=3)
+        # restart on the NEW fleet shape
+        mC = FaultTolerantTrainer.resume(str(tmp_path / "b"))
+        assert mC._step == 7
+        pwC = _wrapped(mC, w_to, mode)
+        pwC.ensure_step()                    # consumes + re-buckets
+        assert pwC.last_remesh == (w_from, w_to)
+        res = pwC.accumulator.residuals
+        assert all(np.asarray(a).shape[0] == w_to
+                   for a in jax.tree_util.tree_leaves(res))
+        trC = FaultTolerantTrainer(mC, str(tmp_path / "b"),
+                                   save_every_n_steps=4, wrapper=pwC,
+                                   sharded_checkpoints=True)
+        trC.fit(_it(X, Y), epochs=3)
+        assert mC._step == ref._step == 12   # schedule completed
+        rel = _rel(_flat(ref), _flat(mC))
+        assert rel <= REMESH_REL_TOL, \
+            f"{mode} {w_from}->{w_to}: rel err {rel} > {REMESH_REL_TOL}"
+        assert np.isfinite(_flat(mC)).all()
+        # zero post-warmup recompiles after the re-meshed rebuild: the
+        # continued multi-epoch fit ran on exactly one compiled program
+        assert pwC._sharded_step._jit._cache_size() == 1
+
+    def test_plain_wrapper_remesh_keeps_dense_trajectory(self, tmp_path):
+        """No per-worker state to re-bucket: a dense DP checkpoint
+        resumed at a different worker count computes the same global
+        math (tolerance covers cross-shard reduction-order float
+        noise)."""
+        X, Y = _arrays(n=64)
+        ref = self._run_fixed(str(tmp_path / "ref"), "plain", 4)
+        mB = _mlp()
+        trB = FaultTolerantTrainer(
+            mB, str(tmp_path / "b"), save_every_n_steps=4,
+            wrapper=_wrapped(mB, 4, "plain"), sharded_checkpoints=True,
+            fault_injector=FaultInjector(plan={"preempt": [7]}))
+        with pytest.raises(PreemptionFault):
+            trB.fit(_it(X, Y), epochs=3)
+        mC = FaultTolerantTrainer.resume(str(tmp_path / "b"))
+        pwC = _wrapped(mC, 2, "plain")
+        FaultTolerantTrainer(mC, str(tmp_path / "b"),
+                             save_every_n_steps=4, wrapper=pwC,
+                             sharded_checkpoints=True).fit(
+            _it(X, Y), epochs=3)
+        assert mC._step == 12
+        assert _rel(_flat(ref), _flat(mC)) <= 1e-2
+
+    # -- re-bucket unit semantics --------------------------------------
+    def test_rebucket_shrink_is_group_mean(self):
+        arr = np.arange(8 * 3, dtype=np.float32).reshape(8, 3)
+        out = rebucket_worker_array(arr, 4)
+        assert out.shape == (4, 3)
+        np.testing.assert_allclose(out[0], arr[:2].mean(0))
+        np.testing.assert_allclose(out[3], arr[6:].mean(0))
+
+    def test_rebucket_grow_is_replication(self):
+        arr = np.random.RandomState(0).rand(4, 3).astype(np.float32)
+        out = rebucket_worker_array(arr, 8)
+        assert out.shape == (8, 3)
+        np.testing.assert_array_equal(out[0], arr[0])
+        np.testing.assert_array_equal(out[1], arr[0])
+        np.testing.assert_array_equal(out[7], arr[3])
+
+    @pytest.mark.parametrize("w_to", [2, 4, 16, 3])
+    def test_rebucket_preserves_pmean_mass(self, w_to):
+        """The invariant the rule is built on: the per-step pmean
+        contribution (1/W) * sum_w state_w is preserved exactly (up to
+        float noise) under shrink, growth, AND the non-divisible
+        fallback."""
+        arr = np.random.RandomState(1).rand(8, 5).astype(np.float32)
+        out = rebucket_worker_array(arr, w_to)
+        np.testing.assert_allclose(out.mean(axis=0), arr.mean(axis=0),
+                                   rtol=1e-5)
+        assert out.dtype == arr.dtype
+
+    def test_rebucket_identity_and_validation(self):
+        arr = np.ones((4, 2), np.float32)
+        assert rebucket_worker_array(arr, 4) is arr
+        with pytest.raises(ValueError):
+            rebucket_worker_array(arr, 0)
+
+
+class TestFormatValidation:
+    def test_unknown_zip_version_is_actionable(self, tmp_path):
+        """Satellite 6: resume() on an unknown payload fails with the
+        expected/found versions and the path — not a KeyError."""
+        X, Y = _arrays(n=16)
+        m = _mlp()
+        FaultTolerantTrainer(m, str(tmp_path),
+                             save_every_n_steps=100).fit(
+            _it(X, Y, batch=16), epochs=1)
+        path = FaultTolerantTrainer.list_checkpoints(str(tmp_path))[-1]
+        # rewrite meta.json with a future format version
+        tmp = path + ".rewrite"
+        with zipfile.ZipFile(path) as zin, \
+                zipfile.ZipFile(tmp, "w") as zout:
+            for name in zin.namelist():
+                data = zin.read(name)
+                if name == "meta.json":
+                    meta = json.loads(data.decode())
+                    meta["format_version"] = 99
+                    data = json.dumps(meta).encode()
+                zout.writestr(name, data)
+        os.replace(tmp, path)
+        with pytest.raises(CheckpointFormatError) as ei:
+            FaultTolerantTrainer.resume(str(tmp_path))
+        msg = str(ei.value)
+        assert "99" in msg and str(path) in msg and "supports" in msg
+
+    def test_unknown_manifest_version_is_actionable(self, tmp_path):
+        X, Y = _arrays(n=16)
+        m = _mlp()
+        FaultTolerantTrainer(m, str(tmp_path), save_every_n_steps=100,
+                             sharded_checkpoints=True).fit(
+            _it(X, Y, batch=16), epochs=1)
+        path = FaultTolerantTrainer.list_checkpoints(str(tmp_path))[-1]
+        mpath = os.path.join(path, "manifest.json")
+        with open(mpath) as f:
+            man = json.load(f)
+        man["format_version"] = 42
+        with open(mpath, "w") as f:
+            json.dump(man, f)
+        with pytest.raises(CheckpointFormatError) as ei:
+            FaultTolerantTrainer.resume(str(tmp_path))
+        assert "42" in str(ei.value) and path in str(ei.value)
+
+    def test_v1_missing_version_still_restores(self, tmp_path):
+        """Pre-v2 checkpoints carried no format_version — they must
+        keep loading (missing == v1), not trip the gate."""
+        X, Y = _arrays(n=16)
+        m = _mlp()
+        FaultTolerantTrainer(m, str(tmp_path),
+                             save_every_n_steps=100).fit(
+            _it(X, Y, batch=16), epochs=1)
+        path = FaultTolerantTrainer.list_checkpoints(str(tmp_path))[-1]
+        tmp = path + ".rewrite"
+        with zipfile.ZipFile(path) as zin, \
+                zipfile.ZipFile(tmp, "w") as zout:
+            for name in zin.namelist():
+                data = zin.read(name)
+                if name == "meta.json":
+                    meta = json.loads(data.decode())
+                    meta.pop("format_version", None)
+                    data = json.dumps(meta).encode()
+                zout.writestr(name, data)
+        os.replace(tmp, path)
+        assert FaultTolerantTrainer.resume(str(tmp_path)) is not None
+
+
+class TestInspectCheckpointTool:
+    def _build_both(self, tmp_path):
+        """v2 zips for epoch 1, then a sharded trainer RESUMES the run
+        to epoch 3 — distinct (epoch, step) positions, so both formats
+        coexist (same-name rewrites would rightly remove their twin)."""
+        X, Y = _arrays(n=32)
+        m = _mlp()
+        FaultTolerantTrainer(m, str(tmp_path), save_every_n_steps=2,
+                             keep_last=10).fit(
+            _it(X, Y, batch=16), epochs=1)
+        m2 = FaultTolerantTrainer.resume(str(tmp_path))
+        pw = ParallelWrapper(
+            m2, workers=4,
+            accumulator=GradientSharingAccumulator(mode="update"))
+        FaultTolerantTrainer(m2, str(tmp_path), save_every_n_steps=2,
+                             keep_last=10, wrapper=pw,
+                             sharded_checkpoints=True).fit(
+            _it(X, Y, batch=16), epochs=3)
+
+    def test_inspects_v2_and_v3_via_cli(self, tmp_path):
+        self._build_both(tmp_path)
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(ROOT, "tools", "inspect_checkpoint.py"),
+             str(tmp_path), "--json"],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr[-2000:]
+        rep = json.loads(r.stdout)
+        kinds = {c["kind"] for c in rep["checkpoints"]}
+        assert "file (v1/v2 zip)" in kinds
+        assert "shard directory (v3)" in kinds
+        for c in rep["checkpoints"]:
+            assert c["step"] is not None and c["has_rng"] is True
+            assert c["cursor"] is not None
+        v3 = [c for c in rep["checkpoints"]
+              if c["kind"].startswith("shard")]
+        assert all(c["num_workers"] == 4 and len(c["shards"]) == 4
+                   for c in v3)
+        assert all(s["present"] for c in v3 for s in c["shards"])
+        assert any(c["worker_sliced_keys"] for c in v3)
+
+    def test_flags_torn_directory(self, tmp_path):
+        self._build_both(tmp_path)
+        torn = os.path.join(str(tmp_path), "checkpoint_epoch7.ckpt")
+        os.makedirs(torn)
+        open(os.path.join(torn, "shard_00000.zip"), "wb").close()
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(ROOT, "tools", "inspect_checkpoint.py"),
+             torn, "--json"],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 1              # broken => nonzero
+        rep = json.loads(r.stdout)
+        assert rep["checkpoints"][0]["torn"] is True
+        assert "never committed" in rep["checkpoints"][0]["error"]

@@ -55,6 +55,12 @@ METRICS = {
     # until the next BENCH_*.json records a baseline, gated after
     ("extra", "training_chaos", "steps_per_sec"):
         "training_chaos_steps_per_sec",
+    # elastic leg (ISSUE 7): 4-worker compressed run, sharded v3
+    # checkpoints, scripted preemption + RE-MESHED resume at 2 workers
+    # inside the timed window — "new, skipped" until the next
+    # BENCH_*.json records a baseline, gated after
+    ("extra", "training_chaos", "elastic_steps_per_sec"):
+        "training_elastic_steps_per_sec",
     # fleet requests/sec through the occupancy-aware router with one
     # scripted zero-loss rolling restart mid-run (ISSUE 6)
     ("extra", "fleet", "requests_per_sec"): "fleet_rps",

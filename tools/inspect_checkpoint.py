@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""Print what's inside a training checkpoint — the first thing the
+on-call runbook reaches for before anyone debugs a bad resume.
+
+Handles every format this codebase writes:
+
+- **v1/v2 single-file zips** (``checkpoint_epochE[_stepS].zip``):
+  format version, model type, step/epoch, loop cursor, PRNG presence,
+  member sizes, and the flat entry counts per section.
+- **v3 shard directories** (``checkpoint_epochE[_stepS].ckpt/``):
+  everything above plus the manifest (worker count, worker-sliced key
+  list) and the shard table — file, bytes, per-section entry counts —
+  including whether the manifest (the commit marker) is present at
+  all, so a torn write is visible at a glance.
+
+Given a directory that is not itself a ``.ckpt`` checkpoint, every
+completed checkpoint in it is inspected (same filter
+``FaultTolerantTrainer.list_checkpoints`` applies), and stray temp
+files/dirs are counted so an operator sees leftover write corpses.
+
+Deliberately framework-free: reads zips + JSON only (npz members are
+zip archives themselves, so entry counts come from ``namelist`` without
+loading any array, and without importing jax) — safe to run on a
+wedged host mid-incident.
+
+Usage::
+
+    python tools/inspect_checkpoint.py ckpts/                    # all
+    python tools/inspect_checkpoint.py ckpts/checkpoint_epoch3.ckpt
+    python tools/inspect_checkpoint.py a.zip b.ckpt --json
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import io
+import json
+import os
+import re
+import sys
+import zipfile
+
+_CKPT_RE = re.compile(r"checkpoint_epoch(\d+)(?:_step(\d+))?\.(?:zip|ckpt)$")
+MANIFEST_NAME = "manifest.json"
+
+_SECTIONS = (("params", "params.npz"), ("net_state", "state.npz"),
+             ("opt_state", "updater.npz"), ("extra", "extra.npz"))
+
+
+def _npz_entry_names(data: bytes):
+    """An .npz is itself a zip of ``<key>.npy`` members — count/name
+    entries without numpy."""
+    with zipfile.ZipFile(io.BytesIO(data)) as z:
+        return [n[:-4] if n.endswith(".npy") else n for n in z.namelist()]
+
+
+def _zip_sections(z: zipfile.ZipFile) -> dict:
+    infos = {i.filename: i for i in z.infolist()}
+    out = {}
+    for section, member in _SECTIONS:
+        if member in infos:
+            names = _npz_entry_names(z.read(member))
+            out[section] = {"entries": len(names),
+                            "bytes": infos[member].file_size,
+                            "keys_sample": sorted(names)[:8]}
+    return out
+
+
+def _meta_summary(meta: dict) -> dict:
+    return {
+        "format_version": meta.get("format_version", 1),
+        "model_type": meta.get("model_type"),
+        "step": meta.get("step"),
+        "epoch": meta.get("epoch"),
+        "cursor": meta.get("cursor"),
+        "has_rng": meta.get("rng") is not None,
+    }
+
+
+def inspect_zip(path: str) -> dict:
+    with zipfile.ZipFile(path) as z:
+        meta = json.loads(z.read("meta.json").decode())
+        out = {"path": path, "kind": "file (v1/v2 zip)",
+               "bytes": os.path.getsize(path)}
+        out.update(_meta_summary(meta))
+        out["sections"] = _zip_sections(z)
+    return out
+
+
+def inspect_sharded(path: str) -> dict:
+    out = {"path": path, "kind": "shard directory (v3)"}
+    mpath = os.path.join(path, MANIFEST_NAME)
+    if not os.path.isfile(mpath):
+        out["torn"] = True
+        out["error"] = ("no manifest.json — the write never committed; "
+                        "this checkpoint is torn and will never be "
+                        "listed or resumed")
+        out["files_present"] = sorted(os.listdir(path))
+        return out
+    with open(mpath) as f:
+        manifest = json.load(f)
+    out.update(_meta_summary(manifest.get("meta", {})))
+    out["format_version"] = manifest.get("format_version")
+    out["num_workers"] = manifest.get("num_workers")
+    out["worker_sliced_keys"] = manifest.get("worker_sliced", [])
+    shards, total = [], 0
+    for entry in manifest.get("shards", []):
+        spath = os.path.join(path, entry["file"])
+        row = dict(entry)
+        row["present"] = os.path.isfile(spath)
+        if row["present"]:
+            actual = os.path.getsize(spath)
+            row["bytes_on_disk"] = actual
+            total += actual
+            if "bytes" in entry and entry["bytes"] != actual:
+                row["size_mismatch"] = True
+        shards.append(row)
+    out["shards"] = shards
+    out["total_shard_bytes"] = total
+    missing = [s["file"] for s in shards if not s["present"]]
+    if missing:
+        out["error"] = f"manifest references missing shards: {missing}"
+    return out
+
+
+def inspect(path: str) -> dict:
+    try:
+        if os.path.isdir(path):
+            return inspect_sharded(path)
+        return inspect_zip(path)
+    except Exception as e:  # noqa: BLE001 — a broken checkpoint must
+        # still produce a diagnosable row, not a traceback
+        return {"path": path, "error": f"{type(e).__name__}: {e}"}
+
+
+def collect(paths) -> dict:
+    """Expand checkpoint-collection directories; inspect everything."""
+    out = {"checkpoints": [], "stray_temps": []}
+    for p in paths:
+        if os.path.isdir(p) and not _CKPT_RE.search(p):
+            members = sorted(
+                q for q in glob.glob(os.path.join(p, "checkpoint_epoch*"))
+                if _CKPT_RE.search(q))
+            out["checkpoints"].extend(inspect(q) for q in members)
+            # write corpses: in-flight/crashed temps of either format,
+            # AND stepped-aside `.old.<pid>` dirs from an interrupted
+            # same-name rewrite — the one corpse that can hold the only
+            # copy of a checkpoint (the trainer's sweep renames it back)
+            out["stray_temps"].extend(sorted(
+                glob.glob(os.path.join(p, "checkpoint_epoch*.tmp.*"))
+                + glob.glob(os.path.join(p, "checkpoint_epoch*.old.*"))))
+        else:
+            out["checkpoints"].append(inspect(p))
+    # dedupe while preserving order
+    seen = set()
+    out["stray_temps"] = [t for t in out["stray_temps"]
+                          if not (t in seen or seen.add(t))]
+    return out
+
+
+def _fmt_human(report: dict) -> str:
+    lines = []
+    for c in report["checkpoints"]:
+        lines.append(f"== {c['path']}")
+        for k in ("kind", "format_version", "model_type", "step",
+                  "epoch", "cursor", "has_rng", "num_workers",
+                  "total_shard_bytes", "bytes", "error"):
+            if c.get(k) is not None:
+                lines.append(f"   {k}: {c[k]}")
+        for section, info in (c.get("sections") or {}).items():
+            lines.append(f"   {section}: {info['entries']} entries, "
+                         f"{info['bytes']} bytes")
+        if c.get("worker_sliced_keys"):
+            lines.append(f"   worker-sliced keys: "
+                         f"{len(c['worker_sliced_keys'])} "
+                         f"(e.g. {c['worker_sliced_keys'][0]})")
+        for s in c.get("shards", []):
+            mark = "" if s.get("present") else "  MISSING"
+            lines.append(f"   shard {s['file']}: "
+                         f"{s.get('bytes_on_disk', '?')} bytes "
+                         f"{s.get('entries', '')}{mark}")
+    if report["stray_temps"]:
+        lines.append(f"-- stray temp files/dirs (interrupted writes): "
+                     f"{len(report['stray_temps'])}")
+        lines.extend(f"   {t}" for t in report["stray_temps"])
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="+",
+                    help="checkpoint file/dir, or a directory of them")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+    report = collect(args.paths)
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        print(_fmt_human(report))
+    # non-zero when anything is broken: scripts can gate on it
+    return 1 if any(c.get("error") for c in report["checkpoints"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
