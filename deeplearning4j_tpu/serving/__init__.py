@@ -53,6 +53,14 @@ Status codes: 400 malformed request (client), 404 unknown route/model,
 shed (queue full) or draining — always with ``Retry-After``, 504
 deadline exceeded.
 
+Priority classes (docs/serving.md "Overload and admission control"):
+every predict/generate request may carry ``"priority": "interactive"``
+(default) or ``"batch"`` — as a JSON field or the ``X-Priority``
+request header (the field wins when both are present). Under pressure
+batch-class work is shed first (503) so interactive p99 holds, and
+deadline-aware admission sheds requests whose budget is already blown
+before they burn a device step.
+
 Fault tolerance (:mod:`.faults`, docs/serving.md "Operating the
 server"): supervised engine loops retry transient step faults with
 bounded backoff and rebuild cache-corrupting failures by
@@ -306,6 +314,13 @@ class InferenceServer:
                         req = json.loads(raw)
                     except json.JSONDecodeError as e:
                         raise ClientError(f"malformed JSON: {e}")
+                    # the X-Priority header maps to the "priority"
+                    # field (routers/gateways tag traffic classes
+                    # without rewriting bodies); the body field wins
+                    prio_hdr = self.headers.get("X-Priority")
+                    if prio_hdr and isinstance(req, dict) \
+                            and "priority" not in req:
+                        req["priority"] = prio_hdr
                     if action == "generate":
                         if isinstance(req, dict) and req.get("stream"):
                             # admission errors raise HERE (before any
@@ -455,7 +470,11 @@ class InferenceServer:
                 not isinstance(timeout_ms, (int, float))
                 or isinstance(timeout_ms, bool)):
             raise ClientError("'timeout_ms' must be a number")
-        res = served.predict(req["inputs"], outputs, timeout_ms=timeout_ms)
+        priority = req.get("priority", "interactive")
+        if not isinstance(priority, str):
+            raise ClientError("'priority' must be a string")
+        res = served.predict(req["inputs"], outputs, timeout_ms=timeout_ms,
+                             priority=priority)
         if isinstance(res, dict):
             return {"outputs": {k: np.asarray(v).tolist()
                                 for k, v in res.items()}}
@@ -489,6 +508,11 @@ class InferenceServer:
                         req[key], bool):
                     raise ClientError(f"{key!r} must be a number")
                 opts[key] = req[key]
+        priority = req.get("priority")
+        if priority is not None:
+            if not isinstance(priority, str):
+                raise ClientError("'priority' must be a string")
+            opts["priority"] = priority
         return served, req["prompt"], opts
 
     def _generate(self, name: str, req) -> dict:
@@ -619,6 +643,9 @@ class InferenceServer:
         return {"ready": self.ready(),
                 "draining": not self.ready(),
                 "load": sum(m["load"] for m in models.values()),
+                # server-level shed total: a fleet poller aggregates
+                # these into per-replica overload counters
+                "shed": sum(m.get("shed", 0) for m in models.values()),
                 "models": models}
 
     def stop(self):

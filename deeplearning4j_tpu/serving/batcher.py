@@ -14,6 +14,18 @@ Overload semantics are explicit: a full queue SHEDS the request
 bound, and every request carries a deadline
 (:class:`DeadlineExceededError` → HTTP 504) so a stalled device cannot
 strand clients forever.
+
+Admission control (docs/serving.md "Overload and admission control"):
+requests carry a priority class — ``interactive`` (default) or
+``batch`` — and under pressure batch work is shed FIRST: batch-class
+requests only get the front ``batch_queue_fraction`` of the queue,
+interactive requests get all of it. Admission is also deadline-aware
+and adaptive: the batcher keeps an EWMA of the device-call time and
+(a) sheds at submit when the estimated queue wait alone already blows
+the request's deadline budget (503 — another, shorter-queued replica
+may still make it), and (b) drops a request at dequeue when its
+remaining budget cannot cover even one device call (504) — zero
+device steps are ever spent on a request that cannot finish in time.
 """
 from __future__ import annotations
 
@@ -43,16 +55,22 @@ class DeadlineExceededError(ServingError):
     (HTTP 504)."""
 
 
+#: Priority classes, in shed order: under pressure "batch" is shed
+#: first so "interactive" p99 holds. Anything else is a ClientError.
+PRIORITIES = ("interactive", "batch")
+
+
 class _Request:
-    __slots__ = ("feed", "n", "sig", "deadline", "event", "result",
-                 "error", "t_submit", "abandoned", "_lock",
+    __slots__ = ("feed", "n", "sig", "deadline", "priority", "event",
+                 "result", "error", "t_submit", "abandoned", "_lock",
                  "_timeout_counted")
 
-    def __init__(self, feed, n, sig, deadline):
+    def __init__(self, feed, n, sig, deadline, priority="interactive"):
         self.feed = feed
         self.n = n
         self.sig = sig
         self.deadline = deadline
+        self.priority = priority
         self.event = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
@@ -88,7 +106,8 @@ class MicroBatcher:
                  max_retries: int = 3,
                  retry_backoff_ms: float = 1.0,
                  retry_backoff_max_ms: float = 50.0,
-                 stall_timeout_s: float = 30.0):
+                 stall_timeout_s: float = 30.0,
+                 batch_queue_fraction: float = 0.5):
         self.engine = engine
         self.max_batch_size = int(max_batch_size or engine.max_batch_size)
         if self.max_batch_size > engine.max_batch_size:
@@ -105,6 +124,15 @@ class MicroBatcher:
         self.stall_timeout_s = float(stall_timeout_s)
         self.metrics = engine.metrics
         self.metrics.queue_max = int(max_queue)
+        # priority shedding: batch-class work only gets the front
+        # fraction of the queue; interactive gets all of it
+        self.batch_queue_fraction = float(batch_queue_fraction)
+        self._batch_queue_limit = max(
+            1, int(self.batch_queue_fraction * max_queue))
+        # adaptive admission: EWMA of one device call, measured — the
+        # deadline-budget checks key off it, so the limits track the
+        # actual service rate instead of a hand-tuned constant
+        self._device_ewma_ms = 0.0
         self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=max_queue)
         self._held: "deque[_Request]" = deque()  # signature-mismatched
         self._profiler = OpProfiler.get_instance()
@@ -117,11 +145,18 @@ class MicroBatcher:
 
     # -- client side ---------------------------------------------------
     def submit(self, inputs, outputs: Optional[Sequence[str]] = None,
-               timeout_ms: Optional[float] = None) -> Any:
+               timeout_ms: Optional[float] = None,
+               priority: str = "interactive") -> Any:
         """Enqueue one request and block until its result. Raises
         :class:`~.engine.ClientError` on malformed payloads,
         :class:`QueueFullError` when shedding, and
-        :class:`DeadlineExceededError` past the deadline."""
+        :class:`DeadlineExceededError` past the deadline. ``priority``
+        is ``"interactive"`` (default) or ``"batch"``; batch-class
+        work is shed first under pressure."""
+        if priority not in PRIORITIES:
+            raise ClientError(
+                f"unknown priority {priority!r}; expected one of "
+                f"{PRIORITIES}")
         if self._draining:
             # checked before _running: a drained replica answers 503 +
             # Retry-After (retry elsewhere), not 500, for its lifetime
@@ -137,7 +172,38 @@ class MicroBatcher:
                 f"{self.max_batch_size}; split the request")
         timeout = (self.default_timeout_ms if timeout_ms is None
                    else float(timeout_ms)) / 1000.0
-        req = _Request(feed, n, sig, deadline=time.perf_counter() + timeout)
+        depth = self._queue.qsize()
+        if priority == "batch" and depth >= self._batch_queue_limit:
+            # shed order: batch first — interactive may still use the
+            # remaining queue, so its p99 holds while batch degrades
+            self.metrics.inc("shed")
+            self.metrics.inc("shed_batch")
+            raise QueueFullError(
+                f"queue depth {depth} at the batch-priority limit "
+                f"({self._batch_queue_limit}/{self.metrics.queue_max});"
+                f" shedding batch-class work first")
+        est_wait_ms = self._est_queue_wait_ms(depth)
+        if est_wait_ms + self._device_ewma_ms > timeout * 1e3:
+            # deadline-aware early rejection at SUBMIT. Two distinct
+            # verdicts: a budget smaller than ONE device call can
+            # never be met anywhere (504, same as expiring in queue);
+            # a budget eaten by THIS queue's wait is load-local (503 —
+            # a shorter-queued replica may still make it)
+            self.metrics.inc("shed_deadline")
+            if self._device_ewma_ms > timeout * 1e3:
+                self.metrics.inc("timeouts")
+                raise DeadlineExceededError(
+                    f"deadline budget {timeout * 1e3:.0f} ms is below "
+                    f"one device call ({self._device_ewma_ms:.0f} ms);"
+                    f" rejecting at admission")
+            self.metrics.inc("shed")
+            raise QueueFullError(
+                f"estimated queue wait {est_wait_ms:.0f} ms exceeds "
+                f"the {timeout * 1e3:.0f} ms deadline budget; shedding"
+                f" at admission")
+        req = _Request(feed, n, sig,
+                       deadline=time.perf_counter() + timeout,
+                       priority=priority)
         try:
             self._queue.put_nowait(req)
         except queue.Full:
@@ -163,6 +229,15 @@ class MicroBatcher:
             (time.perf_counter() - req.t_submit) * 1e3)
         return req.result
 
+    def _est_queue_wait_ms(self, depth: int) -> float:
+        """Estimated time for ``depth`` queued rows to drain, from the
+        measured device-call EWMA. 0.0 until the first call lands (a
+        cold batcher admits everything — no data, no shedding)."""
+        if not self._device_ewma_ms or depth <= 0:
+            return 0.0
+        calls = -(-depth // self.max_batch_size)  # ceil division
+        return calls * self._device_ewma_ms
+
     # -- scheduler side ------------------------------------------------
     def _next(self, block_s: Optional[float]):
         if self._held:
@@ -175,13 +250,18 @@ class MicroBatcher:
 
     def _expired(self, req) -> bool:
         """Drop a dead request instead of spending device time on rows
-        nobody will read. The timeout count is a per-request CAS — the
+        nobody will read. Deadline-BUDGET aware: a request whose
+        remaining budget cannot cover even one device call (EWMA) is
+        already lost — shed it at dequeue-admission, before it burns a
+        device step. The timeout count is a per-request CAS — the
         waiter may be counting the same expiry concurrently."""
         if req.abandoned:
             return True
-        if time.perf_counter() > req.deadline:
-            req.error = DeadlineExceededError("expired in queue")
+        if time.perf_counter() > req.deadline - self._device_ewma_ms / 1e3:
+            req.error = DeadlineExceededError(
+                "deadline budget exhausted in queue")
             req.count_timeout_once(self.metrics)
+            self.metrics.inc("shed_deadline")
             req.event.set()
             return True
         return False
@@ -272,7 +352,11 @@ class MicroBatcher:
                     r.error = e
                     r.event.set()
                 return
-        self.metrics.device_ms.record((time.perf_counter() - t0) * 1e3)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        self.metrics.device_ms.record(dt_ms)
+        # feed the adaptive-admission EWMA (scheduler thread only)
+        self._device_ewma_ms = dt_ms if not self._device_ewma_ms else \
+            0.8 * self._device_ewma_ms + 0.2 * dt_ms
         lo = 0
         for r in batch:
             r.result = _slice(res, lo, lo + r.n)

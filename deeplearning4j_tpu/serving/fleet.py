@@ -35,6 +35,18 @@ Layers::
   response the same request is re-issued to a second replica and the
   first response wins, under a token-bucket retry budget so hedges
   can never amplify an overload (`The Tail at Scale`, PAPERS.md).
+- Backpressure + circuit breaking (ISSUE 9): a 503 shed answer's
+  ``Retry-After`` becomes a per-replica routing COOLDOWN (capped at
+  ``cooldown_cap_s``) so the router stops hammering a replica that
+  just said "back off" — instead of routing the very next request
+  straight back at it. ``breaker_threshold`` CONSECUTIVE sheds trip a
+  circuit breaker distinct from health ejection (the replica is alive
+  and healthy, just overloaded): the breaker holds ``open`` for
+  ``breaker_open_s``, then goes ``half_open`` and admits one probe
+  request per window — a non-503 answer closes it, another shed
+  re-opens it. Counters: ``sheds``, ``cooldowns``, ``breaker_trips``,
+  ``breaker_probes``, ``breaker_recoveries``, plus a ``goodput``
+  ratio (responses/requests) in the snapshot.
 - :meth:`ReplicaFleet.rolling_restart` — the fleet-wide extension of
   PR 4's single-replica zero-loss drain: one replica at a time is
   cordoned (router steers new work away), drained (in-flight work
@@ -133,6 +145,11 @@ class FleetMetrics:
         self.readmissions = 0        # recoveries back into routing
         self.restarts = 0            # rolling-restart cycles completed
         self.streams = 0             # streaming generations proxied
+        self.sheds = 0               # 503 shed answers seen from replicas
+        self.cooldowns = 0           # Retry-After cooldowns activated
+        self.breaker_trips = 0       # closed -> open transitions
+        self.breaker_probes = 0      # half-open probe requests admitted
+        self.breaker_recoveries = 0  # open/half-open -> closed
         self.latency_ms = Reservoir(latency_window)
 
     def inc(self, field: str, n: int = 1):
@@ -156,6 +173,16 @@ class FleetMetrics:
             "readmissions": self.readmissions,
             "restarts": self.restarts,
             "streams": self.streams,
+            "sheds": self.sheds,
+            "cooldowns": self.cooldowns,
+            "breaker_trips": self.breaker_trips,
+            "breaker_probes": self.breaker_probes,
+            "breaker_recoveries": self.breaker_recoveries,
+            # share of accepted requests that came back 2xx — the
+            # overload-robustness headline: under graceful shedding
+            # this stays near 1.0 for ADMITTED work even at 2x load
+            "goodput": round(self.responses / self.requests, 4)
+            if self.requests else 1.0,
             "latency_ms": {k: round(v, 3) for k, v in
                            self.latency_ms.snapshot().items()},
         }
@@ -190,14 +217,42 @@ class Replica:
         self.routed = 0           # total dispatches sent here
         self.summary: Dict = {}   # last-polled /stats summary block
         self.last_poll: Optional[float] = None
+        # backpressure state (distinct from health: the replica is
+        # alive, it just told us to back off)
+        self.cooldown_until = 0.0    # Retry-After routing exclusion
+        self.consecutive_sheds = 0   # 503 streak -> trips the breaker
+        self.breaker_tripped = False
+        self.breaker_until = 0.0     # open until; half-open after
+        self.probe_at = 0.0          # last half-open probe launch
 
     @property
     def address(self) -> str:
         return f"{self.host}:{self.port}"
 
     def eligible(self) -> bool:
-        """May receive NEW work right now."""
+        """May receive NEW work right now (health/membership view —
+        backpressure is layered on top, see
+        :meth:`ReplicaFleet.routable`)."""
         return self.admitted and not self.cordoned and self.ready
+
+    def breaker_state(self, now: Optional[float] = None) -> str:
+        """``closed`` | ``open`` | ``half_open``."""
+        if not self.breaker_tripped:
+            return "closed"
+        now = time.monotonic() if now is None else now
+        return "open" if now < self.breaker_until else "half_open"
+
+    def reset_backpressure(self):
+        """Forget cooldown/breaker state — a rebuilt replica (rolling
+        restart) starts with a clean slate; its old overload history
+        belongs to the process that no longer exists. Caller must NOT
+        hold ``_lock``."""
+        with self._lock:
+            self.cooldown_until = 0.0
+            self.consecutive_sheds = 0
+            self.breaker_tripped = False
+            self.breaker_until = 0.0
+            self.probe_at = 0.0
 
     def score(self) -> int:
         """Occupancy score the router minimizes: the router's own
@@ -218,6 +273,7 @@ class Replica:
             self.in_flight -= 1
 
     def snapshot(self) -> Dict:
+        now = time.monotonic()
         with self._lock:
             return {
                 "id": self.id,
@@ -230,6 +286,9 @@ class Replica:
                 "in_flight": self.in_flight,
                 "requests_routed": self.routed,
                 "score": self.in_flight + int(self.summary.get("load", 0)),
+                "breaker": self.breaker_state(now),
+                "cooling": now < self.cooldown_until,
+                "consecutive_sheds": self.consecutive_sheds,
                 "summary": self.summary,
             }
 
@@ -242,13 +301,24 @@ class ReplicaFleet:
     deterministic tests do). ``eject_after`` consecutive failed polls
     (connection failure or a wedged ``/healthz``) eject a replica from
     routing; the first clean poll re-admits it.
+
+    Backpressure knobs: ``breaker_threshold`` consecutive 503 sheds
+    trip a replica's circuit breaker; it holds open ``breaker_open_s``
+    then admits one half-open probe per window. A shed's Retry-After
+    is honored as a routing cooldown, capped at ``cooldown_cap_s`` so
+    a replica advertising a huge backoff cannot exile itself.
     """
 
     def __init__(self, poll_interval_s: Optional[float] = 0.25,
-                 eject_after: int = 2, probe_timeout_s: float = 5.0):
+                 eject_after: int = 2, probe_timeout_s: float = 5.0,
+                 breaker_threshold: int = 3, breaker_open_s: float = 1.0,
+                 cooldown_cap_s: float = 5.0):
         self.metrics = FleetMetrics()
         self.eject_after = int(eject_after)
         self.probe_timeout_s = float(probe_timeout_s)
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_open_s = float(breaker_open_s)
+        self.cooldown_cap_s = float(cooldown_cap_s)
         self.poll_interval_s = poll_interval_s
         self._lock = threading.Lock()
         self._replicas: List[Replica] = []
@@ -382,6 +452,84 @@ class ReplicaFleet:
         if eject:
             self.metrics.inc("ejections")
 
+    # -- backpressure / circuit breaking -------------------------------
+    def routable(self, rep: Replica,
+                 now: Optional[float] = None) -> bool:
+        """May this replica receive a request RIGHT NOW? Eligibility
+        (health/cordon/ready) AND not in a Retry-After cooldown AND
+        the breaker admits traffic. ``half_open`` answers True only
+        while the current window's probe slot is unclaimed — the
+        router must then :meth:`claim_probe` before dispatching."""
+        if not rep.eligible():
+            return False
+        now = time.monotonic() if now is None else now
+        if now < rep.cooldown_until:
+            return False
+        state = rep.breaker_state(now)
+        if state == "open":
+            return False
+        if state == "half_open":
+            return now - rep.probe_at >= self.breaker_open_s
+        return True
+
+    def claim_probe(self, rep: Replica,
+                    now: Optional[float] = None) -> bool:
+        """Atomically claim the half-open probe slot (one probe per
+        ``breaker_open_s`` window); False means another thread beat
+        us to it and THIS request should pick elsewhere."""
+        now = time.monotonic() if now is None else now
+        with rep._lock:
+            if now - rep.probe_at < self.breaker_open_s:
+                return False
+            rep.probe_at = now
+        self.metrics.inc("breaker_probes")
+        return True
+
+    def note_shed(self, rep: Replica,
+                  retry_after_s: Optional[float] = None):
+        """A 503 shed came back from this replica: honor Retry-After
+        as a routing cooldown (capped) and count one strike toward
+        the breaker. A shed while the breaker is already tripped —
+        a failed half-open probe — re-opens the window."""
+        now = time.monotonic()
+        try:
+            cooldown = float(retry_after_s)
+        except (TypeError, ValueError):
+            cooldown = 1.0
+        cooldown = min(max(cooldown, 0.0), self.cooldown_cap_s)
+        tripped = False
+        with rep._lock:
+            was_cooling = now < rep.cooldown_until
+            rep.cooldown_until = max(rep.cooldown_until, now + cooldown)
+            rep.consecutive_sheds += 1
+            if rep.breaker_tripped:
+                rep.breaker_until = now + self.breaker_open_s
+            elif rep.consecutive_sheds >= self.breaker_threshold:
+                rep.breaker_tripped = True
+                rep.breaker_until = now + self.breaker_open_s
+                tripped = True
+        self.metrics.inc("sheds")
+        if not was_cooling:
+            self.metrics.inc("cooldowns")
+        if tripped:
+            self.metrics.inc("breaker_trips")
+
+    def note_ok(self, rep: Replica):
+        """A non-503 answer from this replica: the shed streak is
+        broken; a tripped breaker closes (successful half-open
+        probe); any residual cooldown is lifted — the replica is
+        demonstrably serving again."""
+        recovered = False
+        with rep._lock:
+            rep.consecutive_sheds = 0
+            rep.cooldown_until = 0.0
+            if rep.breaker_tripped:
+                rep.breaker_tripped = False
+                rep.breaker_until = 0.0
+                recovered = True
+        if recovered:
+            self.metrics.inc("breaker_recoveries")
+
     # -- rolling restart ----------------------------------------------
     def rolling_restart(self, drain_timeout_s: float = 30.0,
                         ready_timeout_s: float = 120.0) -> bool:
@@ -418,6 +566,9 @@ class ReplicaFleet:
                     rep.port = int(new.port)
                     rep.summary = {}
                 ready = self._wait_ready(rep, ready_timeout_s)
+                # the rebuilt process never shed anything: start it
+                # with a clean cooldown/breaker slate
+                rep.reset_backpressure()
                 with rep._lock:
                     rep.fails = 0
                     # a replacement that never answered /readyz within
@@ -465,6 +616,11 @@ class ReplicaFleet:
         s["replicas"] = reps
         s["eligible_replicas"] = sum(1 for r in reps if r["eligible"])
         s["fleet_load"] = sum(r["score"] for r in reps)
+        # replica-side shed totals (from the polled summaries) — the
+        # fleet-wide view of admission-control pressure, including
+        # sheds served to clients that bypassed this router
+        s["fleet_shed"] = sum(int(r["summary"].get("shed", 0) or 0)
+                              for r in reps)
         return s
 
     def stop(self, stop_replicas: bool = False):
@@ -633,8 +789,9 @@ class FleetRouter:
             # pooled keep-alives to addresses that no longer exist
             self._live_addrs = addrs
             self._pool.prune(addrs)
+        now = time.monotonic()
         cands = [r for r in reps
-                 if r.eligible() and r.id not in excluded]
+                 if r.id not in excluded and self.fleet.routable(r, now)]
         if not cands:
             return None
         with self._rr_lock:
@@ -645,7 +802,14 @@ class FleetRouter:
         n = len(cands)
         best = min(range(n),
                    key=lambda i: (cands[i].score(), (i + base) % n))
-        return cands[best]
+        rep = cands[best]
+        if rep.breaker_state(now) == "half_open" \
+                and not self.fleet.claim_probe(rep, now):
+            # another thread took this window's probe slot; this
+            # request must look elsewhere (bounded: each recursion
+            # excludes one replica)
+            return self._pick(excluded | {rep.id})
+        return rep
 
     # -- hedge budget --------------------------------------------------
     def _take_budget(self) -> bool:
@@ -662,18 +826,21 @@ class FleetRouter:
                                self._budget + self.hedge_budget_ratio)
 
     # -- transport -----------------------------------------------------
-    def _roundtrip(self, rep: Replica, path: str, body: bytes):
+    def _roundtrip(self, rep: Replica, path: str, body: bytes,
+                   headers: Dict = None):
         """One POST to one replica -> (status, headers, data). Retries
         exactly once on a stale keep-alive connection; raises a
         retryable exception when the replica is genuinely
         unreachable."""
+        send = (_JSON_HEADERS if not headers
+                else {**_JSON_HEADERS, **headers})
         for fresh in (False, True):
             conn = (http.client.HTTPConnection(rep.host, rep.port,
                                                timeout=self.timeout_s)
                     if fresh else self._pool.take(rep.host, rep.port))
             try:
                 conn.request("POST", path, body=body,
-                             headers=_JSON_HEADERS)
+                             headers=send)
                 resp = conn.getresponse()
                 data = resp.read()
             except _RETRYABLE_EXC as e:
@@ -687,11 +854,12 @@ class FleetRouter:
             return resp.status, dict(resp.getheaders()), data
         raise ConnectionError("unreachable")   # not reached
 
-    def _tracked(self, rep: Replica, path: str, body: bytes):
+    def _tracked(self, rep: Replica, path: str, body: bytes,
+                 headers: Dict = None):
         rep.begin()
         self.metrics.inc("routed")
         try:
-            return self._roundtrip(rep, path, body)
+            return self._roundtrip(rep, path, body, headers)
         finally:
             rep.end()
 
@@ -700,6 +868,15 @@ class FleetRouter:
         """A result worth trying another replica for: transport
         failure, or an explicit shed/draining 503."""
         return isinstance(out, Exception) or out[0] == 503
+
+    def _note(self, rep: Replica, status: int, hdrs: Dict):
+        """Feed the backpressure loop from one replica answer: a 503
+        becomes a Retry-After cooldown + breaker strike; anything
+        else breaks the shed streak (and closes a tripped breaker)."""
+        if status == 503:
+            self.fleet.note_shed(rep, hdrs.get("Retry-After"))
+        else:
+            self.fleet.note_ok(rep)
 
     # -- dispatch ------------------------------------------------------
     def post(self, path: str, payload) -> Tuple[int, Dict]:
@@ -715,9 +892,12 @@ class FleetRouter:
             body = {"error": "unparseable replica response"}
         return status, body
 
-    def post_raw(self, path: str, body: bytes):
+    def post_raw(self, path: str, body: bytes, headers: Dict = None):
         """Bytes-in/bytes-out dispatch (the HTTP front-end's path):
-        returns (status, response headers, response bytes)."""
+        returns (status, response headers, response bytes).
+        ``headers`` are forwarded to the replica on top of the JSON
+        content type — the front-end uses this so request-scoped
+        classification (``X-Priority``) survives the proxy hop."""
         self.metrics.inc("requests")
         hedge = (self.hedge_after_ms is not None
                  and not path.rstrip("/").endswith("/generate")
@@ -734,9 +914,10 @@ class FleetRouter:
             attempts += 1
             if attempts > 1:
                 self.metrics.inc("retries")
-            out = (self._attempt_hedged(rep, path, body, excluded)
+            out = (self._attempt_hedged(rep, path, body, excluded,
+                                        headers)
                    if hedge else self._attempt_plain(rep, path, body,
-                                                     excluded))
+                                                     excluded, headers))
             if self._retryable(out):
                 last = out
                 continue
@@ -763,10 +944,10 @@ class FleetRouter:
             {"error": "no replica available"}).encode()
 
     def _attempt_plain(self, rep: Replica, path: str, body: bytes,
-                       excluded: Set[str]):
+                       excluded: Set[str], headers: Dict = None):
         """Single-arm dispatch in the calling thread."""
         try:
-            out = self._tracked(rep, path, body)
+            out = self._tracked(rep, path, body, headers)
         except _RETRYABLE_EXC as e:
             if isinstance(e, TimeoutError):
                 # the replica is still working — re-dispatching would
@@ -775,12 +956,13 @@ class FleetRouter:
             self.fleet.note_failure(rep)
             excluded.add(rep.id)
             return e
+        self._note(rep, out[0], out[1])
         if out[0] == 503:
             excluded.add(rep.id)
         return out
 
     def _attempt_hedged(self, rep: Replica, path: str, body: bytes,
-                        excluded: Set[str]):
+                        excluded: Set[str], headers: Dict = None):
         """Primary dispatch with an optional hedge arm: wait
         ``hedge_after_ms`` for the primary; if silent, re-issue to the
         next-best replica (budget permitting) and take whichever
@@ -790,7 +972,8 @@ class FleetRouter:
 
         def run(r: Replica):
             try:
-                out = self._tracked(r, path, body)
+                out = self._tracked(r, path, body, headers)
+                self._note(r, out[0], out[1])
             except _RETRYABLE_EXC as e:
                 if isinstance(e, TimeoutError):
                     out = _timeout_response(self.timeout_s)
@@ -833,7 +1016,7 @@ class FleetRouter:
         return out
 
     # -- streaming -----------------------------------------------------
-    def open_stream(self, path: str, body: bytes):
+    def open_stream(self, path: str, body: bytes, headers: Dict = None):
         """Route a streaming generation: returns
         ``("stream", replica, conn, resp)`` with the response open
         (the caller MUST call ``conn.close()`` + ``replica.end()``
@@ -859,7 +1042,8 @@ class FleetRouter:
                                               timeout=self.timeout_s)
             try:
                 conn.request("POST", path, body=body,
-                             headers=_JSON_HEADERS)
+                             headers=(_JSON_HEADERS if not headers
+                                      else {**_JSON_HEADERS, **headers}))
                 resp = conn.getresponse()
             except _RETRYABLE_EXC as e:
                 conn.close()
@@ -876,9 +1060,11 @@ class FleetRouter:
                 data = resp.read()
                 conn.close()
                 rep.end()
+                hdrs = dict(resp.getheaders())
+                self._note(rep, resp.status, hdrs)
                 if resp.status == 503:
                     excluded.add(rep.id)
-                    last = (resp.status, dict(resp.getheaders()), data)
+                    last = (resp.status, hdrs, data)
                     continue
                 if 400 <= resp.status < 500:
                     self.metrics.inc("client_errors")
@@ -886,6 +1072,7 @@ class FleetRouter:
                     self.metrics.inc("server_errors")
                 return ("response", resp.status,
                         dict(resp.getheaders()), data)
+            self.fleet.note_ok(rep)
             self.metrics.inc("streams")
             return ("stream", rep, conn, resp)
         self.metrics.inc("requests_lost")
@@ -1017,6 +1204,14 @@ class FleetRouter:
                     self.close_connection = True
                     return
                 raw = self.rfile.read(n)
+                # X-Priority carries the request's shed class — the
+                # one client header with routing semantics; it must
+                # survive the proxy hop or every fronted request
+                # silently becomes interactive
+                fwd = {}
+                prio = self.headers.get("X-Priority")
+                if prio is not None:
+                    fwd["X-Priority"] = prio
                 streaming = False
                 # only generate routes can stream — don't pay a json
                 # parse of (possibly huge) predict bodies just to
@@ -1030,16 +1225,17 @@ class FleetRouter:
                     except ValueError:
                         pass   # replica answers 400; just forward
                 if streaming:
-                    self._proxy_stream(raw)
+                    self._proxy_stream(raw, fwd)
                     return
-                status, hdrs, data = router.post_raw(self.path, raw)
+                status, hdrs, data = router.post_raw(self.path, raw,
+                                                     fwd)
                 extra = {}
                 if "Retry-After" in hdrs:
                     extra["Retry-After"] = hdrs["Retry-After"]
                 self._json(data, status, headers=extra)
 
-            def _proxy_stream(self, raw: bytes):
-                opened = router.open_stream(self.path, raw)
+            def _proxy_stream(self, raw: bytes, fwd: Dict = None):
+                opened = router.open_stream(self.path, raw, fwd)
                 if opened[0] == "response":
                     _, status, hdrs, data = opened
                     extra = {}

@@ -26,6 +26,17 @@ Overload semantics match the micro-batcher: bounded queue sheds
 (:class:`~.batcher.QueueFullError` → 503), per-request deadlines
 (:class:`~.batcher.DeadlineExceededError` → 504) are enforced both in
 the queue and mid-generation.
+
+Admission control (docs/serving.md "Overload and admission control"):
+requests carry a priority class (``interactive`` default, ``batch``
+shed first — batch work only gets the front ``batch_queue_fraction``
+of the queue), and admission is cost-aware: the engine keeps measured
+EWMAs of per-token prefill time and per-step decode time, rejects a
+request up front when its estimated prefill + ``max_tokens`` decode
+cost cannot fit its deadline budget (504 — no replica can serve it),
+and sheds a queued request at dequeue-admission once its queue wait
+has eaten the budget needed to produce even a first token — zero
+prefill/decode steps are ever spent on a request that cannot finish.
 """
 from __future__ import annotations
 
@@ -40,7 +51,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..profiler import OpProfiler
-from .batcher import DeadlineExceededError, DrainingError, QueueFullError
+from .batcher import (PRIORITIES, DeadlineExceededError, DrainingError,
+                      QueueFullError)
 from .engine import ClientError, ServingError, compile_memoized
 from .faults import (CorruptedStateFault, PoisonRequestError,
                      TransientFault, poll_until_idle)
@@ -131,13 +143,14 @@ def _recovery_seq(req: "_GenRequest") -> np.ndarray:
 
 class _GenRequest:
     __slots__ = ("prompt", "max_tokens", "temperature", "top_k", "seed",
-                 "eos_id", "deadline", "event", "tokens", "error",
-                 "finish_reason", "stream_q", "t_submit", "t_first",
-                 "t_last", "abandoned", "recoveries", "_lock",
+                 "eos_id", "deadline", "priority", "event", "tokens",
+                 "error", "finish_reason", "stream_q", "t_submit",
+                 "t_first", "t_last", "abandoned", "recoveries", "_lock",
                  "_timeout_counted")
 
     def __init__(self, prompt, max_tokens, temperature, top_k, seed,
-                 eos_id, deadline, stream: bool):
+                 eos_id, deadline, stream: bool,
+                 priority: str = "interactive"):
         self.prompt = prompt
         self.max_tokens = max_tokens
         self.temperature = temperature
@@ -145,6 +158,7 @@ class _GenRequest:
         self.seed = seed
         self.eos_id = eos_id
         self.deadline = deadline
+        self.priority = priority
         self.event = threading.Event()
         self.tokens: List[int] = []
         self.error: Optional[BaseException] = None
@@ -315,7 +329,8 @@ class GenerationEngine:
                  retry_backoff_ms: float = 1.0,
                  retry_backoff_max_ms: float = 50.0,
                  max_recoveries_per_request: int = 3,
-                 stall_timeout_s: float = 30.0):
+                 stall_timeout_s: float = 30.0,
+                 batch_queue_fraction: float = 0.5):
         if getattr(model, "_params", None) is None:
             model.init()
         self.model = model
@@ -429,6 +444,16 @@ class GenerationEngine:
         self._donate = (1, 2)
         self._queue: "queue.Queue[_GenRequest]" = queue.Queue(
             maxsize=int(max_queue))
+        # priority shedding: batch-class work only gets the front
+        # fraction of the queue; interactive gets all of it
+        self.batch_queue_fraction = float(batch_queue_fraction)
+        self._batch_queue_limit = max(
+            1, int(self.batch_queue_fraction * int(max_queue)))
+        # cost-aware admission: measured EWMAs (per PROMPT TOKEN of
+        # prefill, per STEP of decode) — 0.0 until the first call
+        # lands, so a cold engine admits everything
+        self._prefill_ms_per_tok = 0.0
+        self._decode_ewma_ms = 0.0
         # -- fault tolerance (serving/faults.py) --------------------
         # seams fire only when an injector is configured; the
         # supervised loop always runs (real device faults need no
@@ -658,7 +683,12 @@ class GenerationEngine:
 
     # -- client side ---------------------------------------------------
     def _make_request(self, prompt, max_tokens, temperature, top_k, seed,
-                      eos_id, timeout_ms, stream) -> _GenRequest:
+                      eos_id, timeout_ms, stream,
+                      priority="interactive") -> _GenRequest:
+        if priority not in PRIORITIES:
+            raise ClientError(
+                f"unknown priority {priority!r}; expected one of "
+                f"{PRIORITIES}")
         if self._draining:
             # checked before _running: a drained replica answers 503 +
             # Retry-After (retry elsewhere), not 500, for its lifetime
@@ -720,15 +750,59 @@ class GenerationEngine:
             eos_id = getattr(self.model, "eos_id", None)
         timeout = (self.default_timeout_ms if timeout_ms is None
                    else float(timeout_ms)) / 1000.0
+        est_ms = self._est_cost_ms(len(prompt), max_tokens)
+        if est_ms > timeout * 1e3:
+            # cost-aware admission: the measured per-token prefill +
+            # per-step decode EWMAs say this request CANNOT finish
+            # inside its own deadline budget (worst case: the full
+            # max_tokens) — reject before any device work, 504 (no
+            # replica can serve it; lower max_tokens or raise the
+            # timeout)
+            self.metrics.inc("shed_deadline")
+            self.metrics.inc("timeouts")
+            raise DeadlineExceededError(
+                f"estimated cost {est_ms:.0f} ms ({len(prompt)} prompt "
+                f"tokens + {max_tokens} max_tokens at measured rates) "
+                f"exceeds the {timeout * 1e3:.0f} ms deadline budget")
         return _GenRequest(prompt, max_tokens, float(temperature),
                            int(top_k), int(seed) & 0xFFFFFFFF, eos_id,
-                           time.perf_counter() + timeout, stream)
+                           time.perf_counter() + timeout, stream,
+                           priority=priority)
+
+    def _est_cost_ms(self, prompt_len: int, max_tokens: int) -> float:
+        """Worst-case service estimate from measured rates: prefill of
+        the whole prompt plus ``max_tokens`` decode steps. 0.0 on a
+        cold engine (no data, no rejection)."""
+        return (prompt_len * self._prefill_ms_per_tok
+                + max_tokens * self._decode_ewma_ms)
+
+    def _deadline_blown(self, req: _GenRequest,
+                        now: Optional[float] = None) -> bool:
+        """Dequeue-admission deadline budget: not merely 'past the
+        deadline' but 'the time left cannot cover even a first token'
+        (prefill of the pending prefix + one decode step, at measured
+        rates) — in which case prefilling would burn device steps on
+        rows nobody will read."""
+        now = time.perf_counter() if now is None else now
+        min_work_ms = (len(req.prompt) * self._prefill_ms_per_tok
+                       + self._decode_ewma_ms)
+        return now > req.deadline - min_work_ms / 1e3
 
     def _enqueue(self, req: _GenRequest):
         if self._draining:
             self.metrics.inc("shed")
             raise DrainingError("generation engine is draining; retry "
                                 "against another replica")
+        if req.priority == "batch" and \
+                self._queue.qsize() >= self._batch_queue_limit:
+            # shed order: batch first — interactive may still use the
+            # remaining queue, so its p99 TTFT holds while batch sheds
+            self.metrics.inc("shed")
+            self.metrics.inc("shed_batch")
+            raise QueueFullError(
+                f"generation queue at the batch-priority limit "
+                f"({self._batch_queue_limit}/{self.metrics.queue_max});"
+                f" shedding batch-class work first")
         try:
             self._queue.put_nowait(req)
         except queue.Full:
@@ -745,13 +819,17 @@ class GenerationEngine:
     def generate(self, prompt, max_tokens: int = 32,
                  temperature: float = 0.0, top_k: int = 0, seed: int = 0,
                  eos_id: Optional[int] = None,
-                 timeout_ms: Optional[float] = None) -> Dict[str, Any]:
+                 timeout_ms: Optional[float] = None,
+                 priority: str = "interactive") -> Dict[str, Any]:
         """Blocking generate: returns ``{"tokens", "prompt_tokens",
         "finish_reason"}``. Raises :class:`~.engine.ClientError` /
         :class:`~.batcher.QueueFullError` /
-        :class:`~.batcher.DeadlineExceededError`."""
+        :class:`~.batcher.DeadlineExceededError`. ``priority`` is
+        ``"interactive"`` (default) or ``"batch"`` (shed first under
+        pressure)."""
         req = self._submit(prompt, max_tokens, temperature, top_k,
-                           seed, eos_id, timeout_ms, stream=False)
+                           seed, eos_id, timeout_ms, stream=False,
+                           priority=priority)
         budget = req.deadline - time.perf_counter()
         if not req.event.wait(budget + 1.0):  # grace for the device call
             req.abandoned = True
@@ -766,14 +844,16 @@ class GenerationEngine:
     def stream(self, prompt, max_tokens: int = 32,
                temperature: float = 0.0, top_k: int = 0, seed: int = 0,
                eos_id: Optional[int] = None,
-               timeout_ms: Optional[float] = None) -> Iterator[Dict]:
+               timeout_ms: Optional[float] = None,
+               priority: str = "interactive") -> Iterator[Dict]:
         """Streaming generate: yields ``{"token", "index"}`` per token
         as the scheduler produces it, then ``{"done": True,
         "finish_reason", ...}``. Admission (validation, queue bounds)
         happens HERE — synchronously — so callers can still map those
         to status codes; later failures raise from the iterator."""
         req = self._submit(prompt, max_tokens, temperature, top_k,
-                           seed, eos_id, timeout_ms, stream=True)
+                           seed, eos_id, timeout_ms, stream=True,
+                           priority=priority)
         return _TokenStream(self, req)
 
     def _submit(self, *args, **kw) -> _GenRequest:
@@ -913,9 +993,12 @@ class GenerationEngine:
                 self.metrics.queue_depth = self._queue.qsize()
             if req.abandoned:
                 continue
-            if time.perf_counter() > req.deadline:
+            if self._deadline_blown(req):
+                # deadline budget gone while queued: shed at dequeue-
+                # admission — zero prefill/decode steps spent on it
+                self.metrics.inc("shed_deadline")
                 self._fail(req, DeadlineExceededError(
-                    "expired in the generation queue"))
+                    "deadline budget exhausted in the generation queue"))
                 continue
             try:
                 self._prefill(req)
@@ -970,9 +1053,12 @@ class GenerationEngine:
                 self.metrics.queue_depth = self._queue.qsize()
             if req.abandoned:
                 continue
-            if time.perf_counter() > req.deadline:
+            if self._deadline_blown(req):
+                # deadline budget gone while queued: shed at dequeue-
+                # admission — zero prefill/decode steps spent on it
+                self.metrics.inc("shed_deadline")
                 self._fail(req, DeadlineExceededError(
-                    "expired in the generation queue"))
+                    "deadline budget exhausted in the generation queue"))
                 continue
             seq = _recovery_seq(req)
             L = len(seq)
@@ -1046,6 +1132,7 @@ class GenerationEngine:
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :clen] = st.seq[p0:p0 + clen]
         table = st.table.padded(st.tbl_bucket)
+        c0 = self.metrics.compiles
         t0 = time.perf_counter()
         try:
             exe = self._get_chunk_exe(bucket, st.tbl_bucket)
@@ -1073,7 +1160,12 @@ class GenerationEngine:
             self._fail(req, e)
             raise CorruptedStateFault(
                 f"prefill chunk device call failed: {e!r}")
-        self.metrics.prefill_ms.record((time.perf_counter() - t0) * 1e3)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        self.metrics.prefill_ms.record(dt_ms)
+        if self.metrics.compiles == c0:
+            # a sample that paid a lazy compile would poison the
+            # cost-admission estimate for thousands of requests
+            self._note_prefill_cost(dt_ms, bucket)
         self.metrics.inc("prefill_chunks")
         self.metrics.prompt_bucket_hist.record(bucket)
         if not ok:
@@ -1209,6 +1301,7 @@ class GenerationEngine:
         bucket = next(b for b in self.prompt_buckets if b >= L)
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :L] = seq
+        c0 = self.metrics.compiles
         t0 = time.perf_counter()
         try:
             exe = self._get_prefill_exe(bucket)
@@ -1233,7 +1326,12 @@ class GenerationEngine:
             self._fail(req, e)
             raise CorruptedStateFault(
                 f"prefill device call failed: {e!r}")
-        self.metrics.prefill_ms.record((time.perf_counter() - t0) * 1e3)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        self.metrics.prefill_ms.record(dt_ms)
+        if self.metrics.compiles == c0:
+            # a sample that paid a lazy compile would poison the
+            # cost-admission estimate for thousands of requests
+            self._note_prefill_cost(dt_ms, bucket)
         self.metrics.inc("prefills")
         self.metrics.prompt_bucket_hist.record(bucket)
         if not ok:
@@ -1265,6 +1363,15 @@ class GenerationEngine:
         self._emit(req, first, time.perf_counter())
         self._check_done(slot, req, first)
 
+    def _note_prefill_cost(self, dt_ms: float, bucket: int):
+        """Feed the per-PROMPT-TOKEN prefill EWMA (scheduler thread
+        only). Normalized by the padded bucket width — that is what
+        the device call actually computed over."""
+        per_tok = dt_ms / max(bucket, 1)
+        self._prefill_ms_per_tok = per_tok \
+            if not self._prefill_ms_per_tok else \
+            0.8 * self._prefill_ms_per_tok + 0.2 * per_tok
+
     def _ready_slots(self) -> List[int]:
         """Slots in the DECODE phase. On the paged backend a slot is
         claimed at admission but only decode-ready after its final
@@ -1281,6 +1388,7 @@ class GenerationEngine:
         # injection seam: BEFORE the device call (and its donation), so
         # a TransientFault here is retryable with all state intact
         self._hit("device_step")
+        c0 = self.metrics.compiles
         t0 = time.perf_counter()
         with self._profiler.record("generation.decode_step"):
             if self.cache_backend == "paged":
@@ -1297,7 +1405,14 @@ class GenerationEngine:
             nxt = np.asarray(nxt)  # device sync: the step really ran
             ok = np.asarray(okd)
         now = time.perf_counter()
-        self.metrics.decode_step_ms.record((now - t0) * 1e3)
+        dt_ms = (now - t0) * 1e3
+        self.metrics.decode_step_ms.record(dt_ms)
+        # feed the cost-aware-admission EWMA (scheduler thread only) —
+        # but never from a sample that paid a lazy compile, which
+        # would poison the estimate for thousands of requests
+        if self.metrics.compiles == c0:
+            self._decode_ewma_ms = dt_ms if not self._decode_ewma_ms \
+                else 0.8 * self._decode_ewma_ms + 0.2 * dt_ms
         self.metrics.inc("decode_steps")
         self.metrics.occupancy_hist.record(len(active))
         tokens = nxt.tolist()

@@ -28,6 +28,10 @@ class ServingMetrics:
         self.client_errors = 0     # 4xx-class failures
         self.server_errors = 0     # 5xx-class failures
         self.shed = 0              # rejected, queue full (503)
+        self.shed_batch = 0        # batch-priority work shed first (503)
+        self.shed_deadline = 0     # deadline budget blown before the
+        #                            device call: rejected at dequeue-
+        #                            admission, zero device work spent
         self.timeouts = 0          # request deadline exceeded (504)
         # fault-tolerance counters (serving/faults.py)
         self.retries = 0           # transient step failures retried
@@ -66,6 +70,8 @@ class ServingMetrics:
             "client_errors": self.client_errors,
             "server_errors": self.server_errors,
             "shed": self.shed,
+            "shed_batch": self.shed_batch,
+            "shed_deadline": self.shed_deadline,
             "timeouts": self.timeouts,
             "faults": {
                 "retries": self.retries,
@@ -108,6 +114,10 @@ class GenerationMetrics:
         self.client_errors = 0     # 4xx-class failures
         self.server_errors = 0     # 5xx-class failures
         self.shed = 0              # rejected, queue full (503)
+        self.shed_batch = 0        # batch-priority work shed first (503)
+        self.shed_deadline = 0     # deadline budget blown before any
+        #                            prefill/decode step: rejected at
+        #                            admission, zero device work spent
         self.timeouts = 0          # deadline exceeded (504)
         # fault-tolerance counters (serving/faults.py): transient step
         # retries, recompute-recoveries (every in-flight request
@@ -187,6 +197,8 @@ class GenerationMetrics:
             "client_errors": self.client_errors,
             "server_errors": self.server_errors,
             "shed": self.shed,
+            "shed_batch": self.shed_batch,
+            "shed_deadline": self.shed_deadline,
             "timeouts": self.timeouts,
             "faults": {
                 "retries": self.retries,

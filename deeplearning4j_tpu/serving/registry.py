@@ -52,10 +52,12 @@ class ServedModel:
         return self.engine.metrics
 
     def predict(self, inputs, outputs: Optional[Sequence[str]] = None,
-                timeout_ms: Optional[float] = None):
+                timeout_ms: Optional[float] = None,
+                priority: str = "interactive"):
         if self.batcher is not None:
             return self.batcher.submit(inputs, outputs,
-                                       timeout_ms=timeout_ms)
+                                       timeout_ms=timeout_ms,
+                                       priority=priority)
         # direct path (batching=False): synchronous, so timeout_ms has
         # no queue to bound — but request metrics must still flow,
         # including the live-occupancy gauge the /stats summary feeds
@@ -118,7 +120,10 @@ class ServedModel:
                 "occupancy": round(active / cap, 4) if cap else 0.0,
                 "draining": bool(self.batcher is not None
                                  and self.batcher.draining),
-                "load": m.queue_depth + active}
+                "load": m.queue_depth + active,
+                # shed total, so a fleet poller can aggregate per-
+                # replica overload without parsing the full /stats
+                "shed": m.shed}
 
 
 class ServedGenerator:
@@ -189,7 +194,10 @@ class ServedGenerator:
                 "capacity": cap,
                 "occupancy": round(active / cap, 4) if cap else 0.0,
                 "draining": self.engine.draining,
-                "load": m.queue_depth + active}
+                "load": m.queue_depth + active,
+                # shed total, so a fleet poller can aggregate per-
+                # replica overload without parsing the full /stats
+                "shed": m.shed}
 
 
 class ModelRegistry:

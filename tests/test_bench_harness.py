@@ -302,3 +302,83 @@ def test_training_elastic_metric_is_gated():
     r2 = cbr.compare(rec2, bad, 0.2)
     assert [e["metric"] for e in r2["regressions"]] == \
         ["training_elastic_steps_per_sec"]
+
+
+def test_check_bench_regression_direction_registry():
+    """ISSUE 9 satellite: latency/shed/queue metrics gate in the
+    opposite direction — a fresh value ABOVE the recorded baseline is
+    the regression — via the LOWER_IS_BETTER direction registry."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "cbr5", os.path.join(ROOT, "tools", "check_bench_regression.py"))
+    cbr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cbr)
+    # every registered direction flip names a real gated metric
+    assert cbr.LOWER_IS_BETTER <= set(cbr.METRICS.values())
+    assert cbr.direction("serving_p99_ms") == "lower_is_better"
+    assert cbr.direction("headline_samples_per_sec") == "higher_is_better"
+    rec = {"value": 100.0,
+           "extra": {"serving": {"p99_ms": 100.0},
+                     "overload": {"overload_shed_rate": 0.2}}}
+    # +30% on a lower-is-better metric REGRESSES...
+    worse = {"value": 100.0,
+             "extra": {"serving": {"p99_ms": 130.0},
+                       "overload": {"overload_shed_rate": 0.2}}}
+    r = cbr.compare(rec, worse, 0.2)
+    assert [e["metric"] for e in r["regressions"]] == ["serving_p99_ms"]
+    assert r["regressions"][0]["direction"] == "lower_is_better"
+    # ...and -30% passes (it would regress a higher-is-better metric)
+    better = {"value": 100.0,
+              "extra": {"serving": {"p99_ms": 70.0},
+                        "overload": {"overload_shed_rate": 0.14}}}
+    r = cbr.compare(rec, better, 0.2)
+    assert not r["regressions"]
+    assert all(e["direction"] in ("lower_is_better", "higher_is_better")
+               for e in r["ok"])
+    # the --list audit surface carries the direction too
+    rows = {row["metric"]: row for row in cbr.list_metrics(rec)}
+    assert rows["serving_p99_ms"]["direction"] == "lower_is_better"
+    assert rows["overload_shed_rate"]["direction"] == "lower_is_better"
+    assert rows["overload_goodput_ratio"]["direction"] == \
+        "higher_is_better"
+
+
+def test_overload_scenario_harness_runs_on_cpu():
+    """ISSUE 9 tentpole at tiny scale (~1.2s legs): the open-loop
+    overload harness must measure capacity closed-loop, run the
+    Poisson diurnal + flat 2x-capacity legs, and emit every gated
+    field with the degradation invariants intact — bounded queue,
+    goodput above the documented floor, batch shed before
+    interactive."""
+    import bench
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable, "-c", bench.OVERLOAD_CODE,
+                        "1.2"],
+                       capture_output=True, text=True, timeout=600,
+                       env=env, cwd=ROOT)
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = [l for l in r.stdout.splitlines() if l.startswith("{")][-1]
+    res = json.loads(line)
+    assert res["capacity_rps"] > 0
+    assert res["overload_offered"] > 0
+    assert res["overload_offered_rps"] > res["capacity_rps"]  # open loop
+    assert 0.0 < res["overload_goodput_ratio"] <= 1.0
+    assert res["overload_goodput_floor"] == 0.3
+    # the graceful-degradation invariants the full run gates on
+    assert res["overload_queue_bounded"] is True
+    assert res["overload_goodput_ok"] is True
+    assert res["overload_interactive_slo_ok"] is True
+    # generation rode along: TTFT/ITL are first-class
+    assert res["overload_ttft_ms_p99"] > 0
+    assert res["overload_itl_ms_p99"] >= 0
+    # fleet-level backpressure counters surfaced
+    assert res["fleet_goodput"] > 0
+    assert res["fleet_shed_total"] >= 0
+    assert res["engine_shed_total"] >= 0
+    # structural: shed accounting splits by class and cause
+    for k in ("overload_batch_shed_rate", "overload_interactive_shed_rate",
+              "overload_shed_rate", "overload_deadline_sheds",
+              "engine_shed_batch_total", "engine_shed_deadline_total",
+              "fleet_cooldowns", "fleet_breaker_trips"):
+        assert k in res, k

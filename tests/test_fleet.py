@@ -611,3 +611,248 @@ class TestFleetHTTP:
         finally:
             router.stop()
             fleet.stop()
+
+
+class _FlipServer:
+    """Minimal stdlib HTTP replica that answers POST /predict with 503
+    + Retry-After while ``mode == "shed"`` and 200 once flipped —
+    the router-side backpressure loop's test double. ``hits`` counts
+    requests that actually REACHED the socket, so a cooldown test can
+    prove the router never contacted a cooling replica."""
+
+    def __init__(self, retry_after="0"):
+        import http.server
+        outer = self
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                self.rfile.read(int(self.headers.get("Content-Length",
+                                                     0) or 0))
+                outer.hits += 1
+                if outer.mode == "shed":
+                    body = json.dumps({"error": "shedding"}).encode()
+                    self.send_response(503)
+                    self.send_header("Retry-After", outer.retry_after)
+                else:
+                    body = json.dumps({"outputs": [[0.0]]}).encode()
+                    self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # noqa: N802 — stdlib name
+                pass
+
+        self.mode = "shed"
+        self.retry_after = retry_after
+        self.hits = 0
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class TestBackpressure:
+    """Satellite + tentpole (ISSUE 9): Retry-After honored as a
+    router-side eligibility cooldown (a shedding replica is NOT routed
+    straight back to), and the consecutive-shed circuit breaker —
+    distinct from health ejection — with its closed -> open ->
+    half_open -> closed lifecycle."""
+
+    def _rep(self, **fleet_kw):
+        fleet = ReplicaFleet(poll_interval_s=None, **fleet_kw)
+        # never contacted: these tests drive note_shed/note_ok directly
+        rep = fleet.add(host="127.0.0.1", port=9)
+        return fleet, rep
+
+    def test_breaker_trips_after_consecutive_sheds(self):
+        fleet, rep = self._rep(breaker_threshold=3, breaker_open_s=60.0)
+        try:
+            for i in range(2):
+                fleet.note_shed(rep, retry_after_s=0)
+                assert rep.breaker_state() == "closed"
+                assert fleet.routable(rep)          # strikes, not open
+            fleet.note_shed(rep, retry_after_s=0)   # third strike
+            assert rep.breaker_state() == "open"
+            assert not fleet.routable(rep)
+            assert fleet.metrics.breaker_trips == 1
+            assert fleet.metrics.sheds == 3
+            # open is a BREAKER state, not a health state: the replica
+            # is still admitted/eligible, just not routable
+            assert rep.eligible()
+            assert fleet.metrics.ejections == 0
+            snap = rep.snapshot()
+            assert snap["breaker"] == "open"
+            assert snap["consecutive_sheds"] == 3
+        finally:
+            fleet.stop()
+
+    def test_half_open_single_probe_then_recovery(self):
+        fleet, rep = self._rep(breaker_threshold=2, breaker_open_s=0.15)
+        try:
+            fleet.note_shed(rep, retry_after_s=0)
+            fleet.note_shed(rep, retry_after_s=0)
+            assert rep.breaker_state() == "open"
+            time.sleep(0.2)
+            assert rep.breaker_state() == "half_open"
+            assert fleet.routable(rep)              # probe slot open
+            assert fleet.claim_probe(rep)           # first probe wins
+            assert not fleet.claim_probe(rep)       # one per window
+            assert not fleet.routable(rep)          # slot now claimed
+            fleet.note_ok(rep)                      # probe succeeded
+            assert rep.breaker_state() == "closed"
+            assert fleet.routable(rep)
+            assert rep.consecutive_sheds == 0
+            assert fleet.metrics.breaker_probes == 1
+            assert fleet.metrics.breaker_recoveries == 1
+        finally:
+            fleet.stop()
+
+    def test_failed_probe_reopens_breaker(self):
+        fleet, rep = self._rep(breaker_threshold=2, breaker_open_s=0.15)
+        try:
+            fleet.note_shed(rep, retry_after_s=0)
+            fleet.note_shed(rep, retry_after_s=0)
+            time.sleep(0.2)
+            assert fleet.claim_probe(rep)
+            fleet.note_shed(rep, retry_after_s=0)   # probe answered 503
+            assert rep.breaker_state() == "open"    # window re-opened
+            assert not fleet.routable(rep)
+            assert fleet.metrics.breaker_trips == 1  # no double-count
+        finally:
+            fleet.stop()
+
+    def test_retry_after_cooldown_is_capped(self):
+        fleet, rep = self._rep(cooldown_cap_s=0.15,
+                               breaker_threshold=100)
+        try:
+            fleet.note_shed(rep, retry_after_s=9999)
+            assert not fleet.routable(rep)
+            time.sleep(0.2)                          # past the cap
+            assert fleet.routable(rep)
+            # malformed Retry-After falls back to a finite default
+            fleet.note_shed(rep, retry_after_s="soon")
+            assert not fleet.routable(rep)
+            assert fleet.metrics.cooldowns == 2
+        finally:
+            fleet.stop()
+
+    def test_rebuilt_replica_starts_with_clean_slate(self):
+        fleet, rep = self._rep(breaker_threshold=1)
+        try:
+            fleet.note_shed(rep, retry_after_s=30)
+            assert rep.breaker_state() == "open"
+            rep.reset_backpressure()                 # rolling restart
+            assert rep.breaker_state() == "closed"
+            assert fleet.routable(rep)
+            assert rep.consecutive_sheds == 0
+        finally:
+            fleet.stop()
+
+    def test_router_honors_retry_after_cooldown_then_expiry(self):
+        """Bugfix (satellite): a 503 + Retry-After must take the
+        replica OUT of the routable set for the advertised window —
+        the next request is not sent straight back to it (the socket
+        sees no contact at all) — and the cooldown EXPIRES: once the
+        window passes the replica is routed to again."""
+        flip = _FlipServer(retry_after="0.3")
+        fleet = ReplicaFleet(poll_interval_s=None, breaker_threshold=100)
+        router = FleetRouter(fleet)
+        try:
+            rep = fleet.add(host="127.0.0.1", port=flip.port)
+            st, _ = router.post("/predict", {"inputs": X})
+            assert st == 503                        # the shed passes up
+            assert flip.hits == 1
+            assert not fleet.routable(rep)          # cooling
+            st, body = router.post("/predict", {"inputs": X})
+            assert st == 503 and "error" in body
+            assert flip.hits == 1                   # NEVER re-contacted
+            assert fleet.metrics.sheds == 1
+            time.sleep(0.4)                         # cooldown expired
+            flip.mode = "ok"
+            st, body = router.post("/predict", {"inputs": X})
+            assert st == 200 and body["outputs"] == [[0.0]]
+            assert flip.hits == 2
+            assert fleet.routable(rep)              # note_ok cleared it
+            assert rep.consecutive_sheds == 0
+            snap = fleet.snapshot()
+            assert snap["sheds"] == 1
+            assert snap["cooldowns"] == 1
+            assert 0.0 < snap["goodput"] <= 1.0
+        finally:
+            router.stop()
+            fleet.stop()
+            flip.stop()
+
+    def test_breaker_opens_through_router_traffic(self):
+        """End-to-end: consecutive 503s observed by the ROUTER trip
+        the breaker; after the open window a half-open probe finds
+        the replica recovered and traffic resumes."""
+        flip = _FlipServer(retry_after="0")
+        fleet = ReplicaFleet(poll_interval_s=None, breaker_threshold=3,
+                             breaker_open_s=0.2)
+        router = FleetRouter(fleet)
+        try:
+            rep = fleet.add(host="127.0.0.1", port=flip.port)
+            for _ in range(3):
+                st, _ = router.post("/predict", {"inputs": X})
+                assert st == 503
+            assert rep.breaker_state() == "open"
+            assert flip.hits == 3
+            st, _ = router.post("/predict", {"inputs": X})
+            assert st == 503 and flip.hits == 3     # open: no contact
+            flip.mode = "ok"
+            time.sleep(0.25)                        # -> half_open
+            st, body = router.post("/predict", {"inputs": X})
+            assert st == 200                        # the probe, via _pick
+            assert rep.breaker_state() == "closed"
+            assert fleet.metrics.breaker_probes >= 1
+            assert fleet.metrics.breaker_recoveries == 1
+        finally:
+            router.stop()
+            fleet.stop()
+            flip.stop()
+
+
+class TestPriorityThroughRouter:
+    """A fronted fleet drops in wherever a single replica stood, so
+    the replica-level priority contract (X-Priority header classifies
+    the request, unknown class -> 400) must hold THROUGH the router's
+    proxy hop, not just replica-direct."""
+
+    def test_x_priority_header_survives_proxy_hop(self, mlp):
+        fleet = _mkfleet([_predict_factory(mlp)])
+        router = FleetRouter(fleet)
+        host, port = router.serve()
+
+        def post(prio):
+            req = urllib.request.Request(
+                f"http://{host}:{port}/predict",
+                data=json.dumps({"inputs": X}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Priority": prio})
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        try:
+            st, out = post("batch")
+            assert st == 200 and "outputs" in out
+            # a bogus class must 400 at the REPLICA — if the router
+            # stripped the header this would be silently admitted as
+            # interactive and answer 200
+            st, out = post("urgent")
+            assert st == 400
+            assert "priority" in out.get("error", "").lower()
+            assert router.metrics.snapshot()["client_errors"] == 1
+        finally:
+            router.stop()
+            fleet.stop(stop_replicas=True)

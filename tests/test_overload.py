@@ -1,0 +1,361 @@
+"""Overload-robustness tests (ISSUE 9): deadline-aware early rejection
+(zero device steps spent on requests whose budget is already gone, for
+the micro-batcher AND the generation engine on both cache backends),
+priority shedding (batch-class work shed first so interactive holds),
+/stats visibility under saturation (queue depth, shed counters, fleet
+aggregation), and the X-Priority HTTP header mapping."""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.serving import (ClientError, DeadlineExceededError,
+                                        FleetRouter, GenerationEngine,
+                                        InferenceEngine, InferenceServer,
+                                        MicroBatcher, QueueFullError,
+                                        ReplicaFleet)
+
+
+def _mlp(seed=0, n_in=4, n_out=3):
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=n_out, loss="mcxent",
+                               activation="softmax"))
+            .input_type_feed_forward(n_in).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _lm():
+    from deeplearning4j_tpu.zoo.transformer_lm import CausalTransformerLM
+    return CausalTransformerLM(vocab_size=64, d_model=16, n_layers=1,
+                               n_heads=2, max_seq_len=32, seed=0,
+                               implementation="plain").init()
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return _lm()
+
+
+class _Slow:
+    """Duck-typed model: output() sleeps (device stall stand-in)."""
+
+    def __init__(self, delay=0.25):
+        self.delay = delay
+
+    def output(self, x):
+        time.sleep(self.delay)
+        return np.zeros((np.asarray(x).shape[0], 1), np.float32)
+
+
+X1 = np.ones((1, 2), np.float32)
+
+
+class TestBatcherDeadlineAdmission:
+    def test_blown_deadline_shed_at_dequeue_zero_device_steps(self):
+        """A queued request whose budget expires behind a slow device
+        call must be rejected at dequeue-admission — 504, counted as
+        shed_deadline, and NO device call issued for it."""
+        eng = InferenceEngine(_Slow(delay=0.25), max_batch_size=1)
+        batcher = MicroBatcher(eng, max_latency_ms=1.0)
+        done = threading.Event()
+
+        def long_client():
+            batcher.submit(X1, timeout_ms=30_000)
+            done.set()
+
+        t = threading.Thread(target=long_client)
+        t.start()
+        time.sleep(0.05)   # worker is now inside the slow device call
+        # the EWMA is still cold (no completed call), so B passes the
+        # submit-time check and queues behind A; by the time the
+        # scheduler reaches it, its 80 ms budget is gone
+        with pytest.raises(DeadlineExceededError):
+            batcher.submit(X1, timeout_ms=80)
+        t.join()
+        assert done.is_set()
+        batcher.stop()
+        assert eng.metrics.batches == 1          # only A reached the device
+        assert eng.metrics.shed_deadline == 1
+        assert eng.metrics.timeouts >= 1
+
+    def test_hopeless_deadline_rejected_504_at_submit(self):
+        """Once the device EWMA is measured, a budget below ONE device
+        call can never be met anywhere — 504 at SUBMIT, before it ever
+        occupies a queue slot."""
+        eng = InferenceEngine(_Slow(delay=0.2), max_batch_size=1)
+        batcher = MicroBatcher(eng, max_latency_ms=1.0)
+        batcher.submit(X1, timeout_ms=30_000)    # warms the EWMA (~200ms)
+        batches = eng.metrics.batches
+        with pytest.raises(DeadlineExceededError, match="one device"):
+            batcher.submit(X1, timeout_ms=50)
+        batcher.stop()
+        assert eng.metrics.batches == batches    # zero device steps spent
+        assert eng.metrics.shed_deadline == 1
+        assert eng.metrics.timeouts == 1         # a deadline verdict (504)
+
+    def test_queue_wait_over_budget_shed_503_at_submit(self):
+        """A budget that covers a device call but not THIS queue's
+        estimated wait is load-local: 503 (another, shorter-queued
+        replica may still make it), not 504."""
+        eng = InferenceEngine(_Slow(delay=0.2), max_batch_size=1)
+        batcher = MicroBatcher(eng, max_latency_ms=1.0)
+        batcher.submit(X1, timeout_ms=30_000)    # warms the EWMA (~200ms)
+        batches = eng.metrics.batches
+        occupiers = [threading.Thread(
+            target=lambda: batcher.submit(X1, timeout_ms=30_000))
+            for _ in range(2)]
+        for t in occupiers:
+            t.start()
+        deadline = time.time() + 5
+        while batcher._queue.qsize() < 1 and time.time() < deadline:
+            time.sleep(0.005)
+        assert batcher._queue.qsize() >= 1
+        # 300 ms covers one ~200 ms device call, but not queue + call
+        with pytest.raises(QueueFullError, match="estimated queue wait"):
+            batcher.submit(X1, timeout_ms=300)
+        for t in occupiers:
+            t.join()
+        batcher.stop()
+        assert eng.metrics.shed_deadline == 1
+        assert eng.metrics.shed == 1             # visible as a shed (503)
+        assert eng.metrics.batches >= batches    # occupiers still served
+
+    def test_cold_batcher_admits_everything(self):
+        """No measured data -> no shedding: a cold batcher must not
+        reject on a fictional estimate."""
+        eng = InferenceEngine(_mlp(), max_batch_size=4)
+        eng.warmup([1])
+        batcher = MicroBatcher(eng, max_latency_ms=1.0)
+        out = batcher.submit(np.ones((1, 4), np.float32), timeout_ms=5)
+        assert np.asarray(out).shape == (1, 3)
+        batcher.stop()
+        assert eng.metrics.shed_deadline == 0
+
+
+class TestBatcherPriorityShedding:
+    def test_batch_class_shed_first_interactive_still_admitted(self):
+        """batch-priority work only gets the front half of the queue:
+        past that depth batch is 503'd while interactive still queues."""
+        eng = InferenceEngine(_Slow(delay=0.1), max_batch_size=1)
+        batcher = MicroBatcher(eng, max_latency_ms=1.0, max_queue=4)
+        assert batcher._batch_queue_limit == 2
+        results = []
+
+        def client():
+            results.append(batcher.submit(X1, timeout_ms=30_000))
+
+        threads = [threading.Thread(target=client) for _ in range(4)]
+        for t in threads:
+            t.start()
+        deadline = time.time() + 5.0
+        while batcher._queue.qsize() < 2 and time.time() < deadline:
+            time.sleep(0.005)
+        assert batcher._queue.qsize() >= 2
+        with pytest.raises(QueueFullError, match="batch-class"):
+            batcher.submit(X1, timeout_ms=30_000, priority="batch")
+        # interactive may still use the remaining queue
+        got = batcher.submit(X1, timeout_ms=30_000)
+        assert np.asarray(got).shape == (1, 1)
+        for t in threads:
+            t.join()
+        batcher.stop()
+        assert eng.metrics.shed_batch >= 1
+        assert len(results) == 4                 # no interactive loss
+
+    def test_unknown_priority_is_client_error(self):
+        eng = InferenceEngine(_mlp(), max_batch_size=4)
+        batcher = MicroBatcher(eng)
+        with pytest.raises(ClientError, match="priority"):
+            batcher.submit(np.ones((1, 4), np.float32), priority="urgent")
+        batcher.stop()
+
+
+class TestGenerationDeadlineAdmission:
+    @pytest.mark.parametrize("cache", ["slots", "paged"])
+    def test_blown_deadline_shed_at_dequeue_zero_prefills(self, lm,
+                                                          cache):
+        """A generation request whose deadline passes while it waits
+        for a slot must be rejected at dequeue-admission — counted as
+        shed_deadline, and never prefilled (zero device steps)."""
+        kw = dict(num_slots=1, max_queue=8, min_prompt_bucket=4)
+        if cache == "paged":
+            kw.update(cache="paged", block_size=4, num_blocks=16)
+        eng = GenerationEngine(lm, **kw)
+        eng.warmup([4])
+        prefills = eng.metrics.prefills + eng.metrics.prefill_chunks
+        # cold cost EWMAs -> a zero budget passes submit admission
+        # (est 0 > 0 is false: no data, no rejection) but is
+        # necessarily expired when the scheduler dequeues it — the
+        # dequeue-admission check must shed it without a prefill
+        with pytest.raises(DeadlineExceededError):
+            eng.generate([4, 5], max_tokens=4, timeout_ms=0)
+        assert eng.metrics.shed_deadline == 1
+        assert eng.metrics.prefills + eng.metrics.prefill_chunks == \
+            prefills                     # the shed request never prefilled
+        # the engine still serves afterwards
+        r = eng.generate([1, 2], max_tokens=2, timeout_ms=30_000)
+        assert len(r["tokens"]) == 2
+        eng.stop()
+
+    def test_hopeless_cost_rejected_at_submit(self, lm):
+        """Once per-token rates are measured, a request that cannot
+        finish inside its own budget is 504'd before any device work."""
+        eng = GenerationEngine(lm, num_slots=1, max_queue=8,
+                               min_prompt_bucket=4)
+        eng.warmup([4])
+        eng.generate([1, 2, 3], max_tokens=8,
+                     timeout_ms=30_000)  # warms prefill/decode EWMAs
+        assert eng._decode_ewma_ms > 0.0
+        prefills = eng.metrics.prefills
+        with pytest.raises(DeadlineExceededError, match="estimated cost"):
+            eng.generate([1, 2, 3], max_tokens=16, timeout_ms=1)
+        assert eng.metrics.prefills == prefills  # zero device steps spent
+        assert eng.metrics.shed_deadline == 1
+        assert eng.metrics.timeouts >= 1
+        eng.stop()
+
+    def test_batch_class_shed_first_in_generation_queue(self, lm):
+        """batch-priority generations only get the front fraction of
+        the queue while the slot is busy; interactive still queues."""
+        eng = GenerationEngine(lm, num_slots=1, max_queue=2,
+                               min_prompt_bucket=4)
+        eng.warmup([4])
+        s = eng.stream([1, 2, 3], max_tokens=25, temperature=0.5,
+                       timeout_ms=60_000)
+        next(s)                         # occupy the only slot
+        got = []
+
+        def client():
+            got.append(eng.generate([1, 2], max_tokens=2,
+                                    timeout_ms=30_000))
+
+        t = threading.Thread(target=client)
+        t.start()
+        deadline = time.time() + 5.0
+        while eng._queue.qsize() < 1 and time.time() < deadline:
+            time.sleep(0.005)
+        assert eng._queue.qsize() >= 1   # at the batch-priority limit
+        with pytest.raises(QueueFullError, match="batch-class"):
+            eng.generate([1, 2], max_tokens=2, timeout_ms=30_000,
+                         priority="batch")
+        assert eng.metrics.shed_batch == 1
+        s.close()                        # free the slot; interactive runs
+        t.join()
+        assert len(got) == 1 and len(got[0]["tokens"]) == 2
+        eng.stop()
+
+    def test_unknown_priority_is_client_error(self, lm):
+        eng = GenerationEngine(lm, num_slots=1, max_queue=2,
+                               min_prompt_bucket=4)
+        with pytest.raises(ClientError, match="priority"):
+            eng.generate([1, 2], max_tokens=2, priority="urgent")
+        eng.stop()
+
+
+class TestStatsUnderOverload:
+    """Satellite: /stats reflects saturation — queue depth, shed
+    counters — and the fleet snapshot aggregates per-replica sheds."""
+
+    def test_stats_reflect_saturation_and_fleet_aggregates(self):
+        server = InferenceServer(port=0, max_batch_size=1,
+                                 max_latency_ms=1.0, max_queue=4)
+        server.register("default", _Slow(delay=0.3))
+        base = f"http://127.0.0.1:{server.port}"
+        payload = json.dumps(
+            {"inputs": X1.tolist(), "timeout_ms": 30_000}).encode()
+        outcomes = []
+
+        def client():
+            req = urllib.request.Request(
+                base + "/predict", data=payload,
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req, timeout=30)
+                outcomes.append(200)
+            except urllib.error.HTTPError as e:
+                outcomes.append(e.code)
+
+        threads = [threading.Thread(target=client) for _ in range(12)]
+        fleet = ReplicaFleet(poll_interval_s=None)
+        try:
+            for t in threads:
+                t.start()
+            time.sleep(0.15)    # 1 in the device call, queue backed up
+            stats = json.loads(urllib.request.urlopen(
+                base + "/stats", timeout=30).read())
+            m = stats["summary"]["models"]["default"]
+            assert m["queue_depth"] >= 1
+            assert stats["summary"]["load"] >= 1
+            for t in threads:
+                t.join()
+            assert outcomes.count(503) >= 1      # bounded queue shed
+            assert outcomes.count(200) >= 1      # but work still flowed
+            stats = json.loads(urllib.request.urlopen(
+                base + "/stats", timeout=30).read())
+            model = stats["models"]["default"]
+            assert model["shed"] >= 1
+            assert stats["summary"]["models"]["default"]["shed"] >= 1
+            assert stats["summary"]["shed"] >= 1
+            # fleet-level: the poll carries the shed total into the
+            # replica summary and the snapshot aggregates it
+            rep = fleet.add(server)
+            fleet.poll_now()
+            snap = fleet.snapshot()
+            assert snap["fleet_shed"] >= 1
+            rs = rep.snapshot()
+            assert rs["breaker"] == "closed"
+            assert rs["cooling"] is False
+            assert rs["consecutive_sheds"] == 0
+        finally:
+            fleet.stop()
+            server.stop()
+
+
+class TestPriorityOverHTTP:
+    """Satellite: the X-Priority header maps to the request's priority
+    field (body field wins); bogus values are 400s, not 500s."""
+
+    @pytest.fixture(scope="class")
+    def server(self):
+        srv = InferenceServer(port=0, max_batch_size=4,
+                              max_latency_ms=2.0)
+        srv.register("default", _mlp())
+        srv.served().warmup([1])
+        yield srv
+        srv.stop()
+
+    def _post(self, server, payload, headers=None):
+        hdrs = {"Content-Type": "application/json"}
+        hdrs.update(headers or {})
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/predict",
+            data=json.dumps(payload).encode(), headers=hdrs)
+        return json.loads(urllib.request.urlopen(req, timeout=30).read())
+
+    def test_header_sets_priority(self, server):
+        out = self._post(server, {"inputs": [[0, 1, 2, 3]]},
+                         headers={"X-Priority": "batch"})
+        assert len(out["outputs"]) == 1   # admitted: unloaded queue
+
+    def test_bogus_header_is_400(self, server):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post(server, {"inputs": [[0, 1, 2, 3]]},
+                       headers={"X-Priority": "urgent"})
+        assert ei.value.code == 400
+
+    def test_body_field_wins_over_header(self, server):
+        # a bogus header must be harmless when the body already says
+        out = self._post(server, {"inputs": [[0, 1, 2, 3]],
+                                  "priority": "interactive"},
+                         headers={"X-Priority": "urgent"})
+        assert len(out["outputs"]) == 1

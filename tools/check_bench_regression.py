@@ -3,8 +3,11 @@
 ``BENCH_*.json`` and fail (exit 1) on a >20% regression in any
 recorded scenario metric.
 
-Scenario metrics are the higher-is-better throughput numbers the bench
-emits (headline samples/sec plus the per-scenario extras). Only
+Most scenario metrics are higher-is-better throughput numbers
+(headline samples/sec plus the per-scenario extras); names listed in
+``LOWER_IS_BETTER`` (latency percentiles, shed rates, queue waits)
+gate in the opposite direction — a fresh value >20% ABOVE the
+recorded baseline is the regression. Only
 metrics present in BOTH the recorded and the fresh run are compared —
 a scenario that didn't run (TPU tunnel down, timeout) is reported as
 "skipped", never failed, so the gate can't be dodged by deleting a
@@ -66,7 +69,40 @@ METRICS = {
     ("extra", "fleet", "requests_per_sec"): "fleet_rps",
     ("extra", "word2vec", "tokens_per_sec"): "word2vec_tokens_per_sec",
     ("extra", "etl_pipeline", "rows_per_sec"): "etl_rows_per_sec",
+    # open-loop overload harness (ISSUE 9): mixed predict+generate
+    # Poisson traffic with a flat 2x-measured-capacity leg — "new,
+    # skipped" until the next BENCH_*.json records a baseline
+    ("extra", "overload", "capacity_rps"): "overload_capacity_rps",
+    ("extra", "overload", "overload_goodput_ratio"):
+        "overload_goodput_ratio",
+    ("extra", "overload", "overload_shed_rate"): "overload_shed_rate",
+    ("extra", "overload", "overload_interactive_p99_ms"):
+        "overload_interactive_p99_ms",
+    ("extra", "overload", "overload_ttft_ms_p99"):
+        "overload_ttft_p99_ms",
+    ("extra", "overload", "overload_itl_ms_p99"): "overload_itl_p99_ms",
+    ("extra", "overload", "overload_queue_depth_max"):
+        "overload_queue_depth_max",
+    # closed-loop serving tail latency (recorded since BENCH_r05)
+    ("extra", "serving", "p99_ms"): "serving_p99_ms",
 }
+
+#: metric NAMES (values of METRICS) where LOWER is better — latency
+#: percentiles, shed rates, queue depths/waits. Everything else gates
+#: higher-is-better. compare() flips the regression test accordingly.
+LOWER_IS_BETTER = {
+    "overload_shed_rate",
+    "overload_interactive_p99_ms",
+    "overload_ttft_p99_ms",
+    "overload_itl_p99_ms",
+    "overload_queue_depth_max",
+    "serving_p99_ms",
+}
+
+
+def direction(name: str) -> str:
+    return ("lower_is_better" if name in LOWER_IS_BETTER
+            else "higher_is_better")
 
 
 def _dig(d, path):
@@ -152,8 +188,13 @@ def compare(recorded: dict, fresh: dict, threshold: float) -> dict:
             continue
         ratio = new / old
         entry = {"metric": name, "recorded": round(old, 3),
-                 "fresh": round(new, 3), "ratio": round(ratio, 3)}
-        if ratio < 1.0 - threshold:
+                 "fresh": round(new, 3), "ratio": round(ratio, 3),
+                 "direction": direction(name)}
+        if name in LOWER_IS_BETTER:
+            regressed = ratio > 1.0 + threshold
+        else:
+            regressed = ratio < 1.0 - threshold
+        if regressed:
             regressions.append(entry)
         else:
             ok.append(entry)
@@ -177,6 +218,7 @@ def list_metrics(recorded: dict, fresh: dict = None) -> list:
             status = "absent from both"
         rows.append({"metric": name,
                      "path": ".".join(path),
+                     "direction": direction(name),
                      "recorded": old,
                      "fresh": new,
                      "status": status})
