@@ -133,6 +133,12 @@ class MicroBatcher:
         # deadline-budget checks key off it, so the limits track the
         # actual service rate instead of a hand-tuned constant
         self._device_ewma_ms = 0.0
+        # total ROWS waiting (in the queue, signature-held, or in a
+        # batch being formed): the queue-wait estimate must count
+        # rows, not requests — one queued request can carry up to
+        # max_batch_size rows
+        self._pending_rows = 0
+        self._rows_lock = threading.Lock()
         self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=max_queue)
         self._held: "deque[_Request]" = deque()  # signature-mismatched
         self._profiler = OpProfiler.get_instance()
@@ -182,7 +188,7 @@ class MicroBatcher:
                 f"queue depth {depth} at the batch-priority limit "
                 f"({self._batch_queue_limit}/{self.metrics.queue_max});"
                 f" shedding batch-class work first")
-        est_wait_ms = self._est_queue_wait_ms(depth)
+        est_wait_ms = self._est_queue_wait_ms(self._pending_rows)
         if est_wait_ms + self._device_ewma_ms > timeout * 1e3:
             # deadline-aware early rejection at SUBMIT. Two distinct
             # verdicts: a budget smaller than ONE device call can
@@ -210,6 +216,8 @@ class MicroBatcher:
             self.metrics.inc("shed")
             raise QueueFullError(
                 f"queue full ({self.metrics.queue_max}); shedding load")
+        with self._rows_lock:
+            self._pending_rows += req.n
         if not self._running:
             # raced with stop(): the scheduler may already have drained
             # the queue — fail fast, don't strand the caller on wait()
@@ -229,14 +237,20 @@ class MicroBatcher:
             (time.perf_counter() - req.t_submit) * 1e3)
         return req.result
 
-    def _est_queue_wait_ms(self, depth: int) -> float:
-        """Estimated time for ``depth`` queued rows to drain, from the
+    def _est_queue_wait_ms(self, rows: int) -> float:
+        """Estimated time for ``rows`` queued ROWS to drain, from the
         measured device-call EWMA. 0.0 until the first call lands (a
         cold batcher admits everything — no data, no shedding)."""
-        if not self._device_ewma_ms or depth <= 0:
+        if not self._device_ewma_ms or rows <= 0:
             return 0.0
-        calls = -(-depth // self.max_batch_size)  # ceil division
+        calls = -(-rows // self.max_batch_size)  # ceil division
         return calls * self._device_ewma_ms
+
+    def _rows_done(self, n: int):
+        """``n`` rows left the pending set (executed, expired, or
+        failed at stop) — keep the queued-rows gauge honest."""
+        with self._rows_lock:
+            self._pending_rows -= n
 
     # -- scheduler side ------------------------------------------------
     def _next(self, block_s: Optional[float]):
@@ -256,12 +270,14 @@ class MicroBatcher:
         device step. The timeout count is a per-request CAS — the
         waiter may be counting the same expiry concurrently."""
         if req.abandoned:
+            self._rows_done(req.n)
             return True
         if time.perf_counter() > req.deadline - self._device_ewma_ms / 1e3:
             req.error = DeadlineExceededError(
                 "deadline budget exhausted in queue")
             req.count_timeout_once(self.metrics)
             self.metrics.inc("shed_deadline")
+            self._rows_done(req.n)
             req.event.set()
             return True
         return False
@@ -299,10 +315,13 @@ class MicroBatcher:
             # against the waiter's own timeout accounting).
             batch = [r for r in batch if not self._expired(r)]
             if batch:
-                self._execute(batch, sum(r.n for r in batch))
+                n_rows = sum(r.n for r in batch)
+                self._rows_done(n_rows)
+                self._execute(batch, n_rows)
             self.metrics.queue_depth = self._queue.qsize()
         # drain on stop: fail fast rather than strand waiters
         for req in list(self._held):
+            self._rows_done(req.n)
             req.error = ServingError("batcher stopped")
             req.event.set()
 
@@ -323,6 +342,7 @@ class MicroBatcher:
         backoff = self.retry_backoff_ms / 1e3
         attempt = 0
         while True:
+            c0 = self.metrics.compiles
             t0 = time.perf_counter()  # device_ms times the call that
             try:                      # succeeded, not the backoffs
                 with self._profiler.record("serving.batch"):
@@ -354,9 +374,14 @@ class MicroBatcher:
                 return
         dt_ms = (time.perf_counter() - t0) * 1e3
         self.metrics.device_ms.record(dt_ms)
-        # feed the adaptive-admission EWMA (scheduler thread only)
-        self._device_ewma_ms = dt_ms if not self._device_ewma_ms else \
-            0.8 * self._device_ewma_ms + 0.2 * dt_ms
+        # feed the adaptive-admission EWMA (scheduler thread only) —
+        # but never from a call that paid a lazy XLA compile: one
+        # multi-second sample would push the estimate above every
+        # deadline budget, and with all traffic then shed at submit
+        # no new samples could ever decay it back down
+        if self.metrics.compiles == c0:
+            self._device_ewma_ms = dt_ms if not self._device_ewma_ms \
+                else 0.8 * self._device_ewma_ms + 0.2 * dt_ms
         lo = 0
         for r in batch:
             r.result = _slice(res, lo, lo + r.n)
@@ -409,6 +434,7 @@ class MicroBatcher:
                 req = self._queue.get_nowait()
             except queue.Empty:
                 break
+            self._rows_done(req.n)
             req.error = ServingError("batcher stopped")
             req.event.set()
 

@@ -43,7 +43,9 @@ Layers::
   circuit breaker distinct from health ejection (the replica is alive
   and healthy, just overloaded): the breaker holds ``open`` for
   ``breaker_open_s``, then goes ``half_open`` and admits one probe
-  request per window — a non-503 answer closes it, another shed
+  request per window — a 2xx answer to a request dispatched AFTER
+  the latest shed closes it (a 200 already in flight when the shed
+  landed is stale evidence and changes nothing), another shed
   re-opens it. Counters: ``sheds``, ``cooldowns``, ``breaker_trips``,
   ``breaker_probes``, ``breaker_recoveries``, plus a ``goodput``
   ratio (responses/requests) in the snapshot.
@@ -220,6 +222,7 @@ class Replica:
         # backpressure state (distinct from health: the replica is
         # alive, it just told us to back off)
         self.cooldown_until = 0.0    # Retry-After routing exclusion
+        self.shed_at = 0.0           # monotonic time of the last shed
         self.consecutive_sheds = 0   # 503 streak -> trips the breaker
         self.breaker_tripped = False
         self.breaker_until = 0.0     # open until; half-open after
@@ -249,6 +252,7 @@ class Replica:
         hold ``_lock``."""
         with self._lock:
             self.cooldown_until = 0.0
+            self.shed_at = 0.0
             self.consecutive_sheds = 0
             self.breaker_tripped = False
             self.breaker_until = 0.0
@@ -501,6 +505,7 @@ class ReplicaFleet:
         with rep._lock:
             was_cooling = now < rep.cooldown_until
             rep.cooldown_until = max(rep.cooldown_until, now + cooldown)
+            rep.shed_at = now
             rep.consecutive_sheds += 1
             if rep.breaker_tripped:
                 rep.breaker_until = now + self.breaker_open_s
@@ -514,13 +519,20 @@ class ReplicaFleet:
         if tripped:
             self.metrics.inc("breaker_trips")
 
-    def note_ok(self, rep: Replica):
-        """A non-503 answer from this replica: the shed streak is
-        broken; a tripped breaker closes (successful half-open
-        probe); any residual cooldown is lifted — the replica is
-        demonstrably serving again."""
+    def note_ok(self, rep: Replica,
+                dispatched_at: Optional[float] = None):
+        """A 2xx answer from this replica: the shed streak is broken;
+        a tripped breaker closes (successful half-open probe); any
+        residual cooldown is lifted — the replica is demonstrably
+        serving again. ``dispatched_at`` (``time.monotonic()`` at
+        send time) guards against stale evidence: a 200 for a request
+        dispatched BEFORE the replica's latest shed was admitted
+        before the overload signal and proves nothing — it must not
+        cancel a fresh cooldown and route traffic straight back."""
         recovered = False
         with rep._lock:
+            if dispatched_at is not None and dispatched_at < rep.shed_at:
+                return
             rep.consecutive_sheds = 0
             rep.cooldown_until = 0.0
             if rep.breaker_tripped:
@@ -869,14 +881,19 @@ class FleetRouter:
         failure, or an explicit shed/draining 503."""
         return isinstance(out, Exception) or out[0] == 503
 
-    def _note(self, rep: Replica, status: int, hdrs: Dict):
+    def _note(self, rep: Replica, status: int, hdrs: Dict,
+              dispatched_at: Optional[float] = None):
         """Feed the backpressure loop from one replica answer: a 503
-        becomes a Retry-After cooldown + breaker strike; anything
-        else breaks the shed streak (and closes a tripped breaker)."""
+        becomes a Retry-After cooldown + breaker strike; a 2xx to a
+        request dispatched AFTER the latest shed breaks the streak
+        (and closes a tripped breaker). Anything else — 4xx, 500,
+        504, or a 200 for a request already in flight when the shed
+        landed — proves neither overload nor recovery and leaves the
+        backpressure state alone."""
         if status == 503:
             self.fleet.note_shed(rep, hdrs.get("Retry-After"))
-        else:
-            self.fleet.note_ok(rep)
+        elif 200 <= status < 300:
+            self.fleet.note_ok(rep, dispatched_at)
 
     # -- dispatch ------------------------------------------------------
     def post(self, path: str, payload) -> Tuple[int, Dict]:
@@ -946,6 +963,7 @@ class FleetRouter:
     def _attempt_plain(self, rep: Replica, path: str, body: bytes,
                        excluded: Set[str], headers: Dict = None):
         """Single-arm dispatch in the calling thread."""
+        t_dispatch = time.monotonic()
         try:
             out = self._tracked(rep, path, body, headers)
         except _RETRYABLE_EXC as e:
@@ -956,7 +974,7 @@ class FleetRouter:
             self.fleet.note_failure(rep)
             excluded.add(rep.id)
             return e
-        self._note(rep, out[0], out[1])
+        self._note(rep, out[0], out[1], t_dispatch)
         if out[0] == 503:
             excluded.add(rep.id)
         return out
@@ -971,9 +989,10 @@ class FleetRouter:
         results: "queue.Queue" = queue.Queue()
 
         def run(r: Replica):
+            t_dispatch = time.monotonic()
             try:
                 out = self._tracked(r, path, body, headers)
-                self._note(r, out[0], out[1])
+                self._note(r, out[0], out[1], t_dispatch)
             except _RETRYABLE_EXC as e:
                 if isinstance(e, TimeoutError):
                     out = _timeout_response(self.timeout_s)
@@ -1038,6 +1057,7 @@ class FleetRouter:
                 self.metrics.inc("retries")
             rep.begin()
             self.metrics.inc("routed")
+            t_dispatch = time.monotonic()
             conn = http.client.HTTPConnection(rep.host, rep.port,
                                               timeout=self.timeout_s)
             try:
@@ -1061,7 +1081,7 @@ class FleetRouter:
                 conn.close()
                 rep.end()
                 hdrs = dict(resp.getheaders())
-                self._note(rep, resp.status, hdrs)
+                self._note(rep, resp.status, hdrs, t_dispatch)
                 if resp.status == 503:
                     excluded.add(rep.id)
                     last = (resp.status, hdrs, data)
@@ -1072,7 +1092,7 @@ class FleetRouter:
                     self.metrics.inc("server_errors")
                 return ("response", resp.status,
                         dict(resp.getheaders()), data)
-            self.fleet.note_ok(rep)
+            self.fleet.note_ok(rep, t_dispatch)
             self.metrics.inc("streams")
             return ("stream", rep, conn, resp)
         self.metrics.inc("requests_lost")

@@ -769,11 +769,25 @@ class GenerationEngine:
                            time.perf_counter() + timeout, stream,
                            priority=priority)
 
+    def _padded_prefill_len(self, prompt_len: int) -> int:
+        """Prompt tokens the device will actually COMPUTE over during
+        prefill: the padded bucket width(s), not the raw length.
+        ``_note_prefill_cost`` normalizes the per-token EWMA by padded
+        width, so cost estimates must scale by the same quantity — a
+        5-token prompt in a 128 bucket pays the full bucket's
+        prefill. Paged: the sum of the chunk plan's buckets; slots:
+        the prompt bucket the request rounds up to."""
+        if self.cache_backend == "paged":
+            return sum(b for _, b, _ in self._chunk_plan(prompt_len))
+        return next((b for b in self.prompt_buckets if b >= prompt_len),
+                    self.prompt_buckets[-1])
+
     def _est_cost_ms(self, prompt_len: int, max_tokens: int) -> float:
         """Worst-case service estimate from measured rates: prefill of
-        the whole prompt plus ``max_tokens`` decode steps. 0.0 on a
-        cold engine (no data, no rejection)."""
-        return (prompt_len * self._prefill_ms_per_tok
+        the whole PADDED prompt plus ``max_tokens`` decode steps. 0.0
+        on a cold engine (no data, no rejection)."""
+        return (self._padded_prefill_len(prompt_len)
+                * self._prefill_ms_per_tok
                 + max_tokens * self._decode_ewma_ms)
 
     def _deadline_blown(self, req: _GenRequest,
@@ -784,7 +798,8 @@ class GenerationEngine:
         rates) — in which case prefilling would burn device steps on
         rows nobody will read."""
         now = time.perf_counter() if now is None else now
-        min_work_ms = (len(req.prompt) * self._prefill_ms_per_tok
+        min_work_ms = (self._padded_prefill_len(len(req.prompt))
+                       * self._prefill_ms_per_tok
                        + self._decode_ewma_ms)
         return now > req.deadline - min_work_ms / 1e3
 

@@ -743,6 +743,53 @@ class TestBackpressure:
         finally:
             fleet.stop()
 
+    def test_stale_ok_does_not_clear_fresh_cooldown(self):
+        """A 200 for a request dispatched BEFORE the shed landed is
+        stale evidence: under concurrency an in-flight request
+        completing right after a shed must not cancel the fresh
+        cooldown (or close the breaker) and route traffic straight
+        back at the overloaded replica."""
+        fleet, rep = self._rep(breaker_threshold=1)
+        try:
+            t_before = time.monotonic()
+            time.sleep(0.01)
+            fleet.note_shed(rep, retry_after_s=30)
+            assert not fleet.routable(rep)
+            assert rep.breaker_state() == "open"
+            fleet.note_ok(rep, dispatched_at=t_before)   # stale answer
+            assert not fleet.routable(rep)               # still cooling
+            assert rep.breaker_state() == "open"
+            assert rep.consecutive_sheds == 1
+            assert fleet.metrics.breaker_recoveries == 0
+            # an answer to a request dispatched AFTER the shed is
+            # real evidence of recovery
+            fleet.note_ok(rep, dispatched_at=time.monotonic())
+            assert fleet.routable(rep)
+            assert rep.breaker_state() == "closed"
+            assert fleet.metrics.breaker_recoveries == 1
+        finally:
+            fleet.stop()
+
+    def test_non_2xx_answers_are_not_recovery(self):
+        """Only a 2xx proves the replica is serving again: a 500/404
+        passing through the router must leave the cooldown and the
+        shed streak untouched."""
+        fleet, rep = self._rep(breaker_threshold=100)
+        router = FleetRouter(fleet)
+        try:
+            fleet.note_shed(rep, retry_after_s=30)
+            assert not fleet.routable(rep)
+            router._note(rep, 500, {}, time.monotonic())
+            router._note(rep, 404, {}, time.monotonic())
+            assert not fleet.routable(rep)               # cooldown holds
+            assert rep.consecutive_sheds == 1
+            router._note(rep, 200, {}, time.monotonic())
+            assert fleet.routable(rep)
+            assert rep.consecutive_sheds == 0
+        finally:
+            router.stop()
+            fleet.stop()
+
     def test_rebuilt_replica_starts_with_clean_slate(self):
         fleet, rep = self._rep(breaker_threshold=1)
         try:

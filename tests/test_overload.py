@@ -142,6 +142,89 @@ class TestBatcherDeadlineAdmission:
         assert eng.metrics.shed_deadline == 0
 
 
+class TestAdmissionEstimates:
+    """The adaptive-admission estimators must be fed honestly: no
+    compile-poisoned device samples, rows (not request count) in the
+    queue-wait estimate, padded buckets in the generation cost."""
+
+    def test_compile_sample_never_feeds_device_ewma(self):
+        """A device call that paid a lazy XLA compile must NOT feed
+        the admission EWMA: one multi-second sample would 504 every
+        budgeted request at submit, and with all traffic shed no new
+        samples could ever decay the estimate back down."""
+        eng = InferenceEngine(_mlp(), max_batch_size=4)
+        batcher = MicroBatcher(eng, max_latency_ms=1.0)
+        x = np.ones((1, 4), np.float32)
+        batcher.submit(x, timeout_ms=30_000)    # pays the lazy compile
+        assert eng.metrics.compiles >= 1
+        assert batcher._device_ewma_ms == 0.0   # poisoned sample dropped
+        compiles = eng.metrics.compiles
+        batcher.submit(x, timeout_ms=30_000)    # warmed: cache hit
+        batcher.stop()
+        assert eng.metrics.compiles == compiles
+        assert batcher._device_ewma_ms > 0.0    # clean sample landed
+
+    def test_queue_wait_estimate_counts_rows_not_requests(self):
+        eng = InferenceEngine(_mlp(), max_batch_size=4)
+        batcher = MicroBatcher(eng, max_latency_ms=1.0)
+        batcher.stop()
+        batcher._device_ewma_ms = 100.0
+        # 8 queued ROWS are 2 device calls at max_batch_size=4 even
+        # when they arrived as fewer (multi-row) requests
+        assert batcher._est_queue_wait_ms(8) == 200.0
+        assert batcher._est_queue_wait_ms(1) == 100.0
+        assert batcher._est_queue_wait_ms(0) == 0.0
+
+    def test_pending_rows_gauge_counts_rows(self):
+        """One queued 4-row request is four rows of wait, not one
+        queue slot — and the gauge returns to zero once served."""
+        eng = InferenceEngine(_Slow(delay=0.25), max_batch_size=4)
+        batcher = MicroBatcher(eng, max_latency_ms=1.0)
+        done = []
+
+        def client(n):
+            batcher.submit(np.ones((n, 2), np.float32),
+                           timeout_ms=30_000)
+            done.append(n)
+
+        a = threading.Thread(target=client, args=(1,))
+        a.start()
+        time.sleep(0.05)     # A is inside the slow device call
+        b = threading.Thread(target=client, args=(4,))
+        b.start()
+        deadline = time.time() + 5.0
+        while batcher._pending_rows < 4 and time.time() < deadline:
+            time.sleep(0.005)
+        assert batcher._queue.qsize() <= 1      # one request queued...
+        assert batcher._pending_rows == 4       # ...but FOUR rows
+        a.join()
+        b.join()
+        batcher.stop()
+        assert batcher._pending_rows == 0
+        assert sorted(done) == [1, 4]
+
+    @pytest.mark.parametrize("cache", ["slots", "paged"])
+    def test_generation_cost_uses_padded_bucket(self, lm, cache):
+        """_note_prefill_cost normalizes by the PADDED bucket width,
+        so the admission estimate must multiply by the same width — a
+        short prompt in a wide bucket pays the whole bucket's
+        prefill, and an estimate from the raw length would admit
+        requests whose budget cannot cover it."""
+        kw = dict(num_slots=1, min_prompt_bucket=8)
+        if cache == "paged":
+            kw.update(cache="paged", block_size=4, num_blocks=16)
+        eng = GenerationEngine(lm, **kw)
+        try:
+            eng._prefill_ms_per_tok = 1.0   # 1 ms per PADDED token
+            eng._decode_ewma_ms = 2.0
+            # a 2-token prompt rounds up to the 8-wide bucket: the
+            # device computes 8 tokens of prefill, so must the cost
+            assert eng._padded_prefill_len(2) == 8
+            assert eng._est_cost_ms(2, 3) == 8.0 + 3 * 2.0
+        finally:
+            eng.stop()
+
+
 class TestBatcherPriorityShedding:
     def test_batch_class_shed_first_interactive_still_admitted(self):
         """batch-priority work only gets the front half of the queue:
