@@ -144,6 +144,13 @@ class Counter:
             return self._v
 
 
+#: the exact key set of :meth:`Reservoir.snapshot` — consumers that
+#: re-render snapshots (the Prometheus exposition in serving/metrics.py,
+#: tools/trace_report.py dumps) detect reservoir-shaped summary dicts by
+#: this signature, so it is defined once here rather than re-guessed
+RESERVOIR_SNAPSHOT_KEYS = ("count", "mean", "p50", "p90", "p99", "max")
+
+
 class Reservoir:
     """Bounded sample reservoir with percentile queries (ref role: the
     reference's PerformanceListener latency aggregation). Keeps the most
@@ -192,8 +199,8 @@ class Reservoir:
     def snapshot(self) -> Dict[str, float]:
         s = self._samples()
         if not s:
-            return {"count": self._n, "mean": 0.0, "p50": 0.0,
-                    "p90": 0.0, "p99": 0.0, "max": 0.0}
+            return dict.fromkeys(RESERVOIR_SNAPSHOT_KEYS, 0.0) | {
+                "count": self._n}
         return {"count": self._n,
                 "mean": float(sum(s) / len(s)),
                 "p50": self._nearest_rank(s, 50),

@@ -42,11 +42,24 @@ HTTP surface::
                                        (per-model live occupancy /
                                        queue depth / draining flag)
                                        for routers and load balancers
+    GET  /metrics                      the same counters as Prometheus
+                                       text exposition (scrapable)
+    GET  /debug/traces                 bounded ring of recent / slow /
+                                       errored request traces; filter
+                                       with ?request_id=<id>
     GET  /health                       legacy summary (always 200)
     GET  /healthz                      liveness: 503 when any engine
                                        loop is wedged (stall watchdog)
     GET  /readyz                       readiness: 503 + Retry-After
                                        while draining
+
+Observability (docs/observability.md): every request carries an
+``X-Request-Id`` (accepted from the caller or minted here, echoed on
+the response); with ``tracing=True`` — or per-request via ``?trace=1``
+/ a ``"trace": 1`` body field, which also embeds the timeline in the
+response — the request records admission / queue / prefill / decode
+spans retained at ``/debug/traces``. ``log_requests=`` emits one
+structured JSON access-log line per HTTP request.
 
 Status codes: 400 malformed request (client), 404 unknown route/model,
 500 internal failure (incl. quarantined poison requests), 503 load
@@ -95,13 +108,17 @@ from __future__ import annotations
 import json
 import os
 import signal
+import sys
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Sequence
+from urllib.parse import parse_qs
 
 import jax
 import numpy as np
 
+from ..tracing import Tracer, new_request_id
 from .batcher import (DeadlineExceededError, DrainingError, MicroBatcher,
                       QueueFullError)
 from .engine import ClientError, InferenceEngine, ServingError, next_bucket
@@ -111,7 +128,8 @@ from .fleet import (FleetError, FleetMetrics, FleetRouter,
                     NoReplicasError, Replica, ReplicaFleet)
 from .generation import GenerationEngine
 from .kvcache import KVCache, SlotTable
-from .metrics import GenerationMetrics, ServingMetrics, profiler_sections
+from .metrics import (GenerationMetrics, ServingMetrics,
+                      profiler_sections, prometheus_text)
 from .paging import BlockAllocator, BlockTable, PagedKVCache
 from .registry import (ModelNotFound, ModelRegistry, ServedGenerator,
                        ServedModel)
@@ -126,7 +144,7 @@ __all__ = [
     "TransientFault", "CorruptedStateFault", "PoisonRequestError",
     "ReplicaFleet", "FleetRouter", "Replica", "FleetMetrics",
     "FleetError", "NoReplicasError",
-    "next_bucket", "export_stablehlo",
+    "next_bucket", "export_stablehlo", "Tracer", "prometheus_text",
 ]
 
 
@@ -204,13 +222,32 @@ class InferenceServer:
                  default_timeout_ms: float = 30_000.0,
                  warmup_buckets: Optional[Sequence[int]] = None,
                  warmup_example=None,
-                 max_body_bytes: int = 256 * 1024 * 1024):
+                 max_body_bytes: int = 256 * 1024 * 1024,
+                 tracing: bool = False,
+                 trace_ring: int = 256,
+                 trace_slow_ms: float = 1000.0,
+                 log_requests=False):
         self.max_body_bytes = int(max_body_bytes)
         self.registry = registry or ModelRegistry()
         self._owns_registry = registry is None
         self._ready = True            # flips off when drain() starts
         self._prev_handlers: Dict[int, Any] = {}
         self._signal_drain: Optional[threading.Thread] = None
+        # request tracing (docs/observability.md): disabled by default
+        # — Tracer.begin then returns None and every instrumented path
+        # skips span work on a single attribute check. ?trace=1 still
+        # traces one request through a disabled tracer.
+        self.tracer = Tracer(enabled=bool(tracing), ring=trace_ring,
+                             slow_ms=trace_slow_ms)
+        # structured access log: False = off, True = stderr, else any
+        # writable text stream (one JSON object per line)
+        self._log_stream = (sys.stderr if log_requests is True
+                            else (log_requests or None))
+        self._log_lock = threading.Lock()
+        # dead-socket writes swallowed by the handler (clients/routers
+        # that timed out and hung up): invisible before this counter
+        self.client_disconnects = 0
+        self._disc_lock = threading.Lock()
         self._opts = dict(batching=batching, max_batch_size=max_batch_size,
                           max_latency_ms=max_latency_ms,
                           max_queue=max_queue,
@@ -232,33 +269,85 @@ class InferenceServer:
             def log_message(self, *a):
                 pass
 
+            def log_request(self, code="-", size="-"):
+                # send_response() calls this once per response — the
+                # single choke point every success/error/stream path
+                # goes through, so the access log is one line per
+                # request with no per-branch bookkeeping
+                if server._log_stream is None:
+                    return
+                try:
+                    status = int(code)
+                except (TypeError, ValueError):
+                    status = str(code)
+                t0 = getattr(self, "_t0", None)
+                entry = {"ts": round(time.time(), 6),
+                         "method": self.command,
+                         "path": self.path,
+                         "status": status,
+                         "latency_ms": round(
+                             (time.perf_counter() - t0) * 1e3, 3)
+                         if t0 is not None else None,
+                         "request_id": getattr(self, "_rid", None),
+                         "priority": getattr(self, "_prio", None)}
+                shed = getattr(self, "_shed", None)
+                if shed is not None:
+                    entry["shed_reason"] = shed
+                server._access_log(entry)
+
             def _json(self, obj, code=200, headers=None):
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                rid = getattr(self, "_rid", None)
+                if rid:
+                    self.send_header("X-Request-Id", rid)
                 for k, v in (headers or {}).items():
                     self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _text(self, body: str, code=200):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "text/plain; "
+                                 "version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
             def do_GET(self):
+                self._t0 = time.perf_counter()
+                self._rid = self.headers.get("X-Request-Id")
+                path, _, query = self.path.partition("?")
                 try:
-                    if self.path == "/health":
+                    if path == "/health":
                         self._json(server._health())
-                    elif self.path == "/healthz":
+                    elif path == "/healthz":
                         code, body = server._healthz()
                         self._json(body, code)
-                    elif self.path == "/readyz":
+                    elif path == "/readyz":
                         if server.ready():
                             self._json({"ready": True})
                         else:
                             self._json({"ready": False,
                                         "reason": "draining"}, 503,
                                        headers={"Retry-After": "1"})
-                    elif self.path == "/stats":
+                    elif path == "/stats":
                         self._json(server.stats())
-                    elif self.path in ("/v1/models", "/v1/models/"):
+                    elif path == "/metrics":
+                        self._text(prometheus_text(server.stats()))
+                    elif path == "/debug/traces":
+                        q = parse_qs(query)
+                        rid = (q.get("request_id") or q.get("id")
+                               or [None])[0]
+                        limit = int((q.get("limit") or [50])[0])
+                        self._json({
+                            "traces": server.tracer.dump(
+                                request_id=rid, limit=limit),
+                            "tracer": server.tracer.snapshot()})
+                    elif path in ("/v1/models", "/v1/models/"):
                         self._json(server.registry.describe())
                     else:
                         self._json({"error": "not found"}, 404)
@@ -266,6 +355,14 @@ class InferenceServer:
                     self._json({"error": str(e)}, 500)
 
             def do_POST(self):
+                self._t0 = time.perf_counter()
+                # mint a request id unless the caller (router, client)
+                # already tagged one — the id is the trace id, echoed
+                # back as X-Request-Id and stitched across tiers
+                self._rid = (self.headers.get("X-Request-Id")
+                             or new_request_id())
+                self._prio = self.headers.get("X-Priority")
+                self._shed = None
                 # drain the body first: on a keep-alive (1.1) connection
                 # an unread body would be parsed as the next request
                 # line, desyncing the socket. Bad/negative lengths are a
@@ -295,7 +392,8 @@ class InferenceServer:
                     self.close_connection = True  # body left unread
                     return
                 raw = self.rfile.read(n)
-                route = server._route(self.path)
+                path, _, query = self.path.partition("?")
+                route = server._route(path)
                 if route is None:
                     self._json({"error": "not found"}, 404)
                     return
@@ -304,11 +402,14 @@ class InferenceServer:
                     # draining: shed BEFORE touching the registry so
                     # half-drained engines never see new work; clients
                     # retry against another replica after Retry-After
+                    self._shed = "draining"
                     self._json({"error": "server is draining"}, 503,
                                headers={"Retry-After": "1"})
                     return
                 req = None
                 result = None
+                trace = None
+                span = None
                 try:
                     try:
                         req = json.loads(raw)
@@ -321,30 +422,63 @@ class InferenceServer:
                     if prio_hdr and isinstance(req, dict) \
                             and "priority" not in req:
                         req["priority"] = prio_hdr
+                    if isinstance(req, dict):
+                        self._prio = req.get("priority", self._prio)
+                    # ?trace=1 (or "trace": 1 in the body) forces a
+                    # per-request trace even when the tracer is off;
+                    # the field is popped so validators never see it
+                    want_trace = bool(
+                        (query and "trace=1" in query.split("&"))
+                        or (isinstance(req, dict)
+                            and req.pop("trace", None)))
+                    trace = server.tracer.begin(self._rid,
+                                                force=want_trace)
+                    if trace is not None:
+                        span = trace.span("http", path=path,
+                                          model=name, action=action)
                     if action == "generate":
                         if isinstance(req, dict) and req.get("stream"):
                             # admission errors raise HERE (before any
                             # header goes out), so they still map to
                             # real status codes; mid-stream failures
                             # become a terminal error chunk instead
-                            it = server._generate_stream(name, req)
+                            it = server._generate_stream(name, req,
+                                                         trace=trace)
                             self._stream_ndjson(it)
+                            if trace is not None:
+                                span.end(status=200, stream=True)
+                                server.tracer.finish(trace)
                             return
-                        result = server._generate(name, req)
+                        result = server._generate(name, req,
+                                                  trace=trace)
                     else:
-                        result = server._predict(name, req)
+                        result = server._predict(name, req,
+                                                 trace=trace)
                 except Exception as e:  # noqa: BLE001
                     code = _status_for(e)
+                    if code in (503, 504):
+                        self._shed = str(e)
                     version = (req.get("version")
                                if isinstance(req, dict) else None)
                     server._count_error(name, code, version)
+                    if trace is not None:
+                        span.end(status=code, error=str(e))
+                        server.tracer.finish(trace,
+                                             error=code >= 500)
                     try:
                         self._json({"error": str(e)}, code,
                                    headers=({"Retry-After": "1"}
                                             if code == 503 else None))
                     except OSError:
+                        server._count_disconnect()
                         self.close_connection = True
                     return
+                if trace is not None:
+                    span.end(status=200)
+                    server.tracer.finish(trace)
+                    if want_trace and isinstance(result, dict):
+                        result = dict(result)
+                        result["trace"] = trace.to_dict()
                 try:
                     self._json(result)
                 except OSError:
@@ -352,6 +486,7 @@ class InferenceServer:
                     # request computed — routine once routers time out
                     # and abandon sockets, not a server error; a
                     # traceback per occurrence would spam stderr
+                    server._count_disconnect()
                     self.close_connection = True
 
             def _stream_ndjson(self, it):
@@ -372,6 +507,7 @@ class InferenceServer:
                     # NOT fall through to a second response attempt
                     if hasattr(it, "close"):
                         it.close()
+                    server._count_disconnect()
                     self.close_connection = True
                     return
 
@@ -391,6 +527,7 @@ class InferenceServer:
                         # cache slot) and drop the connection quietly
                         if hasattr(it, "close"):
                             it.close()
+                        server._count_disconnect()
                         self.close_connection = True
                         return
                     except Exception as e:  # noqa: BLE001 — headers
@@ -401,6 +538,7 @@ class InferenceServer:
                 except OSError:
                     # the error/terminal chunk hit the dead socket too;
                     # never fall through to a second HTTP response
+                    server._count_disconnect()
                     self.close_connection = True
 
         self.httpd = _HTTPServer((host, port), Handler)
@@ -448,7 +586,7 @@ class InferenceServer:
             return parts[2], parts[3]
         return None
 
-    def _predict(self, name: str, req) -> dict:
+    def _predict(self, name: str, req, trace=None) -> dict:
         if not isinstance(req, dict):
             raise ClientError("request body must be a JSON object")
         if "inputs" not in req:
@@ -474,7 +612,7 @@ class InferenceServer:
         if not isinstance(priority, str):
             raise ClientError("'priority' must be a string")
         res = served.predict(req["inputs"], outputs, timeout_ms=timeout_ms,
-                             priority=priority)
+                             priority=priority, trace=trace)
         if isinstance(res, dict):
             return {"outputs": {k: np.asarray(v).tolist()
                                 for k, v in res.items()}}
@@ -515,13 +653,36 @@ class InferenceServer:
             opts["priority"] = priority
         return served, req["prompt"], opts
 
-    def _generate(self, name: str, req) -> dict:
+    def _generate(self, name: str, req, trace=None) -> dict:
         served, prompt, opts = self._gen_opts(name, req)
-        return served.generate(prompt, **opts)
+        return served.generate(prompt, trace=trace, **opts)
 
-    def _generate_stream(self, name: str, req):
+    def _generate_stream(self, name: str, req, trace=None):
         served, prompt, opts = self._gen_opts(name, req)
-        return served.stream(prompt, **opts)
+        return served.stream(prompt, trace=trace, **opts)
+
+    def _count_disconnect(self):
+        """Count a swallowed dead-socket write (client hung up while a
+        response or stream chunk was in flight). Routine under router
+        timeouts/hedging, but a rate spike means clients are giving up
+        before replies arrive — surfaced in ``summary()``."""
+        with self._disc_lock:
+            self.client_disconnects += 1
+
+    def _access_log(self, entry: dict):
+        """Emit one structured JSON access-log line (off unless the
+        server was built with ``log_requests=``). Logging failures
+        never take down a request handler."""
+        stream = self._log_stream
+        if stream is None:
+            return
+        try:
+            line = json.dumps(entry, separators=(",", ":"))
+            with self._log_lock:
+                stream.write(line + "\n")
+                stream.flush()
+        except (OSError, ValueError):
+            pass
 
     def _count_error(self, name: str, code: int, version=None):
         try:
@@ -646,6 +807,7 @@ class InferenceServer:
                 # server-level shed total: a fleet poller aggregates
                 # these into per-replica overload counters
                 "shed": sum(m.get("shed", 0) for m in models.values()),
+                "client_disconnects": self.client_disconnects,
                 "models": models}
 
     def stop(self):

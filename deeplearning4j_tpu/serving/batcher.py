@@ -63,7 +63,7 @@ PRIORITIES = ("interactive", "batch")
 class _Request:
     __slots__ = ("feed", "n", "sig", "deadline", "priority", "event",
                  "result", "error", "t_submit", "abandoned", "_lock",
-                 "_timeout_counted")
+                 "_timeout_counted", "trace", "qspan")
 
     def __init__(self, feed, n, sig, deadline, priority="interactive"):
         self.feed = feed
@@ -78,6 +78,8 @@ class _Request:
         self.abandoned = False  # submitter gave up; don't execute/count
         self._lock = threading.Lock()
         self._timeout_counted = False
+        self.trace = None   # tracing.Trace when the request is traced
+        self.qspan = None   # its open queue-wait span
 
     def count_timeout_once(self, metrics) -> None:
         """Waiter and scheduler can both observe the deadline expiring
@@ -152,13 +154,41 @@ class MicroBatcher:
     # -- client side ---------------------------------------------------
     def submit(self, inputs, outputs: Optional[Sequence[str]] = None,
                timeout_ms: Optional[float] = None,
-               priority: str = "interactive") -> Any:
+               priority: str = "interactive", trace=None) -> Any:
         """Enqueue one request and block until its result. Raises
         :class:`~.engine.ClientError` on malformed payloads,
         :class:`QueueFullError` when shedding, and
         :class:`DeadlineExceededError` past the deadline. ``priority``
         is ``"interactive"`` (default) or ``"batch"``; batch-class
-        work is shed first under pressure."""
+        work is shed first under pressure. ``trace`` (a
+        :class:`~..tracing.Trace`, default ``None`` = untraced) records
+        the admission verdict — with the EWMA estimates that drove it —
+        plus queue-wait and device spans."""
+        if trace is not None:
+            return self._submit_traced(inputs, outputs, timeout_ms,
+                                       priority, trace)
+        return self._submit(inputs, outputs, timeout_ms, priority, None)
+
+    def _submit_traced(self, inputs, outputs, timeout_ms, priority,
+                       trace):
+        """Wrap :meth:`_submit` so every shed/timeout path lands the
+        admission verdict in the trace exactly once."""
+        t0 = time.perf_counter()
+        try:
+            return self._submit(inputs, outputs, timeout_ms, priority,
+                                trace)
+        except (QueueFullError, DeadlineExceededError) as e:
+            trace.span(
+                "admission", t_start=t0, verdict="shed",
+                error=str(e),
+                device_ewma_ms=round(self._device_ewma_ms, 3),
+                est_wait_ms=round(
+                    self._est_queue_wait_ms(self._pending_rows), 3)
+            ).end()
+            raise
+
+    def _submit(self, inputs, outputs, timeout_ms, priority,
+                trace) -> Any:
         if priority not in PRIORITIES:
             raise ClientError(
                 f"unknown priority {priority!r}; expected one of "
@@ -210,6 +240,16 @@ class MicroBatcher:
         req = _Request(feed, n, sig,
                        deadline=time.perf_counter() + timeout,
                        priority=priority)
+        if trace is not None:
+            # attach BEFORE enqueue: the scheduler may dequeue the
+            # request the instant it lands
+            req.trace = trace
+            trace.span("admission", t_start=req.t_submit,
+                       verdict="admitted",
+                       est_wait_ms=round(est_wait_ms, 3),
+                       device_ewma_ms=round(self._device_ewma_ms, 3),
+                       rows=n).end()
+            req.qspan = trace.span("queue", rows=n, priority=priority)
         try:
             self._queue.put_nowait(req)
         except queue.Full:
@@ -278,6 +318,11 @@ class MicroBatcher:
             req.count_timeout_once(self.metrics)
             self.metrics.inc("shed_deadline")
             self._rows_done(req.n)
+            if req.trace is not None:
+                req.qspan.end()
+                req.trace.span(
+                    "admission", verdict="expired",
+                    device_ewma_ms=round(self._device_ewma_ms, 3)).end()
             req.event.set()
             return True
         return False
@@ -330,6 +375,9 @@ class MicroBatcher:
         feed = feeds[0] if len(feeds) == 1 else _concat_results(feeds)
         self.metrics.inc("batches")
         self.metrics.batch_hist.record(rows)
+        for r in batch:
+            if r.trace is not None:  # queue wait ends as the batch forms
+                r.qspan.end(batch_rows=rows)
         # live-occupancy gauge for the /stats summary: rows on the
         # device RIGHT NOW (a fleet router reads it to steer load)
         self.metrics.inflight = rows
@@ -372,8 +420,15 @@ class MicroBatcher:
                     r.error = e
                     r.event.set()
                 return
-        dt_ms = (time.perf_counter() - t0) * 1e3
+        t1 = time.perf_counter()
+        dt_ms = (t1 - t0) * 1e3
         self.metrics.device_ms.record(dt_ms)
+        for r in batch:
+            if r.trace is not None:
+                # retroactive: the device window measured above, not a
+                # second clock read per row
+                r.trace.span("device", t_start=t0, t_end=t1,
+                             batch_rows=rows, retries=attempt)
         # feed the adaptive-admission EWMA (scheduler thread only) —
         # but never from a call that paid a lazy XLA compile: one
         # multi-second sample would push the estimate above every

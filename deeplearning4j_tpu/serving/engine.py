@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import time
 from collections import OrderedDict
 from typing import Any, Dict, Optional, Sequence, Tuple
 
@@ -387,11 +388,24 @@ class InferenceEngine:
                          "pass example= to warmup()")
 
     # -- execution -----------------------------------------------------
-    def predict(self, inputs, outputs: Optional[Sequence[str]] = None):
+    def predict(self, inputs, outputs: Optional[Sequence[str]] = None,
+                trace=None):
         """Run one (possibly multi-request) batch. Batches larger than
         ``max_batch_size`` are chunked. Returns numpy results shaped
-        like the model's own ``output(...)``."""
-        return self.predict_normalized(*self.normalize(inputs, outputs))
+        like the model's own ``output(...)``. ``trace`` (a
+        :class:`~..tracing.Trace`, or ``None``) records the device call
+        as one retroactive span — the unbatched direct path's analog of
+        the batcher's per-request device span."""
+        feed, n, sig = self.normalize(inputs, outputs)
+        if trace is None:
+            return self.predict_normalized(feed, n, sig)
+        t0 = time.perf_counter()
+        res = self.predict_normalized(feed, n, sig)
+        trace.span("device", t_start=t0, t_end=time.perf_counter(),
+                   rows=n, bucket=next_bucket(
+                       min(n, self.max_batch_size), self.min_bucket,
+                       self.max_batch_size))
+        return res
 
     def predict_normalized(self, feed, n, sig):
         """Hot-path entry for callers that already hold a normalized

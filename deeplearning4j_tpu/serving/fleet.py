@@ -71,14 +71,18 @@ from __future__ import annotations
 import http.client
 import json
 import queue
+import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+from urllib.parse import parse_qs
 
 from ..faults import poll_until_idle
 from ..profiler import Reservoir
+from ..tracing import Tracer, new_request_id
 from .engine import ServingError
+from .metrics import prometheus_text
 
 #: transport-level failures that justify trying another replica — the
 #: predict path is stateless and generation is seed-deterministic, so
@@ -765,6 +769,21 @@ class FleetRouter:
     replica once). Only transport-level and shed failures are
     retried; 400/404/500/504 are the request's own fate and pass
     through unchanged.
+
+    ``hedge_generate=True`` extends hedging to non-streaming generate
+    requests — generation is seed-deterministic, so a duplicated
+    dispatch wastes decode steps but never changes the answer.
+    ``cooldown_wait_s>0`` lets a request that found every replica in
+    a Retry-After cooldown WAIT (bounded, once) for the nearest
+    cooldown to lapse instead of failing straight to 503.
+
+    Tracing (``tracing=True``, docs/observability.md): router-side
+    spans — ``pick``, ``cooldown_wait``, ``dispatch``, ``retry``,
+    ``hedge`` — are recorded under the propagated request id, so one
+    trace stitches the router's view onto the winning replica's
+    queue/admission/prefill/decode spans. Hedge arms share the trace
+    id with distinct span ids; the losing arm is marked
+    ``discarded``.
     """
 
     def __init__(self, fleet: ReplicaFleet,
@@ -772,7 +791,12 @@ class FleetRouter:
                  hedge_budget_ratio: float = 0.1,
                  hedge_budget_burst: float = 4.0,
                  max_attempts: Optional[int] = None,
-                 timeout_s: float = 60.0):
+                 timeout_s: float = 60.0,
+                 hedge_generate: bool = False,
+                 cooldown_wait_s: float = 0.0,
+                 tracing: bool = False,
+                 trace_ring: int = 256,
+                 trace_slow_ms: float = 1000.0):
         self.fleet = fleet
         self.metrics = fleet.metrics
         self.hedge_after_ms = (None if hedge_after_ms is None
@@ -781,6 +805,12 @@ class FleetRouter:
         self.hedge_budget_burst = float(hedge_budget_burst)
         self.max_attempts = max_attempts
         self.timeout_s = float(timeout_s)
+        self.hedge_generate = bool(hedge_generate)
+        self.cooldown_wait_s = float(cooldown_wait_s)
+        self.tracer = Tracer(enabled=bool(tracing), ring=trace_ring,
+                             slow_ms=trace_slow_ms)
+        self._log_stream = None
+        self._log_lock = threading.Lock()
         self._budget_lock = threading.Lock()
         self._budget = self.hedge_budget_burst
         self._pool = _ConnPool(timeout_s)
@@ -909,32 +939,74 @@ class FleetRouter:
             body = {"error": "unparseable replica response"}
         return status, body
 
-    def post_raw(self, path: str, body: bytes, headers: Dict = None):
+    def post_raw(self, path: str, body: bytes, headers: Dict = None,
+                 trace=None):
         """Bytes-in/bytes-out dispatch (the HTTP front-end's path):
         returns (status, response headers, response bytes).
         ``headers`` are forwarded to the replica on top of the JSON
         content type — the front-end uses this so request-scoped
-        classification (``X-Priority``) survives the proxy hop."""
+        classification (``X-Priority``) survives the proxy hop, and
+        ``X-Request-Id`` stitches router and replica traces. When the
+        router's tracer is on and no ``trace`` was passed (library
+        callers), a trace is minted here under the forwarded request
+        id."""
+        owned = None
+        if trace is None:
+            trace = owned = self.tracer.begin(
+                (headers or {}).get("X-Request-Id"))
+        out = self._dispatch(path, body, headers, trace)
+        if owned is not None:
+            self.tracer.finish(owned, error=out[0] >= 500)
+        return out
+
+    def _dispatch(self, path: str, body: bytes, headers: Dict,
+                  trace):
         self.metrics.inc("requests")
+        is_gen = (path.rstrip("/").endswith("/generate")
+                  or path == "/generate")
         hedge = (self.hedge_after_ms is not None
-                 and not path.rstrip("/").endswith("/generate")
-                 and path != "/generate")
+                 and (self.hedge_generate or not is_gen))
         t0 = time.perf_counter()
         excluded: Set[str] = set()
         last = None
         attempts = 0
+        waited = False
         max_attempts = self.max_attempts or max(1, len(self.fleet.eligible()))
         while attempts < max_attempts:
+            t_pick = time.perf_counter()
             rep = self._pick(excluded)
             if rep is None:
-                break
+                if waited or self.cooldown_wait_s <= 0:
+                    break
+                # nothing routable RIGHT NOW — but a replica merely in
+                # a Retry-After cooldown will take work again shortly;
+                # wait (bounded, once per request) instead of failing
+                waited = True
+                wait_s = self._cooldown_remaining(excluded)
+                if wait_s is None:
+                    break
+                wait_s = min(wait_s, self.cooldown_wait_s)
+                t_w = time.perf_counter()
+                time.sleep(wait_s)
+                if trace is not None:
+                    trace.span("cooldown_wait", t_start=t_w,
+                               t_end=time.perf_counter())
+                continue
+            if trace is not None:
+                trace.span("pick", t_start=t_pick,
+                           t_end=time.perf_counter(), replica=rep.id,
+                           attempt=attempts + 1)
             attempts += 1
             if attempts > 1:
                 self.metrics.inc("retries")
+                if trace is not None:
+                    trace.span("retry", attempt=attempts,
+                               replica=rep.id).end()
             out = (self._attempt_hedged(rep, path, body, excluded,
-                                        headers)
+                                        headers, trace)
                    if hedge else self._attempt_plain(rep, path, body,
-                                                     excluded, headers))
+                                                     excluded, headers,
+                                                     trace))
             if self._retryable(out):
                 last = out
                 continue
@@ -960,50 +1032,90 @@ class FleetRouter:
         return 503, {"Retry-After": "1"}, json.dumps(
             {"error": "no replica available"}).encode()
 
+    def _cooldown_remaining(self, excluded: Set[str]) -> Optional[float]:
+        """Seconds until the NEAREST cooled-down (but otherwise
+        eligible) replica becomes routable again; None when no
+        replica is merely cooling — waiting would not help."""
+        now = time.monotonic()
+        best = None
+        for rep in self.fleet.replicas():
+            if rep.id in excluded or not rep.eligible():
+                continue
+            left = rep.cooldown_until - now
+            if left > 0 and (best is None or left < best):
+                best = left
+        return best
+
     def _attempt_plain(self, rep: Replica, path: str, body: bytes,
-                       excluded: Set[str], headers: Dict = None):
+                       excluded: Set[str], headers: Dict = None,
+                       trace=None):
         """Single-arm dispatch in the calling thread."""
         t_dispatch = time.monotonic()
+        span = (trace.span("dispatch", replica=rep.id)
+                if trace is not None else None)
         try:
             out = self._tracked(rep, path, body, headers)
         except _RETRYABLE_EXC as e:
             if isinstance(e, TimeoutError):
                 # the replica is still working — re-dispatching would
                 # run the request twice and smear a healthy replica
+                if span is not None:
+                    span.end(status=504, error="socket timeout")
                 return _timeout_response(self.timeout_s)
             self.fleet.note_failure(rep)
             excluded.add(rep.id)
+            if span is not None:
+                span.end(error=f"{type(e).__name__}: {e}")
             return e
         self._note(rep, out[0], out[1], t_dispatch)
+        if span is not None:
+            span.end(status=out[0])
         if out[0] == 503:
             excluded.add(rep.id)
         return out
 
     def _attempt_hedged(self, rep: Replica, path: str, body: bytes,
-                        excluded: Set[str], headers: Dict = None):
+                        excluded: Set[str], headers: Dict = None,
+                        trace=None):
         """Primary dispatch with an optional hedge arm: wait
         ``hedge_after_ms`` for the primary; if silent, re-issue to the
         next-best replica (budget permitting) and take whichever
         answers first. Returns the winning (status, headers, data),
-        or a retryable failure when every launched arm failed."""
-        results: "queue.Queue" = queue.Queue()
+        or a retryable failure when every launched arm failed.
 
-        def run(r: Replica):
+        Both arms record spans on the SAME trace (span ids are
+        per-trace atomic, so the concurrent arms need no extra
+        locking); after the race the losing arm's span is marked
+        ``discarded`` — the waste the hedge budget bounds, visible
+        per-request."""
+        results: "queue.Queue" = queue.Queue()
+        spans: Dict[str, Any] = {}
+
+        def run(r: Replica, kind: str):
             t_dispatch = time.monotonic()
+            span = None
+            if trace is not None:
+                span = trace.span(kind, replica=r.id)
+                spans[r.id] = span
             try:
                 out = self._tracked(r, path, body, headers)
                 self._note(r, out[0], out[1], t_dispatch)
+                if span is not None:
+                    span.end(status=out[0])
             except _RETRYABLE_EXC as e:
                 if isinstance(e, TimeoutError):
                     out = _timeout_response(self.timeout_s)
                 else:
                     self.fleet.note_failure(r)
                     out = e
+                if span is not None:
+                    span.end(error=f"{type(e).__name__}: {e}")
             results.put((r, out))
 
-        threading.Thread(target=run, args=(rep,), daemon=True,
-                         name="fleet-primary").start()
+        threading.Thread(target=run, args=(rep, "dispatch"),
+                         daemon=True, name="fleet-primary").start()
         arms = 1
+        hedged_to = None
         first = None
         try:
             first = results.get(timeout=self.hedge_after_ms / 1e3)
@@ -1011,7 +1123,9 @@ class FleetRouter:
             h = self._pick(excluded | {rep.id})
             if h is not None and self._take_budget():
                 self.metrics.inc("hedges")
-                threading.Thread(target=run, args=(h,), daemon=True,
+                hedged_to = h
+                threading.Thread(target=run, args=(h, "hedge"),
+                                 daemon=True,
                                  name="fleet-hedge").start()
                 arms += 1
         if first is None:
@@ -1023,6 +1137,13 @@ class FleetRouter:
             # deliver; losing its answer would turn a hedge into a loss
             winner = results.get()
         rwin, out = winner
+        if trace is not None and arms > 1:
+            # mark the loser's span discarded (it may still be open —
+            # the dump serializes open spans with a null duration)
+            loser = rep if rwin is not rep else hedged_to
+            lspan = spans.get(loser.id) if loser is not None else None
+            if lspan is not None:
+                lspan.attrs["discarded"] = True
         if self._retryable(out):
             excluded.add(r1.id)
             excluded.add(rwin.id)
@@ -1035,7 +1156,8 @@ class FleetRouter:
         return out
 
     # -- streaming -----------------------------------------------------
-    def open_stream(self, path: str, body: bytes, headers: Dict = None):
+    def open_stream(self, path: str, body: bytes, headers: Dict = None,
+                    trace=None):
         """Route a streaming generation: returns
         ``("stream", replica, conn, resp)`` with the response open
         (the caller MUST call ``conn.close()`` + ``replica.end()``
@@ -1049,15 +1171,25 @@ class FleetRouter:
         attempts = 0
         max_attempts = self.max_attempts or max(1, len(self.fleet.eligible()))
         while attempts < max_attempts:
+            t_pick = time.perf_counter()
             rep = self._pick(excluded)
             if rep is None:
                 break
+            if trace is not None:
+                trace.span("pick", t_start=t_pick,
+                           t_end=time.perf_counter(), replica=rep.id,
+                           attempt=attempts + 1, stream=True)
             attempts += 1
             if attempts > 1:
                 self.metrics.inc("retries")
+                if trace is not None:
+                    trace.span("retry", attempt=attempts,
+                               replica=rep.id).end()
             rep.begin()
             self.metrics.inc("routed")
             t_dispatch = time.monotonic()
+            span = (trace.span("dispatch", replica=rep.id, stream=True)
+                    if trace is not None else None)
             conn = http.client.HTTPConnection(rep.host, rep.port,
                                               timeout=self.timeout_s)
             try:
@@ -1068,6 +1200,8 @@ class FleetRouter:
             except _RETRYABLE_EXC as e:
                 conn.close()
                 rep.end()
+                if span is not None:
+                    span.end(error=f"{type(e).__name__}: {e}")
                 if isinstance(e, TimeoutError):
                     st, hdrs, data = _timeout_response(self.timeout_s)
                     self.metrics.inc("server_errors")
@@ -1076,6 +1210,10 @@ class FleetRouter:
                 excluded.add(rep.id)
                 last = None
                 continue
+            if span is not None:
+                # for a stream the span covers dispatch -> first byte
+                # of response headers, not the whole generation
+                span.end(status=resp.status)
             if resp.status != 200:
                 data = resp.read()
                 conn.close()
@@ -1131,6 +1269,20 @@ class FleetRouter:
         analogue of a replica's ``GET /stats``."""
         return {"fleet": self.fleet.snapshot()}
 
+    def _access_log(self, entry: Dict):
+        """One structured JSON access-log line (see :meth:`serve`'s
+        ``log_requests``). Logging failures never fail a request."""
+        stream = self._log_stream
+        if stream is None:
+            return
+        try:
+            line = json.dumps(entry, separators=(",", ":"))
+            with self._log_lock:
+                stream.write(line + "\n")
+                stream.flush()
+        except (OSError, ValueError):
+            pass
+
     def healthy(self) -> bool:
         """Router liveness: at least one admitted replica."""
         return any(r.admitted for r in self.fleet.replicas())
@@ -1141,10 +1293,16 @@ class FleetRouter:
 
     # -- HTTP front-end ------------------------------------------------
     def serve(self, host: str = "127.0.0.1", port: int = 0,
-              max_body_bytes: int = 256 * 1024 * 1024):
+              max_body_bytes: int = 256 * 1024 * 1024,
+              log_requests=False):
         """Start the fleet's own HTTP listener (same route table as a
-        replica, fleet-level probes/stats) and return (host, port)."""
+        replica, fleet-level probes/stats) and return (host, port).
+        ``log_requests`` (off by default) enables a structured JSON
+        access log — ``True`` logs to stderr, any file-like object
+        logs there (same format as the replica's)."""
         router = self
+        self._log_stream = (sys.stderr if log_requests is True
+                            else (log_requests or None))
 
         class _Server(ThreadingHTTPServer):
             request_queue_size = 128
@@ -1156,6 +1314,30 @@ class FleetRouter:
             def log_message(self, *a):
                 pass
 
+            def log_request(self, code="-", size="-"):
+                # one line per response — see InferenceServer's
+                # identically-shaped override
+                if router._log_stream is None:
+                    return
+                try:
+                    status = int(code)
+                except (TypeError, ValueError):
+                    status = str(code)
+                t0 = getattr(self, "_t0", None)
+                entry = {"ts": round(time.time(), 6),
+                         "method": self.command,
+                         "path": self.path,
+                         "status": status,
+                         "latency_ms": round(
+                             (time.perf_counter() - t0) * 1e3, 3)
+                         if t0 is not None else None,
+                         "request_id": getattr(self, "_rid", None),
+                         "priority": getattr(self, "_prio", None)}
+                shed = getattr(self, "_shed", None)
+                if shed is not None:
+                    entry["shed_reason"] = shed
+                router._access_log(entry)
+
             def _json(self, obj, code=200, headers=None):
                 body = (obj if isinstance(obj, bytes)
                         else json.dumps(obj).encode())
@@ -1163,6 +1345,9 @@ class FleetRouter:
                     self.send_response(code)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(body)))
+                    rid = getattr(self, "_rid", None)
+                    if rid:
+                        self.send_header("X-Request-Id", rid)
                     for k, v in (headers or {}).items():
                         self.send_header(k, v)
                     self.end_headers()
@@ -1173,22 +1358,48 @@ class FleetRouter:
                     # must not traceback-spam stderr per occurrence
                     self.close_connection = True
 
-            def do_GET(self):
+            def _text(self, body: str, code=200):
+                data = body.encode()
                 try:
-                    if self.path == "/stats":
+                    self.send_response(code)
+                    self.send_header("Content-Type", "text/plain; "
+                                     "version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                except OSError:
+                    self.close_connection = True
+
+            def do_GET(self):
+                self._t0 = time.perf_counter()
+                self._rid = self.headers.get("X-Request-Id")
+                path, _, query = self.path.partition("?")
+                try:
+                    if path == "/stats":
                         self._json(router.stats())
-                    elif self.path == "/healthz":
+                    elif path == "/metrics":
+                        self._text(prometheus_text(router.stats()))
+                    elif path == "/debug/traces":
+                        q = parse_qs(query)
+                        rid = (q.get("request_id") or q.get("id")
+                               or [None])[0]
+                        limit = int((q.get("limit") or [50])[0])
+                        self._json({
+                            "traces": router.tracer.dump(
+                                request_id=rid, limit=limit),
+                            "tracer": router.tracer.snapshot()})
+                    elif path == "/healthz":
                         ok = router.healthy()
                         self._json({"status": "ok" if ok else
                                     "no replicas"}, 200 if ok else 503)
-                    elif self.path == "/readyz":
+                    elif path == "/readyz":
                         if router.ready():
                             self._json({"ready": True})
                         else:
                             self._json({"ready": False,
                                         "reason": "no eligible replica"},
                                        503, headers={"Retry-After": "1"})
-                    elif self.path in ("/v1/models", "/v1/models/"):
+                    elif path in ("/v1/models", "/v1/models/"):
                         rep = router._pick(set())
                         if rep is None:
                             self._json({"error": "no replica available"},
@@ -1204,6 +1415,16 @@ class FleetRouter:
                     self._json({"error": str(e)}, 500)
 
             def do_POST(self):
+                self._t0 = time.perf_counter()
+                # the front-end is where a request id is born (unless
+                # the client brought one): the SAME id is forwarded to
+                # whichever replicas this request touches, so the
+                # router's spans and the winning replica's spans land
+                # under one trace id
+                self._rid = (self.headers.get("X-Request-Id")
+                             or new_request_id())
+                self._prio = self.headers.get("X-Priority")
+                self._shed = None
                 # same keep-alive body discipline as InferenceServer:
                 # bad/oversized bodies must not desync or OOM
                 if self.headers.get("Transfer-Encoding"):
@@ -1224,20 +1445,31 @@ class FleetRouter:
                     self.close_connection = True
                     return
                 raw = self.rfile.read(n)
+                path, _, query = self.path.partition("?")
                 # X-Priority carries the request's shed class — the
                 # one client header with routing semantics; it must
                 # survive the proxy hop or every fronted request
                 # silently becomes interactive
-                fwd = {}
+                fwd = {"X-Request-Id": self._rid}
                 prio = self.headers.get("X-Priority")
                 if prio is not None:
                     fwd["X-Priority"] = prio
+                # ?trace=1 on the QUERY (not the body — the router
+                # must not pay a parse of predict bodies) forces a
+                # trace even when the router tracer is off; the query
+                # is NOT forwarded, so each tier opts in separately
+                want_trace = bool(query
+                                  and "trace=1" in query.split("&"))
+                trace = router.tracer.begin(self._rid,
+                                            force=want_trace)
+                fspan = (trace.span("frontend", path=path)
+                         if trace is not None else None)
                 streaming = False
                 # only generate routes can stream — don't pay a json
                 # parse of (possibly huge) predict bodies just to
                 # sniff a flag they can't carry
-                if self.path == "/generate" or \
-                        self.path.rstrip("/").endswith("/generate"):
+                if path == "/generate" or \
+                        path.rstrip("/").endswith("/generate"):
                     try:
                         req = json.loads(raw)
                         streaming = bool(isinstance(req, dict)
@@ -1245,17 +1477,43 @@ class FleetRouter:
                     except ValueError:
                         pass   # replica answers 400; just forward
                 if streaming:
-                    self._proxy_stream(raw, fwd)
+                    self._proxy_stream(path, raw, fwd, trace, fspan)
                     return
-                status, hdrs, data = router.post_raw(self.path, raw,
-                                                     fwd)
+                status, hdrs, data = router.post_raw(path, raw, fwd,
+                                                     trace=trace)
+                if status in (503, 504):
+                    self._shed = "overload"
                 extra = {}
                 if "Retry-After" in hdrs:
                     extra["Retry-After"] = hdrs["Retry-After"]
+                if trace is not None:
+                    fspan.end(status=status)
+                    router.tracer.finish(trace, error=status >= 500)
+                    if want_trace and status == 200:
+                        # splice the router's spans into the replica's
+                        # ?trace=1 timeline (or create one): the
+                        # response carries the full cross-tier view
+                        try:
+                            body = json.loads(data)
+                            if isinstance(body, dict):
+                                body["router_trace"] = trace.to_dict()
+                                data = json.dumps(body).encode()
+                        except ValueError:
+                            pass
                 self._json(data, status, headers=extra)
 
-            def _proxy_stream(self, raw: bytes, fwd: Dict = None):
-                opened = router.open_stream(self.path, raw, fwd)
+            def _proxy_stream(self, path: str, raw: bytes,
+                              fwd: Dict = None, trace=None,
+                              fspan=None):
+                opened = router.open_stream(path, raw, fwd,
+                                            trace=trace)
+                if trace is not None:
+                    fspan.end(status=(opened[1]
+                                      if opened[0] == "response"
+                                      else 200), stream=True)
+                    router.tracer.finish(
+                        trace, error=(opened[0] == "response"
+                                      and opened[1] >= 500))
                 if opened[0] == "response":
                     _, status, hdrs, data = opened
                     extra = {}

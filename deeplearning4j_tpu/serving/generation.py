@@ -146,7 +146,7 @@ class _GenRequest:
                  "eos_id", "deadline", "priority", "event", "tokens",
                  "error", "finish_reason", "stream_q", "t_submit",
                  "t_first", "t_last", "abandoned", "recoveries", "_lock",
-                 "_timeout_counted")
+                 "_timeout_counted", "trace", "qspan")
 
     def __init__(self, prompt, max_tokens, temperature, top_k, seed,
                  eos_id, deadline, stream: bool,
@@ -175,6 +175,8 @@ class _GenRequest:
         self.recoveries = 0     # recompute-recovery re-admissions
         self._lock = threading.Lock()
         self._timeout_counted = False
+        self.trace = None   # tracing.Trace when the request is traced
+        self.qspan = None   # its open queue-wait span
 
     def count_timeout_once(self, metrics) -> None:
         """The waiter and the scheduler can both observe this request's
@@ -835,16 +837,20 @@ class GenerationEngine:
                  temperature: float = 0.0, top_k: int = 0, seed: int = 0,
                  eos_id: Optional[int] = None,
                  timeout_ms: Optional[float] = None,
-                 priority: str = "interactive") -> Dict[str, Any]:
+                 priority: str = "interactive",
+                 trace=None) -> Dict[str, Any]:
         """Blocking generate: returns ``{"tokens", "prompt_tokens",
         "finish_reason"}``. Raises :class:`~.engine.ClientError` /
         :class:`~.batcher.QueueFullError` /
         :class:`~.batcher.DeadlineExceededError`. ``priority`` is
         ``"interactive"`` (default) or ``"batch"`` (shed first under
-        pressure)."""
+        pressure). ``trace`` (a :class:`~..tracing.Trace`, default
+        ``None`` = untraced) records admission/queue/prefill spans plus
+        a retroactive decode span — the decode loop itself carries no
+        instrumentation, so tracing costs nothing per step."""
         req = self._submit(prompt, max_tokens, temperature, top_k,
                            seed, eos_id, timeout_ms, stream=False,
-                           priority=priority)
+                           priority=priority, trace=trace)
         budget = req.deadline - time.perf_counter()
         if not req.event.wait(budget + 1.0):  # grace for the device call
             req.abandoned = True
@@ -860,7 +866,8 @@ class GenerationEngine:
                temperature: float = 0.0, top_k: int = 0, seed: int = 0,
                eos_id: Optional[int] = None,
                timeout_ms: Optional[float] = None,
-               priority: str = "interactive") -> Iterator[Dict]:
+               priority: str = "interactive",
+               trace=None) -> Iterator[Dict]:
         """Streaming generate: yields ``{"token", "index"}`` per token
         as the scheduler produces it, then ``{"done": True,
         "finish_reason", ...}``. Admission (validation, queue bounds)
@@ -868,19 +875,40 @@ class GenerationEngine:
         to status codes; later failures raise from the iterator."""
         req = self._submit(prompt, max_tokens, temperature, top_k,
                            seed, eos_id, timeout_ms, stream=True,
-                           priority=priority)
+                           priority=priority, trace=trace)
         return _TokenStream(self, req)
 
-    def _submit(self, *args, **kw) -> _GenRequest:
+    def _submit(self, *args, trace=None, **kw) -> _GenRequest:
         """Validate + enqueue, counting pre-admission 5xx here — the
         engine owns ALL of its server_errors accounting (requests that
         never reach the scheduler have no _fail to count them; the
         HTTP layer deliberately counts none for generation)."""
+        t0 = time.perf_counter()
         try:
             req = self._make_request(*args, **kw)
+            if trace is not None:
+                # attach BEFORE enqueue: the scheduler can admit the
+                # request the instant it lands in the queue
+                req.trace = trace
+                trace.span(
+                    "admission", t_start=t0, verdict="admitted",
+                    est_cost_ms=round(self._est_cost_ms(
+                        len(req.prompt), req.max_tokens), 3),
+                    prefill_ms_per_tok=round(
+                        self._prefill_ms_per_tok, 4),
+                    decode_ewma_ms=round(self._decode_ewma_ms, 3)).end()
+                req.qspan = trace.span("queue",
+                                       priority=req.priority)
             self._enqueue(req)
             return req
-        except (ClientError, QueueFullError, DeadlineExceededError):
+        except (ClientError, QueueFullError, DeadlineExceededError) as e:
+            if trace is not None:
+                trace.span(
+                    "admission", t_start=t0, verdict="shed",
+                    error=str(e),
+                    prefill_ms_per_tok=round(
+                        self._prefill_ms_per_tok, 4),
+                    decode_ewma_ms=round(self._decode_ewma_ms, 3)).end()
             raise  # counted via their own counters / client's fault
         except Exception:
             self.metrics.inc("server_errors")
@@ -894,6 +922,32 @@ class GenerationEngine:
         if fi is not None:
             fi.fire(seam)
 
+    def _trace_terminal(self, req: _GenRequest, reason=None, exc=None):
+        """Record the request's terminal span RETROACTIVELY from fields
+        the engine already tracks (t_first/t_last/token count) — this
+        is how the decode hot loop stays entirely free of tracing code
+        while enabled traces still show per-request decode timing and
+        the PR 4 fault counters (recoveries/quarantine)."""
+        tr = req.trace
+        if tr is None:
+            return
+        if req.qspan is not None:
+            req.qspan.end()  # idempotent; covers never-admitted sheds
+        attrs = {"steps": len(req.tokens),
+                 "recoveries": req.recoveries}
+        if reason is not None:
+            attrs["finish_reason"] = reason
+        if exc is not None:
+            attrs["error"] = repr(exc)
+            if isinstance(exc, PoisonRequestError):
+                attrs["quarantined"] = True
+        if req.t_first is not None:
+            end = req.t_last if req.t_last is not None else req.t_first
+            tr.span("decode", t_start=req.t_first, t_end=end, **attrs)
+        else:
+            tr.span("error" if exc is not None else "decode",
+                    **attrs).end()
+
     def _fail(self, req: _GenRequest, exc: BaseException,
               count: bool = True):
         """``count=False`` for graceful-shutdown drains: a deploy
@@ -904,6 +958,7 @@ class GenerationEngine:
             req.count_timeout_once(self.metrics)
         elif count and not isinstance(exc, ClientError):
             self.metrics.inc("server_errors")
+        self._trace_terminal(req, exc=exc)
         if req.stream_q is not None:
             req.stream_q.put(("error", exc))
         req.event.set()
@@ -951,6 +1006,7 @@ class GenerationEngine:
     def _finish(self, slot: int, req: _GenRequest, reason: str):
         req.finish_reason = reason
         self._release_slot(slot)
+        self._trace_terminal(req, reason=reason)
         if req.stream_q is not None:
             req.stream_q.put(("done", reason))
         req.event.set()
@@ -1012,6 +1068,13 @@ class GenerationEngine:
                 # deadline budget gone while queued: shed at dequeue-
                 # admission — zero prefill/decode steps spent on it
                 self.metrics.inc("shed_deadline")
+                if req.trace is not None:
+                    req.trace.span(
+                        "admission", verdict="expired",
+                        prefill_ms_per_tok=round(
+                            self._prefill_ms_per_tok, 4),
+                        decode_ewma_ms=round(
+                            self._decode_ewma_ms, 3)).end()
                 self._fail(req, DeadlineExceededError(
                     "deadline budget exhausted in the generation queue"))
                 continue
@@ -1072,6 +1135,13 @@ class GenerationEngine:
                 # deadline budget gone while queued: shed at dequeue-
                 # admission — zero prefill/decode steps spent on it
                 self.metrics.inc("shed_deadline")
+                if req.trace is not None:
+                    req.trace.span(
+                        "admission", verdict="expired",
+                        prefill_ms_per_tok=round(
+                            self._prefill_ms_per_tok, 4),
+                        decode_ewma_ms=round(
+                            self._decode_ewma_ms, 3)).end()
                 self._fail(req, DeadlineExceededError(
                     "deadline budget exhausted in the generation queue"))
                 continue
@@ -1116,6 +1186,8 @@ class GenerationEngine:
             slot = self._slots.alloc(req)
             assert slot is not None  # guarded by free_count
             self._slot_blocks[slot] = table
+            if req.trace is not None:
+                req.qspan.end()  # queue wait ends at the block claim
             self._prefilling.append(
                 _ChunkState(req, slot, table, tbl_bucket, plan, seq))
             self.metrics.active_slots = self._slots.active_count
@@ -1175,12 +1247,17 @@ class GenerationEngine:
             self._fail(req, e)
             raise CorruptedStateFault(
                 f"prefill chunk device call failed: {e!r}")
-        dt_ms = (time.perf_counter() - t0) * 1e3
+        t1 = time.perf_counter()
+        dt_ms = (t1 - t0) * 1e3
         self.metrics.prefill_ms.record(dt_ms)
         if self.metrics.compiles == c0:
             # a sample that paid a lazy compile would poison the
             # cost-admission estimate for thousands of requests
             self._note_prefill_cost(dt_ms, bucket)
+        if req.trace is not None:
+            req.trace.span("prefill", t_start=t0, t_end=t1,
+                           bucket=bucket, chunk=st.idx,
+                           chunks=len(st.plan))
         self.metrics.inc("prefill_chunks")
         self.metrics.prompt_bucket_hist.record(bucket)
         if not ok:
@@ -1294,6 +1371,9 @@ class GenerationEngine:
                     f"attempts: {why}"))
             else:
                 req.recoveries += 1
+                if req.trace is not None:
+                    req.trace.span("recovery", why=why,
+                                   tokens_kept=len(req.tokens)).end()
                 self._requeue.append(req)
         if self.cache_backend == "paged":
             self._update_block_gauges()
@@ -1303,6 +1383,8 @@ class GenerationEngine:
         # leaves nothing to unwind — _admit re-stashes the request and
         # the loop retries with backoff
         self._hit("prefill")
+        if req.trace is not None:
+            req.qspan.end()  # queue wait ends at the slot claim
         resumed = bool(req.tokens)
         seq = _recovery_seq(req)
         slot = self._slots.alloc(req)
@@ -1341,12 +1423,16 @@ class GenerationEngine:
             self._fail(req, e)
             raise CorruptedStateFault(
                 f"prefill device call failed: {e!r}")
-        dt_ms = (time.perf_counter() - t0) * 1e3
+        t1 = time.perf_counter()
+        dt_ms = (t1 - t0) * 1e3
         self.metrics.prefill_ms.record(dt_ms)
         if self.metrics.compiles == c0:
             # a sample that paid a lazy compile would poison the
             # cost-admission estimate for thousands of requests
             self._note_prefill_cost(dt_ms, bucket)
+        if req.trace is not None:
+            req.trace.span("prefill", t_start=t0, t_end=t1,
+                           bucket=bucket, chunks=1, resumed=resumed)
         self.metrics.inc("prefills")
         self.metrics.prompt_bucket_hist.record(bucket)
         if not ok:

@@ -8,10 +8,12 @@ server returns :meth:`ServingMetrics.snapshot` per model.
 """
 from __future__ import annotations
 
+import re
 import threading
 from typing import Dict, List
 
-from ..profiler import CountHistogram, OpProfiler, RateMeter, Reservoir
+from ..profiler import (RESERVOIR_SNAPSHOT_KEYS, CountHistogram,
+                        OpProfiler, RateMeter, Reservoir)
 
 
 class ServingMetrics:
@@ -244,3 +246,151 @@ def profiler_sections() -> Dict:
     return {name: stats for name, stats in
             OpProfiler.get_instance().timings().items()
             if name.startswith("serving.")}
+
+
+# -- Prometheus text exposition ----------------------------------------
+# `GET /metrics` on both the replica server and the fleet front-end is
+# generated here from the SAME snapshot dicts `GET /stats` serves, so
+# the two views cannot drift: one source of truth, two encodings.
+# Output follows the text exposition format version 0.0.4 (`# TYPE`
+# lines, label escaping, one family per metric name).
+
+#: monotonically increasing snapshot fields -> emitted as counters with
+#: the conventional ``_total`` suffix; every other numeric leaf is a
+#: gauge. Keyed by the LEAF name, so ``faults.retries`` matches
+#: ``retries`` here.
+_PROM_COUNTERS = frozenset({
+    "requests", "responses", "client_errors", "server_errors",
+    "shed", "shed_batch", "shed_deadline", "timeouts",
+    "retries", "recoveries", "quarantined", "drains",
+    "batches", "prefills", "decode_steps", "tokens_generated",
+    "prefill_chunks", "chunked_prefills",
+    "compiles", "hits", "misses", "evictions",
+    "client_disconnects",
+    # fleet-side counters
+    "routed", "hedges", "hedges_won", "hedge_budget_denied",
+    "requests_lost", "ejections", "readmissions", "restarts",
+    "streams", "sheds", "cooldowns", "breaker_trips",
+    "breaker_probes", "breaker_recoveries", "fleet_shed",
+})
+
+_RESERVOIR_KEYS = frozenset(RESERVOIR_SNAPSHOT_KEYS)
+
+
+def _prom_name(*parts: str) -> str:
+    name = "_".join(p for p in parts if p)
+    name = re.sub(r"[^a-zA-Z0-9_]", "_", name)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _prom_escape(value) -> str:
+    return (str(value).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _prom_value(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+class _PromWriter:
+    """Accumulates samples grouped per metric family (the exposition
+    format requires all lines of one name to be contiguous, with the
+    `# TYPE` line first)."""
+
+    def __init__(self):
+        self._families: "Dict[str, Dict]" = {}
+
+    def sample(self, name: str, mtype: str, labels: Dict, value):
+        fam = self._families.get(name)
+        if fam is None:
+            fam = self._families[name] = {"type": mtype, "lines": []}
+        lab = ",".join(f'{k}="{_prom_escape(v)}"'
+                       for k, v in labels.items() if v is not None)
+        fam["lines"].append(
+            f"{name}{{{lab}}} {_prom_value(value)}" if lab
+            else f"{name} {_prom_value(value)}")
+
+    def render(self) -> str:
+        out = []
+        for name, fam in self._families.items():
+            out.append(f"# TYPE {name} {fam['type']}")
+            out.extend(fam["lines"])
+        return "\n".join(out) + "\n" if out else "\n"
+
+
+def _walk(w: _PromWriter, base: str, labels: Dict, obj) -> None:
+    """Recursively flatten a stats snapshot into exposition samples.
+    Reservoir-shaped dicts become summaries (quantile-labelled, plus
+    `_count`); integer-keyed dicts (CountHistograms) become one
+    labelled series; strings are skipped (identity lives in labels)."""
+    if isinstance(obj, bool) or isinstance(obj, (int, float)):
+        if any(base.endswith("_" + c) or base == c
+               for c in _PROM_COUNTERS):
+            w.sample(base + "_total", "counter", labels, obj)
+        else:
+            w.sample(base, "gauge", labels, obj)
+        return
+    if isinstance(obj, dict):
+        if obj and set(obj) == _RESERVOIR_KEYS:
+            for q, key in (("0.5", "p50"), ("0.9", "p90"),
+                           ("0.99", "p99")):
+                w.sample(base, "summary",
+                         {**labels, "quantile": q}, obj[key])
+            w.sample(base + "_count", "summary", labels, obj["count"])
+            w.sample(base + "_mean", "gauge", labels, obj["mean"])
+            w.sample(base + "_max", "gauge", labels, obj["max"])
+            return
+        if obj and all(_is_int_key(k) for k in obj):
+            for k, v in obj.items():
+                w.sample(base, "gauge", {**labels, "bucket": k}, v)
+            return
+        for k, v in obj.items():
+            _walk(w, _prom_name(base, str(k)), labels, v)
+        return
+    if isinstance(obj, (list, tuple)):
+        w.sample(base + "_count", "gauge", labels, len(obj))
+        return
+    # strings / None: identity belongs in labels, not sample values
+
+
+def _is_int_key(k) -> bool:
+    try:
+        int(k)
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
+def prometheus_text(stats: Dict, prefix: str = "dl4j") -> str:
+    """Render a `/stats`-shaped snapshot (replica server or fleet
+    router) as Prometheus text exposition. Replica server snapshots
+    (``{"summary", "models", "profiler"}``) emit per-model families
+    labelled ``{model=...}``; fleet snapshots (``{"fleet": ...}``)
+    emit fleet counters plus per-replica gauges labelled
+    ``{replica=...}``."""
+    w = _PromWriter()
+    if "models" in stats:
+        summary = dict(stats.get("summary") or {})
+        summary.pop("models", None)      # covered by the models block
+        _walk(w, _prom_name(prefix, "server"), {}, summary)
+        for mname, snap in (stats.get("models") or {}).items():
+            _walk(w, _prom_name(prefix, "model"), {"model": mname}, snap)
+        for section, timing in (stats.get("profiler") or {}).items():
+            _walk(w, _prom_name(prefix, "profiler"),
+                  {"section": section}, timing)
+    elif "fleet" in stats:
+        fl = dict(stats["fleet"])
+        replicas = fl.pop("replicas", [])
+        _walk(w, _prom_name(prefix, "fleet"), {}, fl)
+        for rep in replicas:
+            rid = rep.get("id") if isinstance(rep, dict) else None
+            _walk(w, _prom_name(prefix, "replica"),
+                  {"replica": rid}, rep)
+    else:
+        _walk(w, prefix, {}, stats)
+    return w.render()

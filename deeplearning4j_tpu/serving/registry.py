@@ -53,11 +53,11 @@ class ServedModel:
 
     def predict(self, inputs, outputs: Optional[Sequence[str]] = None,
                 timeout_ms: Optional[float] = None,
-                priority: str = "interactive"):
+                priority: str = "interactive", trace=None):
         if self.batcher is not None:
             return self.batcher.submit(inputs, outputs,
                                        timeout_ms=timeout_ms,
-                                       priority=priority)
+                                       priority=priority, trace=trace)
         # direct path (batching=False): synchronous, so timeout_ms has
         # no queue to bound — but request metrics must still flow,
         # including the live-occupancy gauge the /stats summary feeds
@@ -68,7 +68,7 @@ class ServedModel:
         t0 = time.perf_counter()
         m.inc("inflight")
         try:
-            res = self.engine.predict(inputs, outputs)
+            res = self.engine.predict(inputs, outputs, trace=trace)
         finally:
             m.inc("inflight", -1)
         m.inc("responses")

@@ -83,6 +83,19 @@ METRICS = {
     ("extra", "overload", "overload_itl_ms_p99"): "overload_itl_p99_ms",
     ("extra", "overload", "overload_queue_depth_max"):
         "overload_queue_depth_max",
+    # admitted-request latency decomposition from traces (ISSUE 10):
+    # where admitted time went under 2x overload, per component —
+    # "new, skipped" until the next BENCH_*.json records a baseline
+    ("extra", "overload", "latency_queue_ms_p99"):
+        "overload_latency_queue_p99_ms",
+    ("extra", "overload", "latency_admission_ms_p99"):
+        "overload_latency_admission_p99_ms",
+    ("extra", "overload", "latency_device_ms_p99"):
+        "overload_latency_device_p99_ms",
+    # traced-generation throughput (ISSUE 10): tokens/sec with
+    # per-request tracing enabled — guards the <5% overhead claim
+    ("extra", "generation", "traced_tokens_per_sec"):
+        "generation_traced_tokens_per_sec",
     # closed-loop serving tail latency (recorded since BENCH_r05)
     ("extra", "serving", "p99_ms"): "serving_p99_ms",
 }
@@ -96,6 +109,9 @@ LOWER_IS_BETTER = {
     "overload_ttft_p99_ms",
     "overload_itl_p99_ms",
     "overload_queue_depth_max",
+    "overload_latency_queue_p99_ms",
+    "overload_latency_admission_p99_ms",
+    "overload_latency_device_p99_ms",
     "serving_p99_ms",
 }
 

@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""Summarize a ``GET /debug/traces`` dump — the second thing the
+slow-request runbook (docs/observability.md) reaches for, after the
+dump itself.
+
+Input: one or more JSON files, each either a raw ``/debug/traces``
+response (``{"traces": [...], "tracer": {...}}``) or a bare list of
+trace dicts. Passing SEVERAL files merges them by trace id — dump the
+router's ``/debug/traces?request_id=...`` and each replica's into
+separate files and this tool stitches the cross-tier view back
+together, exactly as the propagated ``X-Request-Id`` intended.
+
+Output:
+
+- per-span-kind latency table (count, p50, p99, max) over every
+  closed span in every trace — where fleet time goes in aggregate;
+- the slowest trace's CRITICAL PATH: starting from its root span,
+  repeatedly descend into the longest child (by ``parent_id``), so
+  the one chain of spans that bounded the request's latency reads
+  top to bottom.
+
+Deliberately framework-free: reads JSON only (no jax, no numpy, no
+package imports) — safe to run on a wedged host mid-incident, or on
+a laptop against a dump scp'd out of production.
+
+Usage::
+
+    python tools/trace_report.py dump.json
+    python tools/trace_report.py router.json replica_*.json --json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _pct(xs, p):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(round(p / 100.0 * (len(xs) - 1))))] \
+        if xs else 0.0
+
+
+def load_traces(paths):
+    """Read dump files -> list of trace dicts, merged by trace id.
+    Spans from the same trace in different files concatenate; span
+    ids are namespaced per source file (each tier numbers its spans
+    from 1, so raw ids would collide in a merged trace). Span time
+    OFFSETS stay tier-local — the tiers' monotonic clocks are
+    unrelated, which is why the span tree, not the offsets, carries
+    the cross-tier structure."""
+    by_id = {}
+    order = []
+    for fi, p in enumerate(paths):
+        with open(p) as f:
+            doc = json.load(f)
+        traces = doc.get("traces", doc) if isinstance(doc, dict) else doc
+        if not isinstance(traces, list):
+            raise ValueError(f"{p}: not a /debug/traces dump")
+        for t in traces:
+            tid = t.get("trace_id")
+            spans = []
+            for s in t.get("spans", []):
+                s = dict(s)
+                s["span_id"] = f"{fi}.{s.get('span_id')}"
+                if s.get("parent_id") is not None:
+                    s["parent_id"] = f"{fi}.{s['parent_id']}"
+                spans.append(s)
+            have = by_id.get(tid)
+            if have is None:
+                by_id[tid] = dict(t, spans=spans)
+                order.append(tid)
+                continue
+            have["spans"].extend(spans)
+            if (have.get("duration_ms") or 0) < (t.get("duration_ms")
+                                                 or 0):
+                have["duration_ms"] = t["duration_ms"]
+            have["error"] = bool(have.get("error") or t.get("error"))
+    return [by_id[tid] for tid in order]
+
+
+def kind_stats(traces):
+    """Per-span-kind latency aggregate over all CLOSED spans."""
+    by_kind = {}
+    for t in traces:
+        for s in t.get("spans", []):
+            if s.get("duration_ms") is None:
+                continue
+            by_kind.setdefault(s.get("kind", "?"), []).append(
+                s["duration_ms"])
+    return {k: {"count": len(v),
+                "p50_ms": round(_pct(v, 50), 3),
+                "p99_ms": round(_pct(v, 99), 3),
+                "max_ms": round(max(v), 3)}
+            for k, v in sorted(by_kind.items())}
+
+
+def critical_path(trace):
+    """Root-to-leaf chain of longest spans: from each level's longest
+    span, descend into its longest child (``parent_id`` links). Open
+    spans (duration null — e.g. a discarded hedge arm still in
+    flight when dumped) sort as zero but stay visible."""
+    spans = trace.get("spans", [])
+    if not spans:
+        return []
+    children = {}
+    for s in spans:
+        children.setdefault(s.get("parent_id"), []).append(s)
+    dur = lambda s: s.get("duration_ms") or 0.0
+    path = []
+    # roots are parentless spans; a merged cross-tier trace has one
+    # per tier (router "frontend", replica "http") — start from the
+    # longest, the one that bounded the request
+    node = max(children.get(None, spans), key=dur)
+    while node is not None:
+        path.append(node)
+        kids = children.get(node.get("span_id"))
+        node = max(kids, key=dur) if kids else None
+    return path
+
+
+def report(paths):
+    traces = load_traces(paths)
+    slowest = (max(traces, key=lambda t: t.get("duration_ms") or 0.0)
+               if traces else None)
+    return {
+        "files": list(paths),
+        "n_traces": len(traces),
+        "kinds": kind_stats(traces),
+        "slowest": None if slowest is None else {
+            "trace_id": slowest.get("trace_id"),
+            "request_id": slowest.get("request_id"),
+            "duration_ms": slowest.get("duration_ms"),
+            "error": slowest.get("error"),
+            "n_spans": len(slowest.get("spans", [])),
+            "critical_path": [
+                {"kind": s.get("kind"),
+                 "t_offset_ms": s.get("t_offset_ms"),
+                 "duration_ms": s.get("duration_ms"),
+                 "attrs": s.get("attrs", {})}
+                for s in critical_path(slowest)],
+        },
+    }
+
+
+def _fmt_human(rep):
+    lines = [f"{rep['n_traces']} trace(s) from "
+             f"{len(rep['files'])} file(s)"]
+    if rep["kinds"]:
+        w = max(len(k) for k in rep["kinds"])
+        lines.append(f"{'span kind':<{w}}  {'count':>6} {'p50 ms':>9} "
+                     f"{'p99 ms':>9} {'max ms':>9}")
+        for k, st in rep["kinds"].items():
+            lines.append(f"{k:<{w}}  {st['count']:>6} "
+                         f"{st['p50_ms']:>9.3f} {st['p99_ms']:>9.3f} "
+                         f"{st['max_ms']:>9.3f}")
+    s = rep.get("slowest")
+    if s:
+        lines.append(f"-- slowest trace {s['trace_id']} "
+                     f"({s['duration_ms']} ms, {s['n_spans']} spans"
+                     f"{', ERROR' if s.get('error') else ''})")
+        for hop in s["critical_path"]:
+            d = hop["duration_ms"]
+            attrs = " ".join(f"{k}={v}" for k, v in hop["attrs"].items())
+            lines.append(
+                f"   +{hop['t_offset_ms']:>9.3f} ms  "
+                f"{hop['kind']:<14} "
+                f"{'(open)' if d is None else f'{d:.3f} ms':<12} "
+                f"{attrs}".rstrip())
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="+",
+                    help="/debug/traces dump file(s); several files "
+                         "merge by trace id (router + replicas)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+    try:
+        rep = report(args.paths)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(rep, indent=2))
+    else:
+        print(_fmt_human(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
