@@ -35,7 +35,11 @@ HTTP surface::
     POST /generate                     default generator
     POST /v1/models/<name>/generate    continuous-batching generation
                                        ({"stream": true} -> chunked
-                                       newline-delimited JSON tokens)
+                                       newline-delimited JSON tokens;
+                                       {"session_id": "..."} pins the
+                                       turn's KV blocks for prefix
+                                       reuse on the next turn — paged
+                                       backend, docs/generation.md)
     GET  /v1/models                    registry listing
     GET  /stats                        serving metrics per model, plus
                                        a compact top-level "summary"
@@ -651,6 +655,13 @@ class InferenceServer:
             if not isinstance(priority, str):
                 raise ClientError("'priority' must be a string")
             opts["priority"] = priority
+        session_id = req.get("session_id")
+        if session_id is not None:
+            # length/backend validation stays in the engine — it owns
+            # the session store; here only the JSON type is checked
+            if not isinstance(session_id, str):
+                raise ClientError("'session_id' must be a string")
+            opts["session_id"] = session_id
         return served, req["prompt"], opts
 
     def _generate(self, name: str, req, trace=None) -> dict:

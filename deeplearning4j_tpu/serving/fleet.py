@@ -68,6 +68,7 @@ Docs: ``docs/serving.md`` "Running a fleet".
 """
 from __future__ import annotations
 
+import collections
 import http.client
 import json
 import queue
@@ -156,6 +157,7 @@ class FleetMetrics:
         self.breaker_trips = 0       # closed -> open transitions
         self.breaker_probes = 0      # half-open probe requests admitted
         self.breaker_recoveries = 0  # open/half-open -> closed
+        self.session_affinity_hits = 0  # session routed to its replica
         self.latency_ms = Reservoir(latency_window)
 
     def inc(self, field: str, n: int = 1):
@@ -184,6 +186,7 @@ class FleetMetrics:
             "breaker_trips": self.breaker_trips,
             "breaker_probes": self.breaker_probes,
             "breaker_recoveries": self.breaker_recoveries,
+            "session_affinity_hits": self.session_affinity_hits,
             # share of accepted requests that came back 2xx — the
             # overload-robustness headline: under graceful shedding
             # this stays near 1.0 for ADMITTED work even at 2x load
@@ -817,13 +820,24 @@ class FleetRouter:
         self._live_addrs: Set[Tuple[str, int]] = set()
         self._rr = 0               # tie-break rotation among equals
         self._rr_lock = threading.Lock()
+        # session affinity: session_id -> replica id, LRU-bounded. A
+        # session's KV blocks live on ONE replica (its session store),
+        # so routing the next turn there is the difference between a
+        # prefix hit and a full re-prefill. Advisory only: when the
+        # mapped replica is unroutable the request falls back to the
+        # normal pick and the session re-pins wherever it lands.
+        self._affinity: "collections.OrderedDict[str, str]" = \
+            collections.OrderedDict()
+        self._affinity_cap = 4096
+        self._affinity_lock = threading.Lock()
         self.httpd = None
         self.host: Optional[str] = None
         self.port: Optional[int] = None
         self._http_thread: Optional[threading.Thread] = None
 
     # -- replica selection --------------------------------------------
-    def _pick(self, excluded: Set[str]) -> Optional[Replica]:
+    def _pick(self, excluded: Set[str],
+              prefer: Optional[str] = None) -> Optional[Replica]:
         reps = self.fleet.replicas()
         addrs = {(r.host, r.port) for r in reps}
         if addrs != self._live_addrs:
@@ -836,6 +850,19 @@ class FleetRouter:
                  if r.id not in excluded and self.fleet.routable(r, now)]
         if not cands:
             return None
+        if prefer is not None:
+            # session affinity: the preferred replica holds this
+            # session's KV blocks — take it whenever it is routable,
+            # bypassing the occupancy score (a warm prefix beats a
+            # marginally shorter queue)
+            for r in cands:
+                if r.id != prefer:
+                    continue
+                if r.breaker_state(now) != "half_open" \
+                        or self.fleet.claim_probe(r, now):
+                    self.metrics.inc("session_affinity_hits")
+                    return r
+                break
         with self._rr_lock:
             self._rr += 1
             base = self._rr
@@ -852,6 +879,25 @@ class FleetRouter:
             # excludes one replica)
             return self._pick(excluded | {rep.id})
         return rep
+
+    # -- session affinity ---------------------------------------------
+    def _affinity_get(self, session: Optional[str]) -> Optional[str]:
+        if session is None:
+            return None
+        with self._affinity_lock:
+            rid = self._affinity.get(session)
+            if rid is not None:
+                self._affinity.move_to_end(session)
+            return rid
+
+    def _affinity_note(self, session: Optional[str], rep_id: str):
+        if session is None:
+            return
+        with self._affinity_lock:
+            self._affinity[session] = rep_id
+            self._affinity.move_to_end(session)
+            while len(self._affinity) > self._affinity_cap:
+                self._affinity.popitem(last=False)
 
     # -- hedge budget --------------------------------------------------
     def _take_budget(self) -> bool:
@@ -930,9 +976,17 @@ class FleetRouter:
         """Route one JSON request; returns (status, parsed body).
         Retries sheds/connection failures against other replicas;
         hedges slow predicts. 503 with no replica left to try counts
-        as ``requests_lost``."""
+        as ``requests_lost``. A generate payload carrying
+        ``session_id`` is routed with session affinity — towards the
+        replica whose session store pinned that conversation's KV
+        blocks."""
+        session = (payload.get("session_id")
+                   if isinstance(payload, dict) else None)
+        if not isinstance(session, str) or not session:
+            session = None
         status, _hdrs, data = self.post_raw(path,
-                                            json.dumps(payload).encode())
+                                            json.dumps(payload).encode(),
+                                            session=session)
         try:
             body = json.loads(data) if data else {}
         except ValueError:
@@ -940,7 +994,7 @@ class FleetRouter:
         return status, body
 
     def post_raw(self, path: str, body: bytes, headers: Dict = None,
-                 trace=None):
+                 trace=None, session: Optional[str] = None):
         """Bytes-in/bytes-out dispatch (the HTTP front-end's path):
         returns (status, response headers, response bytes).
         ``headers`` are forwarded to the replica on top of the JSON
@@ -954,13 +1008,13 @@ class FleetRouter:
         if trace is None:
             trace = owned = self.tracer.begin(
                 (headers or {}).get("X-Request-Id"))
-        out = self._dispatch(path, body, headers, trace)
+        out = self._dispatch(path, body, headers, trace, session)
         if owned is not None:
             self.tracer.finish(owned, error=out[0] >= 500)
         return out
 
     def _dispatch(self, path: str, body: bytes, headers: Dict,
-                  trace):
+                  trace, session: Optional[str] = None):
         self.metrics.inc("requests")
         is_gen = (path.rstrip("/").endswith("/generate")
                   or path == "/generate")
@@ -971,10 +1025,11 @@ class FleetRouter:
         last = None
         attempts = 0
         waited = False
+        prefer = self._affinity_get(session)
         max_attempts = self.max_attempts or max(1, len(self.fleet.eligible()))
         while attempts < max_attempts:
             t_pick = time.perf_counter()
-            rep = self._pick(excluded)
+            rep = self._pick(excluded, prefer=prefer)
             if rep is None:
                 if waited or self.cooldown_wait_s <= 0:
                     break
@@ -1016,6 +1071,9 @@ class FleetRouter:
                 (time.perf_counter() - t0) * 1e3)
             if 200 <= status < 300:
                 self.metrics.inc("responses")
+                # the finished turn's blocks are pinned on THIS
+                # replica: steer the session's next turn back here
+                self._affinity_note(session, rep.id)
             elif status < 500:
                 self.metrics.inc("client_errors")
             else:
@@ -1157,7 +1215,7 @@ class FleetRouter:
 
     # -- streaming -----------------------------------------------------
     def open_stream(self, path: str, body: bytes, headers: Dict = None,
-                    trace=None):
+                    trace=None, session: Optional[str] = None):
         """Route a streaming generation: returns
         ``("stream", replica, conn, resp)`` with the response open
         (the caller MUST call ``conn.close()`` + ``replica.end()``
@@ -1169,10 +1227,11 @@ class FleetRouter:
         excluded: Set[str] = set()
         last = None
         attempts = 0
+        prefer = self._affinity_get(session)
         max_attempts = self.max_attempts or max(1, len(self.fleet.eligible()))
         while attempts < max_attempts:
             t_pick = time.perf_counter()
-            rep = self._pick(excluded)
+            rep = self._pick(excluded, prefer=prefer)
             if rep is None:
                 break
             if trace is not None:
@@ -1232,6 +1291,7 @@ class FleetRouter:
                         dict(resp.getheaders()), data)
             self.fleet.note_ok(rep, t_dispatch)
             self.metrics.inc("streams")
+            self._affinity_note(session, rep.id)
             return ("stream", rep, conn, resp)
         self.metrics.inc("requests_lost")
         if last is not None:
@@ -1247,9 +1307,14 @@ class FleetRouter:
         closes the upstream connection, which frees the backing
         replica's slot/blocks exactly like a direct client
         disconnect."""
+        session = None
         if isinstance(payload, dict):
             payload = dict(payload, stream=True)
-        opened = self.open_stream(path, json.dumps(payload).encode())
+            sid = payload.get("session_id")
+            if isinstance(sid, str) and sid:
+                session = sid
+        opened = self.open_stream(path, json.dumps(payload).encode(),
+                                  session=session)
         if opened[0] == "response":
             _, status, _hdrs, data = opened
             try:
@@ -1465,22 +1530,31 @@ class FleetRouter:
                 fspan = (trace.span("frontend", path=path)
                          if trace is not None else None)
                 streaming = False
+                session = None
                 # only generate routes can stream — don't pay a json
                 # parse of (possibly huge) predict bodies just to
-                # sniff a flag they can't carry
+                # sniff a flag they can't carry.  the same sniff pulls
+                # session_id so the router can steer the turn to the
+                # replica that pinned the session's KV blocks
                 if path == "/generate" or \
                         path.rstrip("/").endswith("/generate"):
                     try:
                         req = json.loads(raw)
                         streaming = bool(isinstance(req, dict)
                                          and req.get("stream"))
+                        if isinstance(req, dict):
+                            sid = req.get("session_id")
+                            if isinstance(sid, str) and sid:
+                                session = sid
                     except ValueError:
                         pass   # replica answers 400; just forward
                 if streaming:
-                    self._proxy_stream(path, raw, fwd, trace, fspan)
+                    self._proxy_stream(path, raw, fwd, trace, fspan,
+                                       session=session)
                     return
                 status, hdrs, data = router.post_raw(path, raw, fwd,
-                                                     trace=trace)
+                                                     trace=trace,
+                                                     session=session)
                 if status in (503, 504):
                     self._shed = "overload"
                 extra = {}
@@ -1504,9 +1578,10 @@ class FleetRouter:
 
             def _proxy_stream(self, path: str, raw: bytes,
                               fwd: Dict = None, trace=None,
-                              fspan=None):
+                              fspan=None, session=None):
                 opened = router.open_stream(path, raw, fwd,
-                                            trace=trace)
+                                            trace=trace,
+                                            session=session)
                 if trace is not None:
                     fspan.end(status=(opened[1]
                                       if opened[0] == "response"

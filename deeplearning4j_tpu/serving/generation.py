@@ -59,7 +59,8 @@ from .faults import (CorruptedStateFault, PoisonRequestError,
 from .kvcache import KVCache, SlotTable
 from .metrics import GenerationMetrics
 from .paging import (NULL_BLOCK, BlockAllocator, BlockTable, PagedKVCache,
-                     blocks_for, pow2_bucket)
+                     PrefixIndex, SessionStore, blocks_for, chain_hashes,
+                     pow2_bucket)
 
 _NEG_INF = -1e30
 
@@ -143,15 +144,18 @@ def _recovery_seq(req: "_GenRequest") -> np.ndarray:
 
 class _GenRequest:
     __slots__ = ("prompt", "max_tokens", "temperature", "top_k", "seed",
-                 "eos_id", "deadline", "priority", "event", "tokens",
-                 "error", "finish_reason", "stream_q", "t_submit",
-                 "t_first", "t_last", "abandoned", "recoveries", "_lock",
-                 "_timeout_counted", "trace", "qspan")
+                 "eos_id", "deadline", "priority", "session_id", "event",
+                 "tokens", "error", "finish_reason", "stream_q",
+                 "t_submit", "t_first", "t_last", "abandoned",
+                 "recoveries", "_lock", "_timeout_counted", "trace",
+                 "qspan")
 
     def __init__(self, prompt, max_tokens, temperature, top_k, seed,
                  eos_id, deadline, stream: bool,
-                 priority: str = "interactive"):
+                 priority: str = "interactive",
+                 session_id: Optional[str] = None):
         self.prompt = prompt
+        self.session_id = session_id
         self.max_tokens = max_tokens
         self.temperature = temperature
         self.top_k = top_k
@@ -259,14 +263,17 @@ class _ChunkState:
     ``seq`` is the token prefix the chunks run over: the prompt for a
     fresh admission, or prompt + already-emitted tokens (minus the
     last, whose K/V the next decode step writes) when re-admitted by
-    recompute-recovery."""
+    recompute-recovery. ``start`` is the prefix-cache match length —
+    positions below it hold valid K/V from shared/copied blocks, so
+    the plan's first chunk begins there and ``done_tokens`` counts
+    them as live from the moment of admission."""
 
     __slots__ = ("req", "slot", "table", "tbl_bucket", "plan", "idx",
-                 "seq")
+                 "seq", "start")
 
     def __init__(self, req: "_GenRequest", slot: int, table: BlockTable,
                  tbl_bucket: int, plan: List[Tuple[int, int, int]],
-                 seq: np.ndarray):
+                 seq: np.ndarray, start: int = 0):
         self.req = req
         self.slot = slot
         self.table = table
@@ -274,11 +281,12 @@ class _ChunkState:
         self.plan = plan                  # [(p0, chunk_bucket, len)]
         self.idx = 0
         self.seq = seq
+        self.start = start
 
     @property
     def done_tokens(self) -> int:
         return self.plan[self.idx - 1][0] + self.plan[self.idx - 1][2] \
-            if self.idx else 0
+            if self.idx else self.start
 
 
 # ---------------------------------------------------------------------------
@@ -311,7 +319,15 @@ class GenerationEngine:
       interleaved with decode steps, so a long prompt admitted
       mid-stream cannot stall every other request's inter-token
       latency for more than one chunk. Token outputs are identical to
-      the slot backend (test-asserted).
+      the slot backend (test-asserted). With ``enable_prefix_sharing``
+      (default on), admission matches the prompt against an LRU index
+      of chained-content-hashed full prompt blocks and against
+      ``session_id``-pinned conversation state: matched blocks join
+      the request's table by refcount (skipping their prefill
+      entirely, copy-on-write isolating any mid-block tail), so a
+      fleet-wide system prompt is prefilled once and a chat turn
+      re-prefills only its new suffix (docs/generation.md "Prefix
+      sharing").
     """
 
     def __init__(self, model, num_slots: int = 8,
@@ -325,6 +341,9 @@ class GenerationEngine:
                  block_size: int = 16,
                  num_blocks: Optional[int] = None,
                  prefill_chunk_tokens: Optional[int] = None,
+                 enable_prefix_sharing: bool = True,
+                 prefix_index_capacity: int = 1024,
+                 session_capacity: int = 64,
                  metrics: Optional[GenerationMetrics] = None,
                  fault_injector=None,
                  max_step_retries: int = 3,
@@ -415,8 +434,14 @@ class GenerationEngine:
             self._prefilling: "collections.deque[_ChunkState]" = \
                 collections.deque()
             self._held: Optional[_GenRequest] = None
+            # prefix sharing: chained-hash index over full prompt
+            # blocks + session pins; both are scheduler-thread state
+            self.enable_prefix_sharing = bool(enable_prefix_sharing)
+            self._prefix_index = PrefixIndex(int(prefix_index_capacity))
+            self._sessions = SessionStore(int(session_capacity))
         else:
             self.prefill_chunk_tokens = None
+            self.enable_prefix_sharing = False
         self.metrics = metrics or GenerationMetrics()
         self.metrics.queue_max = int(max_queue)
         self.metrics.num_slots = self.num_slots
@@ -429,6 +454,7 @@ class GenerationEngine:
         if self.cache_backend == "paged":
             self.metrics.block_size = self.block_size
             self.metrics.blocks_total = self._allocator.capacity
+            self.metrics.prefix_sharing = self.enable_prefix_sharing
             self._update_block_gauges()
         self._profiler = OpProfiler.get_instance()
         # exactly two executable kinds: decode (one) + prefill (per
@@ -436,6 +462,7 @@ class GenerationEngine:
         # bounded by len(prompt_buckets), so no LRU is needed.
         self._decode_exe = None
         self._prefill_exe: Dict[int, Any] = {}
+        self._cow_exe = None  # paged + sharing: block device-copy
         self._exe_lock = threading.Lock()
         # K/V caches are DONATED to every prefill/decode call: XLA then
         # updates the cache in place instead of copying the whole
@@ -492,16 +519,44 @@ class GenerationEngine:
 
     def _update_block_gauges(self):
         """Push allocator + liveness gauges into the metrics object
-        (snapshot() reads them lock-free from the stats thread)."""
+        (snapshot() reads them lock-free from the stats thread).
+
+        ``kv_tokens_live`` counts each UNIQUE block once at its
+        maximum valid fill across owners — summing per-owner lengths
+        (the pre-sharing rule) would double-count a shared prefix and
+        drive fragmentation negative. With sharing disabled every
+        block has one owner and this reduces to the old sum."""
         a = self._allocator
         self.metrics.blocks_free = a.free_count
         self.metrics.blocks_peak_used = a.peak_used
+        bs = self.block_size
+        fill: Dict[int, int] = {}
+
+        def cover(blocks, n_tokens):
+            for i, b in enumerate(blocks):
+                f = min(bs, int(n_tokens) - i * bs)
+                if f <= 0:
+                    break
+                if f > fill.get(b, 0):
+                    fill[b] = f
+
         st = self._slots
-        live = int(sum(int(st.pos[s]) + 1 for s in range(self.num_slots)
-                       if st.requests[s] is not None and st.step[s] > 0))
-        live += sum(c.done_tokens for c in self._prefilling)
-        self.metrics.kv_tokens_live = live
-        self.metrics.kv_tokens_allocated = a.used_count * self.block_size
+        for s in range(self.num_slots):
+            if st.requests[s] is not None and st.step[s] > 0:
+                t = self._slot_blocks[s]
+                if t is not None:
+                    cover(t.blocks, int(st.pos[s]) + 1)
+        for c in self._prefilling:
+            cover(c.table.blocks, c.done_tokens)
+        for blocks, n in self._sessions.iter_pins():
+            cover(blocks, n)
+        for b in self._prefix_index.blocks():
+            fill[b] = bs  # indexed blocks are full prompt blocks
+        self.metrics.kv_tokens_live = sum(fill.values())
+        self.metrics.kv_tokens_allocated = a.used_count * bs
+        self.metrics.shared_blocks = a.shared_count
+        self.metrics.prefix_blocks = len(self._prefix_index)
+        self.metrics.sessions_live = len(self._sessions)
 
     # -- executables ---------------------------------------------------
     # Every executable also returns a FINITE-LOGITS flag computed
@@ -632,6 +687,41 @@ class GenerationEngine:
             self._prefill_exe[key] = exe
             return exe
 
+    def _cow_fn(self):
+        def cow(kcs, vcs, src, dst):
+            kcs = [kc.at[dst].set(kc[src]) for kc in kcs]
+            vcs = [vc.at[dst].set(vc[src]) for vc in vcs]
+            return kcs, vcs
+        return cow
+
+    def _get_cow_exe(self):
+        """Copy-on-write executable: duplicate one pool block (all
+        layers, K+V) into another. src/dst are runtime scalars, so ONE
+        executable covers every copy — warmed like the rest, it can
+        never recompile under traffic."""
+        if self._cow_exe is not None:
+            return self._cow_exe
+        with self._exe_lock:
+            if self._cow_exe is not None:
+                return self._cow_exe
+            args = (self._kcs, self._vcs, np.int32(0), np.int32(0))
+            with self._profiler.record("generation.compile"):
+                exe = compile_memoized(self._cow_fn(), args, (0, 1))
+            self.metrics.inc("compiles")
+            self._cow_exe = exe
+            return exe
+
+    def _cow(self, src: int, dst: int):
+        """Device-copy block ``src`` into ``dst`` so the admitted
+        request can write into its private copy while every other
+        reader of ``src`` stays bit-unchanged. The pools are donated;
+        the caller maps a failure here to recompute-recovery exactly
+        like a failed prefill/decode call."""
+        with self._profiler.record("generation.cow"):
+            self._kcs, self._vcs = self._get_cow_exe()(
+                self._kcs, self._vcs, np.int32(src), np.int32(dst))
+            jax.block_until_ready(self._kcs[0])  # surface device faults
+
     def _get_prefill_exe(self, bucket: int):
         exe = self._prefill_exe.get(bucket)
         if exe is not None:
@@ -662,6 +752,8 @@ class GenerationEngine:
         self._get_decode_exe()
         warmed = []
         if self.cache_backend == "paged":
+            if self.enable_prefix_sharing:
+                self._get_cow_exe()
             for c in sorted(set(int(x) for x in (buckets
                                                  or self.chunk_buckets))):
                 if c not in self.chunk_buckets:
@@ -686,11 +778,25 @@ class GenerationEngine:
     # -- client side ---------------------------------------------------
     def _make_request(self, prompt, max_tokens, temperature, top_k, seed,
                       eos_id, timeout_ms, stream,
-                      priority="interactive") -> _GenRequest:
+                      priority="interactive",
+                      session_id=None) -> _GenRequest:
         if priority not in PRIORITIES:
             raise ClientError(
                 f"unknown priority {priority!r}; expected one of "
                 f"{PRIORITIES}")
+        if session_id is not None:
+            if not isinstance(session_id, str) or not session_id:
+                raise ClientError("session_id must be a non-empty "
+                                  "string")
+            if len(session_id) > 256:
+                raise ClientError("session_id must be <= 256 chars")
+            if self.cache_backend != "paged":
+                raise ClientError("session_id requires the paged cache "
+                                  "backend (cache='paged')")
+            if not self.enable_prefix_sharing:
+                raise ClientError(
+                    "session_id requires prefix sharing "
+                    "(enable_prefix_sharing=True)")
         if self._draining:
             # checked before _running: a drained replica answers 503 +
             # Retry-After (retry elsewhere), not 500, for its lifetime
@@ -769,7 +875,7 @@ class GenerationEngine:
         return _GenRequest(prompt, max_tokens, float(temperature),
                            int(top_k), int(seed) & 0xFFFFFFFF, eos_id,
                            time.perf_counter() + timeout, stream,
-                           priority=priority)
+                           priority=priority, session_id=session_id)
 
     def _padded_prefill_len(self, prompt_len: int) -> int:
         """Prompt tokens the device will actually COMPUTE over during
@@ -838,19 +944,25 @@ class GenerationEngine:
                  eos_id: Optional[int] = None,
                  timeout_ms: Optional[float] = None,
                  priority: str = "interactive",
+                 session_id: Optional[str] = None,
                  trace=None) -> Dict[str, Any]:
         """Blocking generate: returns ``{"tokens", "prompt_tokens",
         "finish_reason"}``. Raises :class:`~.engine.ClientError` /
         :class:`~.batcher.QueueFullError` /
         :class:`~.batcher.DeadlineExceededError`. ``priority`` is
         ``"interactive"`` (default) or ``"batch"`` (shed first under
-        pressure). ``trace`` (a :class:`~..tracing.Trace`, default
-        ``None`` = untraced) records admission/queue/prefill spans plus
-        a retroactive decode span — the decode loop itself carries no
+        pressure). ``session_id`` (paged backend with prefix sharing
+        only) pins the finished request's KV blocks in the session
+        store so the conversation's next turn re-prefills only its new
+        suffix — see docs/generation.md "Prefix sharing". ``trace``
+        (a :class:`~..tracing.Trace`, default ``None`` = untraced)
+        records admission/queue/prefill spans plus a retroactive
+        decode span — the decode loop itself carries no
         instrumentation, so tracing costs nothing per step."""
         req = self._submit(prompt, max_tokens, temperature, top_k,
                            seed, eos_id, timeout_ms, stream=False,
-                           priority=priority, trace=trace)
+                           priority=priority, session_id=session_id,
+                           trace=trace)
         budget = req.deadline - time.perf_counter()
         if not req.event.wait(budget + 1.0):  # grace for the device call
             req.abandoned = True
@@ -867,6 +979,7 @@ class GenerationEngine:
                eos_id: Optional[int] = None,
                timeout_ms: Optional[float] = None,
                priority: str = "interactive",
+               session_id: Optional[str] = None,
                trace=None) -> Iterator[Dict]:
         """Streaming generate: yields ``{"token", "index"}`` per token
         as the scheduler produces it, then ``{"done": True,
@@ -875,7 +988,8 @@ class GenerationEngine:
         to status codes; later failures raise from the iterator."""
         req = self._submit(prompt, max_tokens, temperature, top_k,
                            seed, eos_id, timeout_ms, stream=True,
-                           priority=priority, trace=trace)
+                           priority=priority, session_id=session_id,
+                           trace=trace)
         return _TokenStream(self, req)
 
     def _submit(self, *args, trace=None, **kw) -> _GenRequest:
@@ -1005,7 +1119,15 @@ class GenerationEngine:
 
     def _finish(self, slot: int, req: _GenRequest, reason: str):
         req.finish_reason = reason
-        self._release_slot(slot)
+        if (req.session_id is not None
+                and self.cache_backend == "paged"
+                and self.enable_prefix_sharing):
+            # clean finish with a session: pin the blocks for turn N+1
+            # (failure paths — quarantine, deadline, abandonment — all
+            # release via _release_slot and never reach here)
+            self._pin_session(slot, req)
+        else:
+            self._release_slot(slot)
         self._trace_terminal(req, reason=reason)
         if req.stream_q is not None:
             req.stream_q.put(("done", reason))
@@ -1090,12 +1212,17 @@ class GenerationEngine:
             except Exception as e:  # noqa: BLE001 — fail one request
                 self._fail(req, e)
 
-    def _chunk_plan(self, prompt_len: int) -> List[Tuple[int, int, int]]:
+    def _chunk_plan(self, prompt_len: int,
+                    start: int = 0) -> List[Tuple[int, int, int]]:
         """Split a prompt into (start, chunk bucket, valid length)
         pieces: full ``_chunk_cap`` chunks, then the remainder routed
-        to the smallest configured chunk bucket that holds it."""
+        to the smallest configured chunk bucket that holds it.
+        ``start`` > 0 (a prefix-cache match) skips the matched tokens:
+        the first chunk begins mid-prompt, at the same chunk-bucket
+        ladder — prefill position is a runtime scalar, so a partial
+        plan reuses the exact executables the full plan would."""
         plan = []
-        p0 = 0
+        p0 = int(start)
         while p0 < prompt_len:
             rem = prompt_len - p0
             if rem >= self._chunk_cap:
@@ -1107,6 +1234,88 @@ class GenerationEngine:
             p0 += clen
         return plan
 
+    def _match_prefix(self, req: _GenRequest
+                      ) -> Tuple[int, List[int], Optional[int],
+                                 Optional[str]]:
+        """Longest cached prefix of a FRESH admission's prompt →
+        ``(match_len, shared_blocks, cow_src, source)``.
+
+        The session store is consulted first (token-granular: the
+        pinned turn is almost always a strict prefix of the next
+        turn's prompt), then the cross-request index (block-granular
+        via chained hashes). ``shared_blocks`` are matched full blocks
+        the request will READ through its table; ``cow_src`` is the
+        block holding the matched tail when the match ends mid-block —
+        the request must WRITE there from position ``match_len`` on,
+        so admission copies it into a private block first.
+        ``match_len`` is capped at prompt_len - 1: the last prompt
+        position must be computed to sample the first output token.
+        Recovery re-admissions never match — their block budget and
+        token stream are already settled."""
+        if not self.enable_prefix_sharing or req.tokens:
+            return 0, [], None, None
+        bs = self.block_size
+        prompt = req.prompt
+        L = len(prompt)
+        if req.session_id is not None:
+            sess = self._sessions.get(req.session_id)
+            if sess is not None:
+                stored = sess.tokens
+                n = min(len(stored), L - 1)
+                neq = stored[:n] != prompt[:n]
+                m = int(np.argmax(neq)) if neq.any() else n
+                if m > 0:
+                    self.metrics.inc("session_hits")
+                    self.metrics.inc("prefix_hits")
+                    self.metrics.inc("prefix_tokens_matched", m)
+                    shared = sess.blocks[:m // bs]
+                    cow = sess.blocks[m // bs] if m % bs else None
+                    return m, list(shared), cow, "session"
+            self.metrics.inc("session_misses")
+        matched = self._prefix_index.match(chain_hashes(prompt, bs))
+        if not matched:
+            return 0, [], None, None
+        m = len(matched) * bs
+        cow = None
+        if m >= L:
+            # every full block matched and the prompt is block-aligned:
+            # keep the last matched block as a COW source so only the
+            # final prompt position re-prefills (for its logits)
+            m = L - 1
+            matched, cow = matched[:m // bs], matched[m // bs]
+        self.metrics.inc("prefix_hits")
+        self.metrics.inc("prefix_tokens_matched", m)
+        return m, list(matched), cow, "index"
+
+    def _evict_one_pin(self) -> bool:
+        """Release ONE cache pin under block pressure: the LRU prefix-
+        index entry first (one block, finest granularity), then the
+        LRU session. False when nothing is evictable — every block is
+        held by in-flight work."""
+        b = self._prefix_index.evict_lru()
+        if b is not None:
+            self._allocator.free([b])
+            self.metrics.inc("prefix_evictions")
+            return True
+        sess = self._sessions.evict_lru()
+        if sess is not None:
+            self._allocator.free(sess.blocks)
+            self.metrics.inc("session_evictions")
+            return True
+        return False
+
+    def _alloc_with_eviction(self, n: int) -> Optional[List[int]]:
+        """All-or-nothing alloc that reclaims cache pins (prefix index
+        entries, then sessions) under pressure — in-flight requests
+        always outrank opportunistic caching. None only when even a
+        fully-evicted pool cannot cover ``n``."""
+        while True:
+            blocks = self._allocator.alloc(n)
+            if blocks is not None:
+                return blocks
+            if not self._evict_one_pin():
+                return None
+
     def _admit_paged(self):
         """Paged admission: claim a slot AND the request's full
         worst-case block count, all-or-nothing. When blocks run out
@@ -1114,7 +1323,13 @@ class GenerationEngine:
         arrivals first would starve it) until retirements free blocks;
         the engine never admits work it could fail to finish.
         Admission only STARTS the prefill — chunks run interleaved
-        with decode steps in the scheduler loop."""
+        with decode steps in the scheduler loop.
+
+        With prefix sharing, admission first matches the prompt
+        against the session store + prefix index: matched full blocks
+        join the request's table by refcount (no allocation, no
+        prefill), a mid-block match tail is copy-on-write duplicated,
+        and the chunk plan starts at the first unmatched token."""
         while self._running and self._slots.free_count:
             if self._requeue:
                 req = self._requeue.popleft()
@@ -1147,7 +1362,6 @@ class GenerationEngine:
                 continue
             seq = _recovery_seq(req)
             L = len(seq)
-            plan = self._chunk_plan(L)
             # block budget is unchanged by recovery: prefix + remaining
             # generation == prompt + max_tokens positions either way
             need = blocks_for(len(req.prompt) + req.max_tokens,
@@ -1159,8 +1373,20 @@ class GenerationEngine:
                 # retry (or recovery) re-admits it, in order
                 self._requeue.appendleft(req)
                 raise
-            blocks = self._allocator.alloc(need)
-            if blocks is None:
+            match_len, shared, cow_src, source = self._match_prefix(req)
+            pinned = shared + ([cow_src] if cow_src is not None else [])
+            if pinned:
+                # pin the matched blocks BEFORE allocating: the alloc
+                # below may evict the very index/session entries that
+                # own them — without this extra reference an evicted
+                # match would re-enter the free list and come back as
+                # someone's "fresh" block while this request still
+                # reads it
+                self._allocator.share(pinned)
+            fresh = self._alloc_with_eviction(need - len(shared))
+            if fresh is None:
+                if pinned:
+                    self._allocator.free(pinned)
                 if self._held is None:
                     self._held = req
                 else:
@@ -1170,7 +1396,33 @@ class GenerationEngine:
                     # the held one into oblivion
                     self._requeue.appendleft(req)
                 return
+            if cow_src is not None:
+                # the match ends mid-block: the request must write
+                # positions >= match_len into that block, so it gets a
+                # private copy (its first fresh block — table index
+                # len(shared)) and drops its pin on the original
+                try:
+                    self._cow(cow_src, fresh[0])
+                except Exception as e:  # noqa: BLE001 — pools donated
+                    self._requeue.appendleft(req)
+                    raise CorruptedStateFault(
+                        f"copy-on-write device call failed: {e!r}")
+                self._allocator.free([cow_src])
+                self.metrics.inc("cow_copies")
+            blocks = shared + fresh
+            plan = self._chunk_plan(L, start=match_len)
             table = BlockTable(blocks, self.block_size)
+            if req.trace is not None and match_len:
+                full = sum(b for _, b, _ in self._chunk_plan(L))
+                part = sum(b for _, b, _ in plan)
+                req.trace.span(
+                    "prefix_match", source=source,
+                    matched_tokens=match_len,
+                    matched_blocks=len(shared),
+                    cow=cow_src is not None,
+                    saved_est_ms=round(
+                        (full - part) * self._prefill_ms_per_tok,
+                        3)).end()
             # the table bucket must also cover the LAST chunk's padded
             # tail. Its junk writes stay harmless two ways: rows inside
             # the allocation hit positions beyond the live length of
@@ -1189,7 +1441,8 @@ class GenerationEngine:
             if req.trace is not None:
                 req.qspan.end()  # queue wait ends at the block claim
             self._prefilling.append(
-                _ChunkState(req, slot, table, tbl_bucket, plan, seq))
+                _ChunkState(req, slot, table, tbl_bucket, plan, seq,
+                            start=match_len))
             self.metrics.active_slots = self._slots.active_count
             self._update_block_gauges()
 
@@ -1259,6 +1512,7 @@ class GenerationEngine:
                            bucket=bucket, chunk=st.idx,
                            chunks=len(st.plan))
         self.metrics.inc("prefill_chunks")
+        self.metrics.inc("prefill_tokens", clen)
         self.metrics.prompt_bucket_hist.record(bucket)
         if not ok:
             # poison quarantine: this request's own tokens drove the
@@ -1293,12 +1547,62 @@ class GenerationEngine:
         slots.temp[st.slot] = req.temperature
         slots.top_k[st.slot] = req.top_k
         self._tables[st.slot] = st.table.padded(self._blocks_per_seq)
+        if self.enable_prefix_sharing and not resumed:
+            # the prompt's full blocks now hold finished, immutable
+            # K/V (decode writes land at pos >= prompt_len): publish
+            # them for cross-request reuse
+            self._register_prefix(req, st.table)
         self._update_block_gauges()
         if resumed:
             return
         self.metrics.tokens.record(1)
         self._emit(req, first, time.perf_counter())
         self._check_done(st.slot, req, first)
+
+    def _register_prefix(self, req: _GenRequest, table: BlockTable):
+        """Publish a freshly-prefilled prompt's FULL blocks into the
+        prefix index. A newly-registered block gains one reference
+        owned by the index (so it outlives the request); a digest
+        already present keeps its existing block — identical content,
+        and the old block may be mid-read by other tables."""
+        n_full = len(req.prompt) // self.block_size
+        if not n_full:
+            return
+        hashes = chain_hashes(req.prompt, self.block_size)
+        for h, b in zip(hashes, table.blocks[:n_full]):
+            if self._prefix_index.register(h, b):
+                self._allocator.share([b])
+        evicted = self._prefix_index.evict_over_capacity()
+        if evicted:
+            self._allocator.free(evicted)
+            self.metrics.inc("prefix_evictions", len(evicted))
+
+    def _pin_session(self, slot: int, req: _GenRequest):
+        """Transfer a cleanly-finished request's live blocks to the
+        session store instead of freeing them. The store inherits the
+        request's own reference on the kept blocks (ownership moves,
+        refcounts don't); trailing blocks past the K/V-valid prefix
+        (prompt + emitted minus the last token, whose K/V was never
+        written) are freed now. Mirrors :meth:`_release_slot`'s slot
+        bookkeeping."""
+        table = self._slot_blocks[slot]
+        seq = _recovery_seq(req)  # the K/V-valid token prefix
+        keep = blocks_for(len(seq), self.block_size)
+        kept, trailing = table.blocks[:keep], table.blocks[keep:]
+        if trailing:
+            self._allocator.free(trailing)
+        replaced = req.session_id in self._sessions
+        displaced = self._sessions.put(req.session_id, seq, kept)
+        for sess in displaced:
+            self._allocator.free(sess.blocks)
+        evictions = len(displaced) - (1 if replaced else 0)
+        if evictions:
+            self.metrics.inc("session_evictions", evictions)
+        self._slots.free(slot)
+        self._slot_blocks[slot] = None
+        self._tables[slot] = NULL_BLOCK
+        self._update_block_gauges()
+        self.metrics.active_slots = self._slots.active_count
 
     def _poison(self, why: str):
         """LAST RESORT (recovery itself failed): every in-flight
@@ -1313,11 +1617,14 @@ class GenerationEngine:
         self.metrics.active_slots = 0
         if self.cache_backend == "paged":
             # mid-prefill requests hold slots too, so they were failed
-            # above; reset the block bookkeeping wholesale
+            # above; reset the block bookkeeping wholesale — including
+            # the prefix/session pins, whose K/V went with the pools
             self._prefilling.clear()
             self._allocator = BlockAllocator(self.num_blocks)
             self._tables[:] = NULL_BLOCK
             self._slot_blocks = [None] * self.num_slots
+            self._prefix_index.clear()
+            self._sessions.clear()
             self._update_block_gauges()
         self._cache = self._fresh_cache()
         self._kcs = self._cache.ks
@@ -1352,6 +1659,12 @@ class GenerationEngine:
             self._allocator = BlockAllocator(self.num_blocks)
             self._tables[:] = NULL_BLOCK
             self._slot_blocks = [None] * self.num_slots
+            # cached prefixes and session pins died with the pools:
+            # drop the bookkeeping (no frees — the allocator is new)
+            # so post-recovery admissions rebuild refcounts from zero
+            # instead of matching blocks whose K/V no longer exists
+            self._prefix_index.clear()
+            self._sessions.clear()
         self._cache = self._fresh_cache()
         self._kcs = self._cache.ks
         self._vcs = self._cache.vs
@@ -1639,6 +1952,34 @@ class GenerationEngine:
     # -- admin ---------------------------------------------------------
     def stats(self) -> Dict:
         return self.metrics.snapshot()
+
+    def evict_sessions(self) -> int:
+        """Release every session pin, returning how many sessions were
+        evicted. The session store is scheduler-thread state — call
+        only on an idle/drained engine (tests, admin maintenance), not
+        under traffic."""
+        if self.cache_backend != "paged":
+            return 0
+        sessions = self._sessions.clear()
+        for sess in sessions:
+            self._allocator.free(sess.blocks)
+        if sessions:
+            self.metrics.inc("session_evictions", len(sessions))
+        self._update_block_gauges()
+        return len(sessions)
+
+    def clear_prefix_cache(self) -> int:
+        """Release every prefix-index pin, returning how many blocks
+        were unpinned. Same idle-engine-only contract as
+        :meth:`evict_sessions`."""
+        if self.cache_backend != "paged":
+            return 0
+        blocks = self._prefix_index.clear()
+        if blocks:
+            self._allocator.free(blocks)
+            self.metrics.inc("prefix_evictions", len(blocks))
+        self._update_block_gauges()
+        return len(blocks)
 
     def set_fault_injector(self, injector) -> None:
         """Swap the fault injector (``None`` disables injection). The

@@ -155,6 +155,20 @@ class GenerationMetrics:
         self.chunked_prefills = 0      # prompts that spanned >1 chunk
         self.kv_tokens_live = 0        # written positions, live seqs
         self.kv_tokens_allocated = 0   # blocks_used * block_size
+        # prefix sharing + persistent sessions (paged backend only;
+        # docs/generation.md "Prefix sharing")
+        self.prefix_sharing = False    # config flag
+        self.prefix_hits = 0           # admissions that matched a prefix
+        self.session_hits = 0          # ...matched via the session store
+        self.session_misses = 0        # session_id sent, nothing pinned
+        self.prefix_tokens_matched = 0  # prompt tokens served from cache
+        self.prefill_tokens = 0        # prompt tokens actually computed
+        self.cow_copies = 0            # copy-on-write block duplications
+        self.prefix_evictions = 0      # index entries evicted
+        self.session_evictions = 0     # sessions evicted (LRU/pressure)
+        self.shared_blocks = 0         # gauge: blocks with refcount > 1
+        self.prefix_blocks = 0         # gauge: blocks the index pins
+        self.sessions_live = 0         # gauge
         # compile cache: decode + one prefill executable per bucket
         self.compiles = 0
         self.warmed_buckets: List[int] = []
@@ -190,6 +204,20 @@ class GenerationMetrics:
                 "kv_tokens_allocated": alloc,
                 "prefill_chunks": self.prefill_chunks,
                 "chunked_prefills": self.chunked_prefills,
+                "prefix_cache": {
+                    "enabled": self.prefix_sharing,
+                    "prefix_hits": self.prefix_hits,
+                    "session_hits": self.session_hits,
+                    "session_misses": self.session_misses,
+                    "prefix_tokens_matched": self.prefix_tokens_matched,
+                    "prefill_tokens": self.prefill_tokens,
+                    "cow_copies": self.cow_copies,
+                    "prefix_evictions": self.prefix_evictions,
+                    "session_evictions": self.session_evictions,
+                    "shared_blocks": self.shared_blocks,
+                    "prefix_blocks": self.prefix_blocks,
+                    "sessions_live": self.sessions_live,
+                },
             }
         return {
             "cache_backend": self.cache_backend,
@@ -265,6 +293,9 @@ _PROM_COUNTERS = frozenset({
     "retries", "recoveries", "quarantined", "drains",
     "batches", "prefills", "decode_steps", "tokens_generated",
     "prefill_chunks", "chunked_prefills",
+    "prefix_hits", "session_hits", "session_misses",
+    "prefix_tokens_matched", "prefill_tokens", "cow_copies",
+    "prefix_evictions", "session_evictions",
     "compiles", "hits", "misses", "evictions",
     "client_disconnects",
     # fleet-side counters
@@ -272,6 +303,7 @@ _PROM_COUNTERS = frozenset({
     "requests_lost", "ejections", "readmissions", "restarts",
     "streams", "sheds", "cooldowns", "breaker_trips",
     "breaker_probes", "breaker_recoveries", "fleet_shed",
+    "session_affinity_hits",
 })
 
 _RESERVOIR_KEYS = frozenset(RESERVOIR_SNAPSHOT_KEYS)

@@ -33,10 +33,26 @@ Invariants, shared with the slot cache and test-asserted:
   the request stays queued — the engine never admits work it could be
   unable to finish (the alternative, swapping/preemption, trades that
   guarantee for recompute; see docs/generation.md).
+- **Shared blocks are immutable.** A block referenced by more than
+  one owner (another request's table, the prefix index, a session
+  pin) is never written in place: a writer gets a copy-on-write
+  duplicate first (`GenerationEngine._cow` copies it into a fresh
+  block and swaps the writer's table entry), so readers observe
+  bit-identical content for the block's whole shared lifetime.
+
+Prefix sharing (vLLM block sharing + RadixAttention-style reuse,
+PAPERS.md) layers three pieces on the allocator: per-block REFCOUNTS
+(:meth:`BlockAllocator.share` / a decrementing :meth:`~BlockAllocator.
+free`), a :class:`PrefixIndex` mapping chained content hashes of full
+prompt blocks to pool blocks, and a :class:`SessionStore` pinning a
+finished request's prefix+generated blocks under a client-provided
+``session_id`` so the next turn re-prefills only its new suffix.
 """
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+import collections
+import hashlib
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -68,7 +84,15 @@ class BlockAllocator:
     Block 0 (:data:`NULL_BLOCK`) is reserved; ``capacity`` counts only
     allocatable blocks. Allocation is all-or-nothing and LIFO, so a
     just-freed (cache-warm) block is reused first — same policy as the
-    slot table's free list."""
+    slot table's free list.
+
+    Blocks are REFCOUNTED so prefix sharing can hand one physical
+    block to several owners: :meth:`alloc` sets each block's count to
+    1, :meth:`share` bumps it for every additional owner, and
+    :meth:`free` decrements — the block re-enters the free list only
+    when its last owner releases it. ``used_count`` keeps counting
+    UNIQUE blocks (physical pool occupancy), which is what peak/
+    fragmentation accounting must reflect under sharing."""
 
     def __init__(self, num_blocks: int):
         self.num_blocks = int(num_blocks)
@@ -80,6 +104,7 @@ class BlockAllocator:
         # the scheduler thread at every retirement, and a linear scan
         # of the free list there would tax every stream's ITL
         self._free_set = set(self._free)
+        self._refs: Dict[int, int] = {}
         self.peak_used = 0
 
     @property
@@ -94,33 +119,71 @@ class BlockAllocator:
     def used_count(self) -> int:
         return self.capacity - len(self._free)
 
+    @property
+    def shared_count(self) -> int:
+        """Unique blocks currently held by more than one owner."""
+        return sum(1 for c in self._refs.values() if c > 1)
+
+    def ref(self, block: int) -> int:
+        """Current refcount of ``block`` (0 if free/unallocated)."""
+        return self._refs.get(int(block), 0)
+
     def alloc(self, n: int) -> Optional[List[int]]:
-        """Claim ``n`` blocks, or None (claim NOTHING) if fewer than
-        ``n`` are free — the no-over-commit contract."""
+        """Claim ``n`` blocks (each at refcount 1), or None (claim
+        NOTHING) if fewer than ``n`` are free — the no-over-commit
+        contract."""
         if n < 0:
             raise ValueError(f"cannot allocate {n} blocks")
         if n > len(self._free):
             return None
         blocks = [self._free.pop() for _ in range(n)]
         self._free_set.difference_update(blocks)
+        for b in blocks:
+            self._refs[b] = 1
         self.peak_used = max(self.peak_used, self.used_count)
         return blocks
 
+    def share(self, blocks: Sequence[int]):
+        """Add one owner to each (already-allocated) block. Raises if
+        any block is free — sharing can never resurrect a block, so the
+        caller's ordering bug (e.g. freeing matched blocks via eviction
+        before pinning them) surfaces as an error, not aliasing."""
+        for b in blocks:
+            b = int(b)
+            if self._refs.get(b, 0) < 1:
+                raise ValueError(f"cannot share unallocated block {b}")
+        for b in blocks:
+            self._refs[int(b)] += 1
+
     def free(self, blocks: Sequence[int]):
-        """Return blocks to the free list. No zeroing — stale contents
-        stay masked by the next owner's length."""
+        """Drop one owner per block; a block re-enters the free list
+        only at refcount 0. No zeroing — stale contents stay masked by
+        the next owner's length. Validates the WHOLE batch before
+        mutating anything so a bad call can't half-free."""
+        counted: Dict[int, int] = {}
         for b in blocks:
             b = int(b)
             if b == NULL_BLOCK or not 0 < b < self.num_blocks:
                 raise ValueError(f"block {b} is not allocatable")
             if b in self._free_set:
                 raise ValueError(f"double free of block {b}")
-        self._free.extend(int(b) for b in blocks)
-        self._free_set.update(int(b) for b in blocks)
+            counted[b] = counted.get(b, 0) + 1
+            if counted[b] > self._refs.get(b, 0):
+                raise ValueError(f"double free of block {b}")
+        released = []
+        for b in blocks:
+            b = int(b)
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                del self._refs[b]
+                released.append(b)
+        self._free.extend(released)
+        self._free_set.update(released)
 
     def stats(self) -> dict:
         return {"total": self.capacity, "free": self.free_count,
-                "used": self.used_count, "peak_used": self.peak_used}
+                "used": self.used_count, "peak_used": self.peak_used,
+                "shared": self.shared_count}
 
 
 class BlockTable:
@@ -183,3 +246,177 @@ class PagedKVCache:
     def block_nbytes(self) -> int:
         """Bytes one block pins across all layers (K+V)."""
         return self.nbytes() // self.num_blocks
+
+
+def chain_hashes(tokens: Sequence[int], block_size: int) -> List[bytes]:
+    """Chained content hash per FULL block of ``tokens``:
+    ``h_i = blake2b(h_{i-1} || tokens[i*Bs:(i+1)*Bs])``.
+
+    Chaining makes each digest identify the block's content AND its
+    whole prefix, so two requests share block i only when their first
+    ``(i+1)*block_size`` tokens are identical — the property that
+    lets the engine reuse the block's K/V verbatim (K/V are pure
+    per-position projections of the prefix). Partial tail blocks are
+    never hashed: their content is still growing, so they are only
+    shareable via session pins + copy-on-write."""
+    toks = np.ascontiguousarray(np.asarray(tokens, np.int32))
+    bs = int(block_size)
+    out: List[bytes] = []
+    prev = b""
+    for i in range(len(toks) // bs):
+        h = hashlib.blake2b(prev + toks[i * bs:(i + 1) * bs].tobytes(),
+                            digest_size=16).digest()
+        out.append(h)
+        prev = h
+    return out
+
+
+class PrefixIndex:
+    """LRU map from chained block hash → pool block, the cross-request
+    half of prefix sharing (RadixAttention's radix tree flattened to a
+    hash map — chained digests already encode the path, PAPERS.md).
+
+    The index OWNS one reference per registered block (the engine
+    ``share()``s on register, ``free()``s on evict), so an indexed
+    block survives the registering request and stays bit-stable for
+    future matches. Pure bookkeeping: no allocator calls happen here —
+    every method returns the block ids whose ownership changed and the
+    caller settles refcounts, keeping one thread (the scheduler) in
+    charge of allocator state."""
+
+    def __init__(self, capacity: int = 1024):
+        self.capacity = int(capacity)
+        if self.capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self._entries: "collections.OrderedDict[bytes, int]" = \
+            collections.OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def blocks(self) -> Iterator[int]:
+        """All indexed blocks, eviction order first."""
+        return iter(self._entries.values())
+
+    def match(self, hashes: Sequence[bytes]) -> List[int]:
+        """Longest indexed chain prefix of ``hashes`` → its blocks.
+        Matched entries are LRU-touched (a shared system prompt stays
+        hot no matter how old its registration is)."""
+        out: List[int] = []
+        for h in hashes:
+            b = self._entries.get(h)
+            if b is None:
+                break
+            self._entries.move_to_end(h)
+            out.append(b)
+        return out
+
+    def register(self, digest: bytes, block: int) -> bool:
+        """Insert ``digest → block``; True iff the entry is NEW (the
+        caller then owns transferring a reference to the index). An
+        existing entry is kept — its block already holds the content —
+        and merely LRU-touched."""
+        if digest in self._entries:
+            self._entries.move_to_end(digest)
+            return False
+        self._entries[digest] = int(block)
+        return True
+
+    def evict_lru(self) -> Optional[int]:
+        """Drop the least-recently-matched entry; returns its block
+        (caller frees the index's reference) or None when empty."""
+        if not self._entries:
+            return None
+        _, block = self._entries.popitem(last=False)
+        return block
+
+    def evict_over_capacity(self) -> List[int]:
+        """Evict LRU entries until within capacity; returns their
+        blocks for the caller to free."""
+        out: List[int] = []
+        while len(self._entries) > self.capacity:
+            out.append(self._entries.popitem(last=False)[1])
+        return out
+
+    def clear(self) -> List[int]:
+        """Drop every entry; returns all previously indexed blocks."""
+        out = list(self._entries.values())
+        self._entries.clear()
+        return out
+
+
+class Session:
+    """One pinned conversation: the K/V-valid token prefix (prompt +
+    generated tokens whose K/V were actually written) and the blocks
+    holding it. Held by :class:`SessionStore`."""
+    __slots__ = ("tokens", "blocks")
+
+    def __init__(self, tokens: np.ndarray, blocks: List[int]):
+        self.tokens = tokens
+        self.blocks = blocks
+
+
+class SessionStore:
+    """LRU map ``session_id`` → :class:`Session`, the persistent half
+    of prefix sharing: a finished turn's blocks stay pinned (the store
+    owns one reference per block) so the next turn of the same
+    conversation re-prefills only its new suffix.
+
+    Like :class:`PrefixIndex` this is pure bookkeeping — methods
+    return displaced :class:`Session` objects and the caller frees
+    their blocks."""
+
+    def __init__(self, capacity: int = 64):
+        self.capacity = int(capacity)
+        if self.capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self._entries: "collections.OrderedDict[str, Session]" = \
+            collections.OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, session_id: str) -> bool:
+        return session_id in self._entries
+
+    def ids(self) -> List[str]:
+        return list(self._entries.keys())
+
+    def get(self, session_id: str) -> Optional[Session]:
+        """LRU-touching lookup."""
+        sess = self._entries.get(session_id)
+        if sess is not None:
+            self._entries.move_to_end(session_id)
+        return sess
+
+    def put(self, session_id: str, tokens: np.ndarray,
+            blocks: List[int]) -> List[Session]:
+        """Pin a finished turn, displacing (a) the session's previous
+        pin if any and (b) LRU entries past capacity. Returns every
+        displaced Session; the caller frees their blocks."""
+        displaced: List[Session] = []
+        old = self._entries.pop(session_id, None)
+        if old is not None:
+            displaced.append(old)
+        self._entries[session_id] = Session(tokens, blocks)
+        while len(self._entries) > self.capacity:
+            displaced.append(self._entries.popitem(last=False)[1])
+        return displaced
+
+    def evict_lru(self) -> Optional[Session]:
+        """Drop the least-recently-used session; caller frees its
+        blocks. None when empty."""
+        if not self._entries:
+            return None
+        return self._entries.popitem(last=False)[1]
+
+    def clear(self) -> List[Session]:
+        out = list(self._entries.values())
+        self._entries.clear()
+        return out
+
+    def iter_pins(self) -> Iterator[Tuple[List[int], int]]:
+        """(blocks, n_valid_tokens) per live session — the inputs the
+        engine's kv_tokens_live gauge needs."""
+        for sess in self._entries.values():
+            yield sess.blocks, len(sess.tokens)

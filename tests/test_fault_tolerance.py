@@ -381,7 +381,10 @@ class TestChaosPaged:
         assert out == paged_baseline          # token-identical
         assert recoveries == 3
         assert compiles == 0
-        # every block returned to the pool after the storm
+        # every block returned to the pool after the storm — the only
+        # live blocks left are prefix-index pins from post-recovery
+        # registrations; releasing them must reclaim the pool exactly
+        paged_eng.clear_prefix_cache()
         assert paged_eng._allocator.free_count == \
             paged_eng._allocator.capacity
 
@@ -482,7 +485,10 @@ class TestPoisonQuarantine:
             assert isinstance(errs[4], PoisonRequestError)
             assert out[:3] == base_out
             assert eng.metrics.quarantined == 2
-            # quarantine released the poisoned requests' blocks
+            # quarantine released the poisoned requests' blocks (the
+            # healthy requests' full prompt blocks stay pinned in the
+            # prefix index until cleared)
+            eng.clear_prefix_cache()
             assert eng._allocator.free_count == eng._allocator.capacity
             # ...and those blocks still hold the poison's NaN K/V —
             # reusing them must not contaminate fresh requests

@@ -343,6 +343,43 @@ class TestPrometheusExposition:
             router.stop()
             fleet.stop(stop_replicas=True)
 
+    def test_prefix_cache_counters_parse_and_agree_with_stats(
+            self, tiny_lm):
+        """ISSUE 11 parity: the paged engine's prefix-cache block of
+        /stats (hits, COW copies, session gauges) exports 1:1 on
+        /metrics — counters as _total, gauges bare."""
+        srv = InferenceServer(port=0)
+        g = srv.register_generator(
+            "lm", tiny_lm, num_slots=2, max_seq_len=32,
+            prompt_buckets=[8], cache="paged", block_size=8,
+            prefill_chunk_tokens=8)
+        g.warmup()
+        try:
+            prompt = [1, 5, 2, 9, 3, 7, 4, 6, 8, 10, 1, 5, 2, 9, 3, 7]
+            g.generate(prompt, max_tokens=3, timeout_ms=60_000)
+            g.generate(prompt, max_tokens=3, timeout_ms=60_000,
+                       session_id="s1")
+            base = f"http://{srv.host}:{srv.port}"
+            pc = _get_json(base + "/stats")["models"]["lm"]["paged"][
+                "prefix_cache"]
+            assert pc["prefix_hits"] >= 1 and pc["sessions_live"] == 1
+            samples, types = _parse_prometheus(urllib.request.urlopen(
+                base + "/metrics", timeout=30).read().decode())
+            lab = '{model="lm"}'
+            stem = "dl4j_model_paged_prefix_cache_"
+            for leaf in ("prefix_hits", "session_hits",
+                         "session_misses", "prefix_tokens_matched",
+                         "prefill_tokens", "cow_copies",
+                         "prefix_evictions", "session_evictions"):
+                assert samples[(f"{stem}{leaf}_total", lab)] == pc[leaf]
+                assert types[f"{stem}{leaf}_total"] == "counter"
+            for leaf in ("shared_blocks", "prefix_blocks",
+                         "sessions_live"):
+                assert samples[(f"{stem}{leaf}", lab)] == pc[leaf]
+                assert types[f"{stem}{leaf}"] == "gauge"
+        finally:
+            srv.stop()
+
 
 # ---------------------------------------------------------------------
 # structured access log + client_disconnects (satellites a, b)

@@ -305,6 +305,10 @@ class TestPagedEngine:
                 f"request {i} diverged: {a['tokens']} vs {b['tokens']}")
         # reuse really happened: 32 requests > pool concurrency
         assert paged.metrics.blocks_peak_used <= 24
+        # after drain the only live blocks are prefix-index pins —
+        # releasing them must reclaim the pool exactly (a leaked block
+        # would survive the clear)
+        paged.clear_prefix_cache()
         assert paged.stats()["paged"]["blocks_free"] == 24
         # mid-stream chunking really happened
         assert paged.metrics.chunked_prefills >= 1
@@ -390,6 +394,7 @@ class TestPagedEngine:
         for r in results:
             assert r is not None and len(r["tokens"]) == 7
         assert eng.metrics.server_errors == 0
+        eng.clear_prefix_cache()              # release index pins
         assert eng.metrics.blocks_free == 4   # all reclaimed
         eng.stop()
 
@@ -433,7 +438,13 @@ class TestPagedEngine:
         p = s["paged"]
         assert p["block_size"] == 8
         assert p["blocks_total"] > 0
-        assert p["blocks_free"] == p["blocks_total"]  # idle engine
+        # idle engine: everything still held belongs to the prefix
+        # index (the 14-token prompt spans one full 8-token block)
+        assert p["blocks_free"] + p["prefix_cache"]["prefix_blocks"] \
+            == p["blocks_total"]
+        paged_engine.clear_prefix_cache()
+        assert paged_engine.stats()["paged"]["blocks_free"] \
+            == p["blocks_total"]
         assert p["blocks_peak_used"] >= 2             # 14+4 tokens
         assert p["prefill_chunks"] >= 2               # 14 tokens, cap 8
         assert p["chunked_prefills"] >= 1
@@ -577,6 +588,7 @@ class TestPagedStreamDisconnect:
 
     def test_dropped_stream_frees_blocks(self, lm, paged_engine):
         eng = paged_engine
+        eng.clear_prefix_cache()    # drop pins left by earlier tests
         cap = eng._allocator.capacity
         errs0 = eng.metrics.server_errors
         it = eng.stream([1, 2, 3], max_tokens=25, temperature=0.5)
@@ -598,6 +610,7 @@ class TestPagedStreamDisconnect:
     def test_never_started_paged_stream_releases_blocks(
             self, paged_engine):
         eng = paged_engine
+        eng.clear_prefix_cache()    # drop pins left by earlier tests
         cap = eng._allocator.capacity
         it = eng.stream([1, 2], max_tokens=25, temperature=0.5)
         it.close()          # consumer never called next()

@@ -98,6 +98,22 @@ METRICS = {
         "generation_traced_tokens_per_sec",
     # closed-loop serving tail latency (recorded since BENCH_r05)
     ("extra", "serving", "p99_ms"): "serving_p99_ms",
+    # block-level prefix sharing + persistent sessions (ISSUE 11):
+    # shared-prefix burst and multi-turn session legs — "new, skipped"
+    # until the next BENCH_*.json records a baseline, gated after
+    ("extra", "generation", "prefix_hit_rate"): "prefix_hit_rate",
+    ("extra", "generation", "prefix_prefill_tokens_saved_frac"):
+        "prefix_prefill_tokens_saved_frac",
+    ("extra", "generation", "prefix_users_capacity_ratio"):
+        "prefix_users_capacity_ratio",
+    ("extra", "generation", "prefix_kv_bytes_per_request"):
+        "prefix_kv_bytes_per_request",
+    ("extra", "generation", "prefix_ttft_ms_p50"): "prefix_ttft_p50_ms",
+    ("extra", "generation", "prefix_ttft_ms_p99"): "prefix_ttft_p99_ms",
+    ("extra", "generation", "session_ttft_turnN_ms"):
+        "session_ttft_turnN_ms",
+    ("extra", "generation", "session_turnN_speedup"):
+        "session_turnN_speedup",
 }
 
 #: metric NAMES (values of METRICS) where LOWER is better — latency
@@ -113,6 +129,10 @@ LOWER_IS_BETTER = {
     "overload_latency_admission_p99_ms",
     "overload_latency_device_p99_ms",
     "serving_p99_ms",
+    "prefix_kv_bytes_per_request",
+    "prefix_ttft_p50_ms",
+    "prefix_ttft_p99_ms",
+    "session_ttft_turnN_ms",
 }
 
 

@@ -95,6 +95,29 @@ def kind_stats(traces):
             for k, v in sorted(by_kind.items())}
 
 
+def prefix_savings(traces):
+    """Aggregate the engine's ``prefix_match`` spans (emitted at
+    admission when a request reuses cached KV blocks): how many
+    requests hit, how much prefill they skipped, and the estimated
+    milliseconds saved — split by match source (cross-request
+    ``index`` vs persistent ``session``)."""
+    by_src = {}
+    for t in traces:
+        for s in t.get("spans", []):
+            if s.get("kind") != "prefix_match":
+                continue
+            a = s.get("attrs", {})
+            agg = by_src.setdefault(a.get("source", "?"), {
+                "count": 0, "matched_tokens": 0, "cow_copies": 0,
+                "saved_est_ms": 0.0})
+            agg["count"] += 1
+            agg["matched_tokens"] += int(a.get("matched_tokens") or 0)
+            agg["cow_copies"] += 1 if a.get("cow") else 0
+            agg["saved_est_ms"] += float(a.get("saved_est_ms") or 0.0)
+    return {k: dict(v, saved_est_ms=round(v["saved_est_ms"], 3))
+            for k, v in sorted(by_src.items())}
+
+
 def critical_path(trace):
     """Root-to-leaf chain of longest spans: from each level's longest
     span, descend into its longest child (``parent_id`` links). Open
@@ -127,6 +150,7 @@ def report(paths):
         "files": list(paths),
         "n_traces": len(traces),
         "kinds": kind_stats(traces),
+        "prefix_sharing": prefix_savings(traces),
         "slowest": None if slowest is None else {
             "trace_id": slowest.get("trace_id"),
             "request_id": slowest.get("request_id"),
@@ -154,6 +178,14 @@ def _fmt_human(rep):
             lines.append(f"{k:<{w}}  {st['count']:>6} "
                          f"{st['p50_ms']:>9.3f} {st['p99_ms']:>9.3f} "
                          f"{st['max_ms']:>9.3f}")
+    if rep.get("prefix_sharing"):
+        lines.append("-- prefix-cache savings (prefix_match spans)")
+        for src, st in rep["prefix_sharing"].items():
+            lines.append(
+                f"   {src:<8} {st['count']:>5} hit(s)  "
+                f"{st['matched_tokens']:>7} tokens matched  "
+                f"{st['cow_copies']:>4} cow  "
+                f"~{st['saved_est_ms']:.1f} ms prefill saved")
     s = rep.get("slowest")
     if s:
         lines.append(f"-- slowest trace {s['trace_id']} "
