@@ -20,6 +20,11 @@ Serving seams (PR 4; fired by the engines in :mod:`.serving`):
   abandoned, as if the HTTP consumer hung up mid-stream
 - ``latency``       — once per scheduler iteration; a fire sleeps
   ``latency_ms`` instead of raising (injects tail latency, not errors)
+- ``draft``         — immediately before a speculative-decoding draft
+  proposal call (PR 12); corrupting fires cost only the draft cache
+- ``verify``        — immediately before a speculative verification
+  call against the target cache (donated — corrupting fires force
+  recompute-recovery, same blast radius as ``device_step``)
 
 Training seams (this PR; fired by
 :class:`~.parallel.elastic.FaultTolerantTrainer`'s supervised loop):
@@ -71,8 +76,8 @@ import numpy as np
 #: configuration typo and fails loudly at construction rather than
 #: silently never firing
 SEAMS = ("device_step", "prefill", "alloc", "client_disconnect",
-         "latency", "train_step", "data_batch", "checkpoint_io",
-         "preempt")
+         "latency", "draft", "verify", "train_step", "data_batch",
+         "checkpoint_io", "preempt")
 
 
 class FaultError(RuntimeError):

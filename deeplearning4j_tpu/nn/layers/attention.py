@@ -212,6 +212,58 @@ class SelfAttentionLayer(Layer):
         out = att.reshape(B, self.n_out) @ params["Wo"] + params["b"]
         return self.activation(out), k_pool, v_pool
 
+    def apply_verify(self, params, x, k_cache, v_cache, slot, p0,
+                     chunk_len):
+        """Multi-token verification span against the DENSE slot cache —
+        the slot-backend sibling of :meth:`apply_prefill_paged`, used by
+        speculative decoding to score a draft's k proposals (plus the
+        committed current token) in one causal pass. Write the span's
+        K/V at positions ``p0 + i`` of ``slot``'s panel, then attend
+        each row causally over the slot's whole prefix.
+
+        x: [1, C, Cin] span activations (C = verify bucket);
+        k_cache/v_cache: [S, H, T_max, Dh]; slot: scalar int32; p0:
+        scalar int32 global start; chunk_len: scalar int32 valid rows.
+        Padded rows (>= chunk_len) write junk K/V beyond the live
+        length, where every reader's mask keeps it dark and the next
+        accepted write overwrites it — the same no-zeroing stale-tail
+        contract as the paged chunk path (rows past ``T_max`` are
+        dropped by the scatter). Returns (out [1, C, n_out], k_cache,
+        v_cache)."""
+        if not self.causal:
+            raise ValueError("cached decode needs causal=True attention")
+        C = x.shape[1]
+        H = self.n_heads
+        Dh = self.n_out // H
+        xx = x[0]
+        q = (xx @ params["Wq"]).reshape(C, H, Dh)
+        k_t = (xx @ params["Wk"]).reshape(C, H, Dh)
+        v_t = (xx @ params["Wv"]).reshape(C, H, Dh)
+        gpos = p0 + jnp.arange(C)
+        heads = jnp.arange(H)[None, :]
+        k_cache = k_cache.at[slot, heads, gpos[:, None]].set(k_t)
+        v_cache = v_cache.at[slot, heads, gpos[:, None]].set(v_t)
+        # the slot's whole panel is the gathered span: row c (global
+        # position p0+c) sees keys j <= p0+c, exactly the paged math
+        # with the block-table gather replaced by one dense panel
+        kk = k_cache[slot]
+        vv = v_cache[slot]
+        scale = 1.0 / jnp.sqrt(jnp.float32(Dh))
+        s = jnp.einsum("chd,htd->hct", q.astype(jnp.float32),
+                       kk.astype(jnp.float32)) * scale
+        T = kk.shape[1]
+        valid = jnp.arange(T)[None, None, :] <= gpos[None, :, None]
+        s = jnp.where(valid, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        p = jnp.where(valid, p, 0.0)
+        # V beyond what this slot has written is a previous occupant's
+        # stale leavings and may be non-finite; 0 * NaN = NaN, so mask
+        written = (jnp.arange(T) < p0 + C)[None, :, None]
+        vv = jnp.where(written, vv.astype(jnp.float32), 0.0)
+        att = jnp.einsum("hct,htd->chd", p, vv).astype(x.dtype)
+        out = att.reshape(C, self.n_out) @ params["Wo"] + params["b"]
+        return self.activation(out)[None], k_cache, v_cache
+
     def apply_prefill_paged(self, params, x, k_pool, v_pool, block_table,
                             p0, chunk_len):
         """One prefill CHUNK against the paged pool: project the chunk,
@@ -420,6 +472,17 @@ class TransformerEncoderLayer(Layer):
             self._attn_params(params), h, k_pool, v_pool, block_table,
             p0, chunk_len)
         return self._mlp(params, x + att), k_pool, v_pool
+
+    def apply_verify(self, params, x, k_cache, v_cache, slot, p0,
+                     chunk_len):
+        """One verification span through the full block against the
+        dense slot cache (see :meth:`SelfAttentionLayer.apply_verify`)."""
+        from ..functional import layer_norm as _ln
+        h = _ln(x, params["ln1_g"], params["ln1_b"])
+        att, k_cache, v_cache = self.attn.apply_verify(
+            self._attn_params(params), h, k_cache, v_cache, slot, p0,
+            chunk_len)
+        return self._mlp(params, x + att), k_cache, v_cache
 
     def init_carry(self, batch, dtype=jnp.float32):
         return ()

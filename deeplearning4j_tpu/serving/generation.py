@@ -61,6 +61,9 @@ from .metrics import GenerationMetrics
 from .paging import (NULL_BLOCK, BlockAllocator, BlockTable, PagedKVCache,
                      PrefixIndex, SessionStore, blocks_for, chain_hashes,
                      pow2_bucket)
+from .speculative import (make_prime_fn, make_propose_fn,
+                          make_verify_paged_fn, make_verify_slots_fn,
+                          verify_bucket)
 
 _NEG_INF = -1e30
 
@@ -148,7 +151,9 @@ class _GenRequest:
                  "tokens", "error", "finish_reason", "stream_q",
                  "t_submit", "t_first", "t_last", "abandoned",
                  "recoveries", "_lock", "_timeout_counted", "trace",
-                 "qspan")
+                 "qspan", "spec_rounds", "spec_proposed",
+                 "spec_accepted", "spec_emitted", "spec_dt0", "spec_dt1",
+                 "spec_vt0", "spec_vt1")
 
     def __init__(self, prompt, max_tokens, temperature, top_k, seed,
                  eos_id, deadline, stream: bool,
@@ -181,6 +186,17 @@ class _GenRequest:
         self._timeout_counted = False
         self.trace = None   # tracing.Trace when the request is traced
         self.qspan = None   # its open queue-wait span
+        # speculative-decoding participation, aggregated per request so
+        # the terminal trace can rebuild draft/verify spans
+        # retroactively (zero cost in the hot loop beyond 8 stores)
+        self.spec_rounds = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_emitted = 0
+        self.spec_dt0: Optional[float] = None
+        self.spec_dt1: Optional[float] = None
+        self.spec_vt0: Optional[float] = None
+        self.spec_vt1: Optional[float] = None
 
     def count_timeout_once(self, metrics) -> None:
         """The waiter and the scheduler can both observe this request's
@@ -351,7 +367,9 @@ class GenerationEngine:
                  retry_backoff_max_ms: float = 50.0,
                  max_recoveries_per_request: int = 3,
                  stall_timeout_s: float = 30.0,
-                 batch_queue_fraction: float = 0.5):
+                 batch_queue_fraction: float = 0.5,
+                 speculation_k: int = 0,
+                 draft_model=None):
         if getattr(model, "_params", None) is None:
             model.init()
         self.model = model
@@ -366,6 +384,20 @@ class GenerationEngine:
             raise ValueError(
                 f"max_seq_len {self.max_seq_len} exceeds the model's "
                 f"position table ({model.max_seq_len})")
+        # speculative decoding (serving/speculative.py): k = 0 is OFF
+        # (the default — no draft model, no extra executables, the
+        # decode loop is byte-for-byte the non-speculative one)
+        self.speculation_k = int(speculation_k)
+        if self.speculation_k < 0:
+            raise ValueError(f"speculation_k must be >= 0, "
+                             f"got {speculation_k}")
+        if self.speculation_k and \
+                self.speculation_k + 1 >= self.max_seq_len:
+            raise ValueError(
+                f"speculation_k {self.speculation_k} leaves no room "
+                f"under max_seq_len {self.max_seq_len}")
+        self._vbucket = (verify_bucket(self.speculation_k)
+                         if self.speculation_k else 0)
         self.decode_impl = decode_impl
         self.default_timeout_ms = float(default_timeout_ms)
         self.min_prompt_bucket = int(min_prompt_bucket)
@@ -417,9 +449,16 @@ class GenerationEngine:
             self.chunk_buckets = sorted(
                 set(b for b in self.prompt_buckets if b < cap) | {cap})
             # largest per-request table bucket: the last chunk's
-            # bucket can overshoot the allocation by < chunk_cap
+            # bucket can overshoot the allocation by < chunk_cap, and
+            # a speculative verify span's padded tail by < its bucket.
+            # The overshoot MUST stay inside the table (not merely be
+            # masked): an out-of-range gather index clamps to the
+            # table's LAST entry, which for an exactly-sized table is
+            # a REAL block — the padded rows' junk writes would land
+            # in live data
             self._tbl_top = pow2_bucket(
-                blocks_for(self.max_seq_len + cap, self.block_size))
+                blocks_for(self.max_seq_len + max(cap, self._vbucket),
+                           self.block_size))
             self._tbl_buckets = []
             b = 1
             while b <= self._tbl_top:
@@ -451,6 +490,39 @@ class GenerationEngine:
         self._kcs = self._cache.ks
         self._vcs = self._cache.vs
         self._slots = SlotTable(self.num_slots)
+        # -- speculative decoding state -----------------------------
+        self._draft = None
+        self._draft_cache = None
+        self._draft_kcs = self._draft_vcs = None
+        if self.speculation_k:
+            if draft_model is None:
+                from ..zoo.transformer_lm import make_draft_lm
+                draft_model = make_draft_lm(model)
+            if getattr(draft_model, "_params", None) is None:
+                draft_model.init()
+            if draft_model.vocab_size != model.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_model.vocab_size} != target "
+                    f"vocab {model.vocab_size}: the draft must share "
+                    f"the target's tokenizer")
+            if draft_model.max_seq_len < self.max_seq_len:
+                raise ValueError(
+                    f"draft position table ({draft_model.max_seq_len})"
+                    f" shorter than max_seq_len {self.max_seq_len}")
+            self._draft = draft_model
+            self._reset_draft_cache()
+            self.metrics.cache_bytes += self._draft_cache.nbytes()
+            # draft-prime bucket ladder: pow2 steps TOPPED BY
+            # max_seq_len itself (not its pow2 round-up — the draft's
+            # dense cache is exactly max_seq_len deep, and the prime
+            # update slab must fit inside it)
+            self._prime_buckets = []
+            b = self.min_prompt_bucket
+            while b < self.max_seq_len:
+                self._prime_buckets.append(b)
+                b <<= 1
+            self._prime_buckets.append(self.max_seq_len)
+        self.metrics.speculation_k = self.speculation_k
         if self.cache_backend == "paged":
             self.metrics.block_size = self.block_size
             self.metrics.blocks_total = self._allocator.capacity
@@ -463,6 +535,11 @@ class GenerationEngine:
         self._decode_exe = None
         self._prefill_exe: Dict[int, Any] = {}
         self._cow_exe = None  # paged + sharing: block device-copy
+        # speculative executables: one draft-propose, draft-prime per
+        # prime bucket, verify per table bucket (paged) or one (slots)
+        self._draft_exe = None
+        self._draft_prime_exe: Dict[int, Any] = {}
+        self._verify_exe: Dict[Any, Any] = {}
         self._exe_lock = threading.Lock()
         # K/V caches are DONATED to every prefill/decode call: XLA then
         # updates the cache in place instead of copying the whole
@@ -741,13 +818,106 @@ class GenerationEngine:
             self._prefill_exe[bucket] = exe
             return exe
 
+    # -- speculative executables (serving/speculative.py) --------------
+    def _reset_draft_cache(self, disable_lanes: bool = False):
+        """(Re)build the draft model's dense slot cache. Called at
+        construction, after recompute-recovery (the draft replays
+        nothing — lanes re-prime at their next decode entry), and when
+        a draft device call dies mid-flight (its caches were donated;
+        ``disable_lanes`` then drops every lane to plain decode until
+        re-primed, WITHOUT touching the target's state — a draft
+        failure must never cost target work)."""
+        self._draft_cache = KVCache(
+            self._draft.cache_shapes(self.max_seq_len), self.num_slots)
+        self._draft_kcs = self._draft_cache.ks
+        self._draft_vcs = self._draft_cache.vs
+        if disable_lanes:
+            self._slots.spec_ok[:] = False
+
+    def _get_draft_exe(self):
+        """One batched draft-propose executable: k greedy draft steps
+        over ALL slots in a single device call."""
+        if self._draft_exe is not None:
+            return self._draft_exe
+        with self._exe_lock:
+            if self._draft_exe is not None:
+                return self._draft_exe
+            S = self.num_slots
+            args = (self._draft._params, self._draft_kcs,
+                    self._draft_vcs, np.zeros(S, np.int32),
+                    np.zeros(S, np.int32))
+            with self._profiler.record("generation.compile"):
+                exe = compile_memoized(
+                    make_propose_fn(self._draft, self.speculation_k,
+                                    self.decode_impl),
+                    args, (1, 2))
+            self.metrics.inc("compiles")
+            self._draft_exe = exe
+            return exe
+
+    def _get_draft_prime_exe(self, bucket: int):
+        exe = self._draft_prime_exe.get(bucket)
+        if exe is not None:
+            return exe
+        with self._exe_lock:
+            exe = self._draft_prime_exe.get(bucket)
+            if exe is not None:
+                return exe
+            args = (self._draft._params, self._draft_kcs,
+                    self._draft_vcs, np.zeros((1, bucket), np.int32),
+                    np.int32(1), np.int32(0))
+            with self._profiler.record("generation.compile"):
+                exe = compile_memoized(make_prime_fn(self._draft),
+                                       args, (1, 2))
+            self.metrics.inc("compiles")
+            self._draft_prime_exe[bucket] = exe
+            return exe
+
+    def _get_verify_exe(self, tbl_bucket: Optional[int] = None):
+        """Target-side verification executable: per table bucket on
+        the paged backend (the verify span's block table is padded to
+        the same pow2 ladder the chunk prefill uses), a single one on
+        slots."""
+        key = tbl_bucket if self.cache_backend == "paged" else "slots"
+        exe = self._verify_exe.get(key)
+        if exe is not None:
+            return exe
+        with self._exe_lock:
+            exe = self._verify_exe.get(key)
+            if exe is not None:
+                return exe
+            vb = self._vbucket
+            if self.cache_backend == "paged":
+                fn = make_verify_paged_fn(self.model)
+                args = (self.model._params, self._kcs, self._vcs,
+                        np.zeros((1, vb), np.int32), np.int32(0),
+                        np.int32(1),
+                        np.full(tbl_bucket, NULL_BLOCK, np.int32),
+                        np.uint32(0), np.int32(0), np.float32(0.0),
+                        np.int32(0))
+            else:
+                fn = make_verify_slots_fn(self.model)
+                args = (self.model._params, self._kcs, self._vcs,
+                        np.zeros((1, vb), np.int32), np.int32(0),
+                        np.int32(1), np.int32(0), np.uint32(0),
+                        np.int32(0), np.float32(0.0), np.int32(0))
+            with self._profiler.record("generation.compile"):
+                exe = compile_memoized(fn, args, self._donate)
+            self.metrics.inc("compiles")
+            self._verify_exe[key] = exe
+            return exe
+
     def warmup(self, buckets: Optional[Sequence[int]] = None) -> List[int]:
         """AOT-compile the decode executable plus every prefill
         executable, so traffic never compiles. Slots: one prefill per
         prompt bucket (default: all of ``prompt_buckets``). Paged: one
         per (chunk bucket, table bucket) pair — only pairs where the
         table can actually hold the chunk (``tbl * block_size >=
-        chunk``) exist in traffic, so only those are compiled.
+        chunk``) exist in traffic, so only those are compiled. With
+        speculation enabled, also the draft-propose, per-bucket
+        draft-prime, and per-table-bucket verify executables — so a
+        speculative engine is exactly as recompile-free under traffic
+        as a plain one (test-asserted).
         Returns the warmed (chunk-)bucket list."""
         self._get_decode_exe()
         warmed = []
@@ -771,6 +941,16 @@ class GenerationEngine:
                                      f"{self.prompt_buckets}")
                 self._get_prefill_exe(b)
                 warmed.append(b)
+        if self.speculation_k:
+            self._get_draft_exe()
+            for b in self._prime_buckets:
+                self._get_draft_prime_exe(b)
+            if self.cache_backend == "paged":
+                for t in self._tbl_buckets:
+                    if t * self.block_size >= self._vbucket:
+                        self._get_verify_exe(t)
+            else:
+                self._get_verify_exe()
         self.metrics.warmed_buckets = sorted(
             set(self.metrics.warmed_buckets) | set(warmed))
         return warmed
@@ -1061,6 +1241,25 @@ class GenerationEngine:
         else:
             tr.span("error" if exc is not None else "decode",
                     **attrs).end()
+        if req.spec_rounds:
+            # speculative participation, rebuilt retroactively from the
+            # per-request aggregates (the hot loop never touches the
+            # tracer): one draft span + one verify span covering first
+            # to last round, with the accounting as attributes
+            rate = round(req.spec_accepted / max(req.spec_proposed, 1),
+                         4)
+            tr.span("draft", t_start=req.spec_dt0, t_end=req.spec_dt1,
+                    rounds=req.spec_rounds,
+                    proposed=req.spec_proposed)
+            tr.span("verify", t_start=req.spec_vt0, t_end=req.spec_vt1,
+                    rounds=req.spec_rounds,
+                    proposed=req.spec_proposed,
+                    accepted=req.spec_accepted,
+                    accept_rate=rate,
+                    spec_tokens=req.spec_emitted,
+                    saved_est_ms=round(
+                        max(req.spec_emitted - req.spec_rounds, 0)
+                        * self._decode_ewma_ms, 3))
 
     def _fail(self, req: _GenRequest, exc: BaseException,
               count: bool = True):
@@ -1553,6 +1752,12 @@ class GenerationEngine:
             # them for cross-request reuse
             self._register_prefix(req, st.table)
         self._update_block_gauges()
+        if self.speculation_k:
+            # decode entry: prime the draft over the whole committed
+            # prefix. The DRAFT always prefills from scratch — prefix
+            # sharing may have skipped most of the target's prefill,
+            # but the draft shares nothing
+            self._spec_prime(st.slot, st.seq)
         if resumed:
             return
         self.metrics.tokens.record(1)
@@ -1629,6 +1834,8 @@ class GenerationEngine:
         self._cache = self._fresh_cache()
         self._kcs = self._cache.ks
         self._vcs = self._cache.vs
+        if self.speculation_k:
+            self._reset_draft_cache()
 
     def _recover(self, why: str):
         """Recompute-recovery (the vLLM preempt-and-recompute insight:
@@ -1668,6 +1875,11 @@ class GenerationEngine:
         self._cache = self._fresh_cache()
         self._kcs = self._cache.ks
         self._vcs = self._cache.vs
+        if self.speculation_k:
+            # the draft cache may hold donated-away device state too;
+            # it replays nothing — each re-admitted lane re-primes at
+            # its decode entry (spec_ok was cleared with the slots)
+            self._reset_draft_cache()
         now = time.perf_counter()
         for req in recovered:
             if req.abandoned:
@@ -1766,6 +1978,8 @@ class GenerationEngine:
         st.seed[slot] = req.seed
         st.temp[slot] = req.temperature
         st.top_k[slot] = req.top_k
+        if self.speculation_k:
+            self._spec_prime(slot, seq)
         self.metrics.active_slots = st.active_count
         if resumed:
             # the emitted stream stands — the re-sampled first token is
@@ -1786,6 +2000,222 @@ class GenerationEngine:
             if not self._prefill_ms_per_tok else \
             0.8 * self._prefill_ms_per_tok + 0.2 * per_tok
 
+    # -- speculative decoding (serving/speculative.py) -----------------
+    def _spec_prime(self, slot: int, seq: np.ndarray):
+        """Prefill the DRAFT over a lane's committed prefix at decode
+        entry, marking the lane speculation-eligible on success. Any
+        draft-side failure here — compile, device call, non-finite
+        draft logits — costs speculation only, never the request: the
+        lane (or, after a donation-destroying call failure, every
+        lane until re-primed) simply decodes plainly."""
+        seq = np.asarray(seq, np.int32)
+        L = len(seq)
+        bucket = next(b for b in self._prime_buckets if b >= L)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :L] = seq
+        try:
+            ok, self._draft_kcs, self._draft_vcs = \
+                self._get_draft_prime_exe(bucket)(
+                    self._draft._params, self._draft_kcs,
+                    self._draft_vcs, tokens, np.int32(L),
+                    np.int32(slot))
+            ok = bool(np.asarray(ok))
+        except Exception:  # noqa: BLE001 — draft caches were donated
+            # to the dead call: rebuild them; the target is untouched
+            self._reset_draft_cache(disable_lanes=True)
+            self.metrics.inc("spec_draft_fallbacks")
+            return
+        self._slots.spec_ok[slot] = ok
+        if not ok:
+            self.metrics.inc("spec_draft_fallbacks")
+
+    def _spec_cow_guard(self, slot: int, p0: int) -> bool:
+        """Copy-on-write isolation BEFORE any speculative write: a
+        verify span scatters K/V across ``[p0, p0 + vbucket)`` (plus
+        the passenger decode write at ``p0 + emitted``), and none of
+        those positions may land in a block other tables still read.
+        Today's sharing paths only ever share prompt-prefix blocks —
+        always below a decode cursor — but the guard is cheap
+        (refcount loads) and makes speculation safe against ANY future
+        sharing pattern. False = could not isolate (pool exhausted):
+        the caller skips speculation for this lane this round."""
+        table = self._slot_blocks[slot]
+        bs = self.block_size
+        last = min((p0 + self._vbucket) // bs, len(table.blocks) - 1)
+        for i in range(p0 // bs, last + 1):
+            b = table.blocks[i]
+            if self._allocator.ref(b) <= 1:
+                continue
+            fresh = self._alloc_with_eviction(1)
+            if fresh is None:
+                return False
+            try:
+                self._cow(b, fresh[0])
+            except Exception as e:  # noqa: BLE001 — pools donated
+                raise CorruptedStateFault(
+                    f"speculative COW device copy failed: {e!r}")
+            self._allocator.free([b])
+            table.blocks[i] = fresh[0]
+            self._tables[slot] = table.padded(self._blocks_per_seq)
+            self.metrics.inc("cow_copies")
+        return True
+
+    def _spec_step(self) -> frozenset:
+        """One speculative round: ONE batched draft call proposes k
+        tokens for every eligible lane, then each lane's proposals are
+        verified in ONE target forward over the chunk-ladder kernels.
+        Returns the slots whose cursors this round advanced (the plain
+        decode step skips them). Acceptance, rollback, and the
+        bit-identity contract live in `serving/speculative.py`."""
+        st = self._slots
+        k = self.speculation_k
+        lanes = []
+        for s in self._ready_slots():
+            if not st.spec_ok[s]:
+                continue
+            req = st.requests[s]
+            # a lane within k tokens of its budget plain-decodes to
+            # the finish line: every verify span then has full width,
+            # and speculative writes can never run past the lane's
+            # block allocation / slot capacity
+            if req.max_tokens - len(req.tokens) >= k + 1:
+                lanes.append(s)
+        if not lanes:
+            return frozenset()
+        # -- draft: one batched proposal call for all lanes ---------
+        t0 = time.perf_counter()
+        try:
+            # the injection seam lives INSIDE the except scope: any
+            # draft-side fault — injected or real, transient or
+            # corrupting — costs speculation only, never a recovery
+            self._hit("draft")
+            with self._profiler.record("generation.spec_draft"):
+                props, dok, self._draft_kcs, self._draft_vcs = \
+                    self._get_draft_exe()(
+                        self._draft._params, self._draft_kcs,
+                        self._draft_vcs, st.token.copy(),
+                        st.pos.copy())
+                props = np.asarray(props)
+                dok = np.asarray(dok)
+        except Exception:  # noqa: BLE001 — the draft call died with
+            # ITS OWN caches donated; the target state is intact, so
+            # this costs speculation (until lanes re-prime), never
+            # recovery and never a request
+            self._reset_draft_cache(disable_lanes=True)
+            self.metrics.inc("spec_draft_fallbacks", len(lanes))
+            return frozenset()
+        t1 = time.perf_counter()
+        # -- verify: one target forward per lane --------------------
+        vb = self._vbucket
+        paged = self.cache_backend == "paged"
+        serviced = set()
+        emitted = 0
+        itl: List[float] = []
+        for s in lanes:
+            req = st.requests[s]
+            if not dok[s]:
+                # draft NaN: fail ONLY speculation for this lane — it
+                # decodes plainly from here on (re-primes on recovery)
+                st.spec_ok[s] = False
+                self.metrics.inc("spec_draft_fallbacks")
+                continue
+            p0 = int(st.pos[s])
+            tokens = np.zeros((1, vb), np.int32)
+            tokens[0, 0] = st.token[s]
+            tokens[0, 1:k + 1] = props[s, :k]
+            if paged:
+                if not self._spec_cow_guard(s, p0):
+                    continue
+                table = self._slot_blocks[s]
+                # the padded table must COVER the span's padded tail:
+                # an out-of-range gather clamps to the table's last
+                # entry — a real block — so junk rows would otherwise
+                # write into live data
+                tv = pow2_bucket(
+                    max(blocks_for(p0 + vb, self.block_size),
+                        len(table.blocks)), cap=self._tbl_top)
+                extra = (table.padded(tv),)
+            else:
+                extra = (np.int32(s),)
+            self._hit("verify")
+            v0 = time.perf_counter()
+            try:
+                with self._profiler.record("generation.spec_verify"):
+                    tgt, n_acc, vok, self._kcs, self._vcs = \
+                        self._get_verify_exe(tv if paged else None)(
+                            self.model._params, self._kcs, self._vcs,
+                            tokens, np.int32(p0), np.int32(k + 1),
+                            *extra, np.uint32(req.seed),
+                            np.int32(st.step[s]),
+                            np.float32(req.temperature),
+                            np.int32(req.top_k))
+                    tgt = np.asarray(tgt)
+                    n_acc = int(np.asarray(n_acc))
+                    vok = bool(np.asarray(vok))
+            except Exception as e:  # noqa: BLE001 — the TARGET pools
+                # were donated to the dead call: same attribution as a
+                # failed prefill chunk — fail this request alone, then
+                # recompute-recover everyone else
+                self._release_slot(s)
+                self._fail(req, e)
+                raise CorruptedStateFault(
+                    f"speculative verify device call failed: {e!r}")
+            v1 = time.perf_counter()
+            if not vok:
+                # the TARGET's logits went non-finite on this lane's
+                # own tokens: the standard poison quarantine, exactly
+                # as a plain decode step would rule
+                self.metrics.inc("quarantined")
+                exc = PoisonRequestError(
+                    "request produced non-finite logits during "
+                    f"speculative verify at step {int(st.step[s])}; "
+                    "quarantined")
+                self._release_slot(s)
+                self._fail(req, exc)
+                continue
+            n_emit = n_acc + 1
+            self.metrics.inc("spec_verify_batches")
+            self.metrics.inc("spec_draft_tokens_proposed", k)
+            self.metrics.inc("spec_draft_tokens_accepted", n_acc)
+            if n_acc < k:
+                # rejected tail: rolled back by NOT committing it —
+                # the draft cursor and the target write position both
+                # rewind for free because pos is the only commit
+                # pointer and stale K/V past it stays masked
+                self.metrics.inc("spec_rollbacks")
+            req.spec_rounds += 1
+            req.spec_proposed += k
+            req.spec_accepted += n_acc
+            req.spec_emitted += n_emit
+            if req.spec_dt0 is None:
+                req.spec_dt0 = t0
+            req.spec_dt1 = t1
+            if req.spec_vt0 is None:
+                req.spec_vt0 = v0
+            req.spec_vt1 = v1
+            serviced.add(s)
+            committed = 0
+            last_tok = 0
+            done = False
+            for j in range(n_emit):
+                token = int(tgt[j])
+                self._emit(req, token, v1, itl_out=itl)
+                emitted += 1
+                committed += 1
+                last_tok = token
+                if self._check_done(s, req, token, v1):
+                    done = True
+                    break
+            if not done:
+                st.commit(s, last_tok, committed)
+        if emitted:
+            self.metrics.tokens.record(emitted)
+        if itl:
+            self.metrics.itl_ms.record_many(itl)
+        if paged:
+            self._update_block_gauges()
+        return frozenset(serviced)
+
     def _ready_slots(self) -> List[int]:
         """Slots in the DECODE phase. On the paged backend a slot is
         claimed at admission but only decode-ready after its final
@@ -1796,9 +2226,18 @@ class GenerationEngine:
         return [s for s in range(self.num_slots)
                 if st.requests[s] is not None and st.step[s] > 0]
 
-    def _decode_step(self):
+    def _decode_step(self, skip=frozenset()):
+        """One plain decode step. ``skip`` holds slots a speculative
+        round already advanced this iteration: they ride the batch as
+        masked passengers (the executable's shape is the full slot
+        panel either way) and their lane results are simply not
+        applied — the passenger's one K/V write lands at the position
+        the NEXT verify span rewrites before attending, so it leaves
+        no observable residue."""
         st = self._slots
-        active = self._ready_slots()
+        active = [s for s in self._ready_slots() if s not in skip]
+        if not active:
+            return
         # injection seam: BEFORE the device call (and its donation), so
         # a TransientFault here is retryable with all state intact
         self._hit("device_step")
@@ -1891,7 +2330,12 @@ class GenerationEngine:
                 if paged and self._prefilling:
                     self._prefill_chunk_step()
                 if self._ready_slots():
-                    self._decode_step()
+                    # speculative round first (no-op at k=0); lanes it
+                    # advanced sit out the plain step that finishes
+                    # everyone else
+                    spun = (self._spec_step() if self.speculation_k
+                            else frozenset())
+                    self._decode_step(skip=spun)
             except TransientFault as e:
                 strikes += 1
                 if strikes > self._max_step_retries:

@@ -79,6 +79,11 @@ class SlotTable:
         self.seed = np.zeros(self.num_slots, np.uint32)
         self.temp = np.zeros(self.num_slots, np.float32)
         self.top_k = np.zeros(self.num_slots, np.int32)
+        # speculative decoding (serving/speculative.py): slots with
+        # spec_ok=False fall back to plain one-token decode — set at
+        # draft-prime time, cleared on free() and on per-lane draft
+        # failure (a draft NaN must never fail the request)
+        self.spec_ok = np.zeros(self.num_slots, bool)
 
     @property
     def free_count(self) -> int:
@@ -112,4 +117,23 @@ class SlotTable:
         self.seed[slot] = 0
         self.temp[slot] = 0.0
         self.top_k[slot] = 0
+        self.spec_ok[slot] = False
         self._free.append(slot)
+
+    def commit(self, slot: int, token: int, n_accepted: int):
+        """Settle a slot's decode cursor after a speculative round:
+        advance by the accepted run (``n_accepted`` tokens emitted,
+        ``token`` the last of them) and leave everything the device
+        wrote PAST the accepted length behind the cursor — the
+        rejected tail needs no explicit rollback because pos/step are
+        the only commit pointers; stale K/V beyond them is masked by
+        every reader and re-written by the next accepted step, the
+        same no-zeroing contract that covers slot reuse."""
+        if self.requests[slot] is None:
+            raise ValueError(f"slot {slot} is free")
+        if n_accepted < 1:
+            raise ValueError(f"speculative round must commit >= 1 "
+                             f"token, got {n_accepted}")
+        self.token[slot] = token
+        self.pos[slot] += n_accepted
+        self.step[slot] += n_accepted

@@ -169,6 +169,15 @@ class GenerationMetrics:
         self.shared_blocks = 0         # gauge: blocks with refcount > 1
         self.prefix_blocks = 0         # gauge: blocks the index pins
         self.sessions_live = 0         # gauge
+        # speculative decoding (serving/speculative.py; both backends;
+        # all zero with speculation_k=0)
+        self.speculation_k = 0            # config knob (0 = off)
+        self.spec_draft_tokens_proposed = 0  # k per verify round
+        self.spec_draft_tokens_accepted = 0  # target-matched prefix
+        self.spec_verify_batches = 0      # verify device calls
+        self.spec_rollbacks = 0           # rounds with a rejected tail
+        self.spec_draft_fallbacks = 0     # draft failures -> plain
+        #                                   decode (lane never failed)
         # compile cache: decode + one prefill executable per bucket
         self.compiles = 0
         self.warmed_buckets: List[int] = []
@@ -251,6 +260,19 @@ class GenerationMetrics:
                         self.num_slots and steps) else 0.0,
                 "occupancy_hist": occ.snapshot(),
             },
+            "spec": {
+                "enabled": self.speculation_k > 0,
+                "speculation_k": self.speculation_k,
+                "draft_tokens_proposed": self.spec_draft_tokens_proposed,
+                "draft_tokens_accepted": self.spec_draft_tokens_accepted,
+                "accept_rate": round(
+                    self.spec_draft_tokens_accepted
+                    / self.spec_draft_tokens_proposed, 4)
+                if self.spec_draft_tokens_proposed else 0.0,
+                "verify_batches": self.spec_verify_batches,
+                "rollbacks": self.spec_rollbacks,
+                "draft_fallbacks": self.spec_draft_fallbacks,
+            },
             "prompt_bucket_hist": self.prompt_bucket_hist.snapshot(),
             "ttft_ms": {k: round(v, 3) for k, v in
                         self.ttft_ms.snapshot().items()},
@@ -296,6 +318,11 @@ _PROM_COUNTERS = frozenset({
     "prefix_hits", "session_hits", "session_misses",
     "prefix_tokens_matched", "prefill_tokens", "cow_copies",
     "prefix_evictions", "session_evictions",
+    # speculative decoding (the `spec` snapshot block; leaf names —
+    # `spec_verify_batches` also matches the `batches` rule, the rest
+    # are matched here)
+    "draft_tokens_proposed", "draft_tokens_accepted", "verify_batches",
+    "rollbacks", "draft_fallbacks",
     "compiles", "hits", "misses", "evictions",
     "client_disconnects",
     # fleet-side counters

@@ -150,7 +150,8 @@ from .simple import (AlexNet, Darknet19, LeNet, SimpleCNN,  # noqa: E402,F401
 from .resnet import ResNet50  # noqa: E402,F401
 from .inception import FaceNetNN4Small2, InceptionResNetV1  # noqa: E402,F401
 from .advanced import NASNet, SqueezeNet, UNet, Xception  # noqa: E402,F401
-from .transformer_lm import CausalTransformerLM  # noqa: E402,F401
+from .transformer_lm import (CausalTransformerLM,  # noqa: E402,F401
+                             make_draft_lm)
 
 ALL_MODELS = (AlexNet, Darknet19, FaceNetNN4Small2, InceptionResNetV1, LeNet,
               NASNet, ResNet50, SimpleCNN, SqueezeNet, TextGenerationLSTM,

@@ -183,6 +183,34 @@ class CausalTransformerLM:
         x = layer_norm(x[0], params["lnf_g"], params["lnf_b"])
         return x @ params["head"], new_k, new_v
 
+    def forward_verify(self, params, tokens, p0, chunk_len, k_caches,
+                       v_caches, slot):
+        """Multi-token verification span against the DENSE slot cache —
+        the slot-backend sibling of :meth:`forward_prefill_chunk`, used
+        by speculative decoding (serving/speculative.py) to score a
+        draft's proposals in one causal pass. Same embedding/masking
+        math as the chunk path; the paged scatter/gather is replaced by
+        one slot panel.
+
+        tokens: [1, C] int32 (C = verify bucket); p0: scalar int32 span
+        start; chunk_len: scalar int32 valid tokens; slot: scalar int32
+        cache row. Returns (logits [C, V], k_caches, v_caches)."""
+        C = tokens.shape[1]
+        gpos = p0 + jnp.arange(C)
+        x = (params["tok"][tokens[0]]
+             + params["pos"][jnp.clip(gpos, 0, self.max_seq_len - 1)])
+        row_mask = (jnp.arange(C) < chunk_len).astype(x.dtype)
+        x = (x * row_mask[:, None])[None]
+        new_k, new_v = [], []
+        for blk, bp, kc, vc in zip(self.blocks, params["blocks"],
+                                   k_caches, v_caches):
+            x, kc, vc = blk.apply_verify(bp, x, kc, vc, slot, p0,
+                                         chunk_len)
+            new_k.append(kc)
+            new_v.append(vc)
+        x = layer_norm(x[0], params["lnf_g"], params["lnf_b"])
+        return x @ params["head"], new_k, new_v
+
     def logits(self, tokens) -> jnp.ndarray:
         """Convenience uncached full-sequence logits (tests/training
         harnesses; the serving path never calls this)."""
@@ -190,3 +218,28 @@ class CausalTransformerLM:
             self.init()
         return self.forward_prefill(self._params,
                                     jnp.asarray(tokens, jnp.int32))[0]
+
+
+def make_draft_lm(target: CausalTransformerLM, d_model: int = 32,
+                  n_layers: int = 1, n_heads: int = 2,
+                  d_ff: Optional[int] = None,
+                  seed: Optional[int] = None) -> CausalTransformerLM:
+    """Build a narrow/shallow draft LM for speculative decoding
+    (serving/speculative.py), sharing the TARGET's token space — same
+    vocab, same ``eos_id``, same position-table reach — so every draft
+    proposal is a legal target token and the draft's cache cursor can
+    track the target's positions one-for-one. Architecture is the
+    knob: fewer/narrower layers make proposing k tokens cheaper than
+    one target decode step; the accept rate (how often the target's
+    sample agrees) is what the draft's capacity buys. Initialized and
+    ready to serve; pass it to ``GenerationEngine(draft_model=...)``.
+
+    ``seed`` defaults to ``target.seed + 1`` — a DIFFERENT stream than
+    the target on purpose (a same-seed same-config draft would be the
+    target itself: a valid identity-test rig, a pointless draft)."""
+    draft = CausalTransformerLM(
+        vocab_size=target.vocab_size, d_model=d_model,
+        n_layers=n_layers, n_heads=n_heads, d_ff=d_ff,
+        max_seq_len=target.max_seq_len, eos_id=target.eos_id,
+        seed=target.seed + 1 if seed is None else seed)
+    return draft.init()

@@ -96,6 +96,19 @@ def test_generation_scenario_harness_runs_on_cpu():
     assert res["tokens_identical_traced"] is True
     assert res["trace_spans_recorded"] >= 8 * 3  # admission+queue+decode
     assert res["trace_overhead_frac"] < 0.25
+    # speculative leg (ISSUE 12): k=3 same-weights draft vs k=0 on the
+    # long-context mix — tokens must be identical (the bit-identity
+    # contract, measured not assumed), the accept path must actually
+    # run (same weights at temperature 0 accept most rounds), and the
+    # measured window must stay compile-free; the speedup itself is
+    # gated against the recorded baseline at full scale, not here
+    assert res["spec_k"] == 3
+    assert res["spec_tokens_identical_vs_plain"] is True
+    assert res["spec_recompiles_post_warmup"] == 0
+    assert res["spec_tokens_per_sec"] > 0
+    assert res["spec_verify_batches"] >= 1
+    assert res["spec_accept_rate"] > 0.3
+    assert res["spec_itl_ms_p99"] > 0
 
 
 def test_fleet_scenario_harness_runs_on_cpu():
@@ -349,6 +362,52 @@ def test_check_bench_regression_direction_registry():
     assert rows["overload_shed_rate"]["direction"] == "lower_is_better"
     assert rows["overload_goodput_ratio"]["direction"] == \
         "higher_is_better"
+
+
+def test_check_bench_regression_speculative_metrics_gated():
+    """ISSUE 12 satellite: the speculative-decoding leg gates BOTH
+    ways — tokens/sec and speedup-vs-plain are higher-is-better, but
+    the per-request mean-ITL p99 flips (speculation is a latency
+    optimization; a throughput win that regresses ITL is a loss)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "cbr6", os.path.join(ROOT, "tools", "check_bench_regression.py"))
+    cbr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cbr)
+    names = set(cbr.METRICS.values())
+    assert {"generation_spec_tokens_per_sec", "spec_itl_p99_ms",
+            "spec_speedup_vs_plain"} <= names
+    assert cbr.METRICS[("extra", "generation", "spec_itl_ms_p99")] \
+        == "spec_itl_p99_ms"
+    assert cbr.direction("spec_itl_p99_ms") == "lower_is_better"
+    assert cbr.direction("generation_spec_tokens_per_sec") == \
+        "higher_is_better"
+    assert cbr.direction("spec_speedup_vs_plain") == "higher_is_better"
+    rec = {"value": 100.0,
+           "extra": {"generation": {"spec_tokens_per_sec": 900.0,
+                                    "spec_itl_ms_p99": 2.0,
+                                    "spec_speedup_vs_plain": 1.2}}}
+    # ITL p99 climbing 50% is the regression even with throughput flat
+    worse = {"value": 100.0,
+             "extra": {"generation": {"spec_tokens_per_sec": 900.0,
+                                      "spec_itl_ms_p99": 3.0,
+                                      "spec_speedup_vs_plain": 1.2}}}
+    r = cbr.compare(rec, worse, 0.2)
+    assert [e["metric"] for e in r["regressions"]] == ["spec_itl_p99_ms"]
+    # faster tokens AND lower ITL both pass
+    better = {"value": 100.0,
+              "extra": {"generation": {"spec_tokens_per_sec": 1100.0,
+                                       "spec_itl_ms_p99": 1.5,
+                                       "spec_speedup_vs_plain": 1.3}}}
+    assert not cbr.compare(rec, better, 0.2)["regressions"]
+    # throughput dropping 30% regresses in the usual direction
+    slow = {"value": 100.0,
+            "extra": {"generation": {"spec_tokens_per_sec": 600.0,
+                                     "spec_itl_ms_p99": 2.0,
+                                     "spec_speedup_vs_plain": 1.2}}}
+    r = cbr.compare(rec, slow, 0.2)
+    assert [e["metric"] for e in r["regressions"]] == \
+        ["generation_spec_tokens_per_sec"]
 
 
 def test_overload_scenario_harness_runs_on_cpu():

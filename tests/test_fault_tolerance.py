@@ -402,6 +402,112 @@ class TestChaosPaged:
         assert compiles == 0
 
 
+class _NaNDraftLM(CausalTransformerLM):
+    """Draft-side NaN rig (ISSUE 12): prefill is clean — lanes prime
+    and become speculation-eligible — but every decode step's logits
+    are non-finite, so each round's per-lane finite guard trips. The
+    target model is untouched; a correct engine turns this into
+    plain decode for the tripped lanes, never a failed request."""
+
+    def forward_decode(self, params, tokens, pos, k_caches, v_caches,
+                       impl="auto"):
+        logits, kcs, vcs = super().forward_decode(
+            params, tokens, pos, k_caches, v_caches, impl)
+        return jnp.full_like(logits, jnp.nan), kcs, vcs
+
+
+_SPEC_KW = dict(num_slots=3, max_queue=64, min_prompt_bucket=4,
+                retry_backoff_ms=0.2, retry_backoff_max_ms=2.0,
+                speculation_k=2)
+
+
+@pytest.fixture(scope="module")
+def spec_eng(lm):
+    """Warmed SPECULATING slot-backend engine (same-weights draft so
+    rounds actually accept) shared by the spec chaos scenarios."""
+    eng = GenerationEngine(lm, draft_model=_lm(), **_SPEC_KW)
+    eng.warmup()
+    yield eng
+    eng.stop()
+
+
+@pytest.fixture(scope="module")
+def spec_baseline(spec_eng, slot_baseline):
+    """Fault-free speculating outputs — the bit-identity contract
+    makes the k=0 workload outputs the oracle here too."""
+    out, errs = _run_all(spec_eng)
+    assert all(e is None for e in errs)
+    assert out == slot_baseline
+    return out
+
+
+class TestChaosSpeculative:
+    """ISSUE 12 acceptance: faults in the SPECULATIVE plane degrade
+    along the documented ladder — draft-side trouble (NaN logits or a
+    died/injected draft call) costs speculation only, while a
+    corrupting fault at the verify seam forces the same
+    recompute-recovery as any target-cache corruption — and every
+    surviving request replays token-identical with zero post-warmup
+    recompiles."""
+
+    def test_draft_nan_falls_back_lane_only(self, lm, slot_baseline):
+        eng = GenerationEngine(lm, draft_model=_NaNDraftLM(
+            vocab_size=VOCAB, d_model=32, n_layers=2, n_heads=4,
+            max_seq_len=32, seed=1,
+            implementation="plain").init(), **_SPEC_KW)
+        eng.warmup()
+        try:
+            out, errs = _run_all(eng)
+            assert all(e is None for e in errs)    # never the request
+            assert out == slot_baseline            # plain-decode result
+            sp = eng.stats()["spec"]
+            assert sp["draft_fallbacks"] >= 1      # every lane tripped
+            assert sp["draft_tokens_accepted"] == 0
+        finally:
+            eng.stop()
+
+    def test_transient_verify_fault_retried_token_identical(
+            self, spec_eng, spec_baseline):
+        inj = FaultInjector(plan={"verify": [2]})
+        out, errs, retries, recoveries, compiles = _chaos_run(
+            spec_eng, inj)
+        assert all(e is None for e in errs)
+        assert out == spec_baseline
+        assert retries == 1
+        assert recoveries == 0
+        assert compiles == 0
+
+    def test_corrupting_verify_fault_recovers_token_identical(
+            self, spec_eng, spec_baseline):
+        # the verify call owns the TARGET's donated caches: a
+        # corrupting fire there has device_step blast radius —
+        # recompute-recovery replays every in-flight request
+        inj = FaultInjector(plan={"verify": [3]},
+                            corrupting=("verify",))
+        out, errs, _, recoveries, compiles = _chaos_run(spec_eng, inj)
+        assert all(e is None for e in errs)
+        assert out == spec_baseline
+        assert recoveries == 1
+        assert compiles == 0
+
+    def test_corrupting_draft_fault_costs_speculation_only(
+            self, spec_eng, spec_baseline):
+        # the draft call only ever donates the DRAFT's own caches, so
+        # even a corrupting fire at that seam must degrade to plain
+        # decode (fallback counter) with NO retry and NO recovery
+        f0 = spec_eng.stats()["spec"]["draft_fallbacks"]
+        inj = FaultInjector(plan={"draft": [1, 2]},
+                            corrupting=("draft",))
+        out, errs, retries, recoveries, compiles = _chaos_run(
+            spec_eng, inj)
+        assert all(e is None for e in errs)
+        assert out == spec_baseline
+        assert retries == 0
+        assert recoveries == 0
+        assert compiles == 0
+        assert spec_eng.stats()["spec"]["draft_fallbacks"] > f0
+
+
 class TestPoisonQuarantine:
     """A request whose logits go non-finite fails ALONE with 500
     while its batchmates keep decoding to unchanged outputs."""

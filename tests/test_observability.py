@@ -380,6 +380,41 @@ class TestPrometheusExposition:
         finally:
             srv.stop()
 
+    def test_spec_counters_parse_and_agree_with_stats(self, tiny_lm):
+        """ISSUE 12 parity: the speculating engine's `spec` block of
+        /stats (proposed/accepted, verify batches, rollbacks,
+        fallbacks) exports 1:1 on /metrics — counters as _total, the
+        accept_rate / speculation_k / enabled knobs as gauges."""
+        srv = InferenceServer(port=0)
+        g = srv.register_generator(
+            "lm", tiny_lm, num_slots=2, max_seq_len=32,
+            prompt_buckets=[8], speculation_k=2)
+        g.warmup()
+        try:
+            for i in range(3):
+                g.generate([1 + i, 5, 2, 9], max_tokens=8,
+                           temperature=0.0, seed=i, timeout_ms=60_000)
+            base = f"http://{srv.host}:{srv.port}"
+            sp = _get_json(base + "/stats")["models"]["lm"]["spec"]
+            assert sp["enabled"] is True
+            assert sp["verify_batches"] >= 1
+            assert sp["draft_tokens_proposed"] == \
+                2 * sp["verify_batches"]
+            samples, types = _parse_prometheus(urllib.request.urlopen(
+                base + "/metrics", timeout=30).read().decode())
+            lab = '{model="lm"}'
+            stem = "dl4j_model_spec_"
+            for leaf in ("draft_tokens_proposed",
+                         "draft_tokens_accepted", "verify_batches",
+                         "rollbacks", "draft_fallbacks"):
+                assert samples[(f"{stem}{leaf}_total", lab)] == sp[leaf]
+                assert types[f"{stem}{leaf}_total"] == "counter"
+            for leaf in ("enabled", "speculation_k", "accept_rate"):
+                assert samples[(f"{stem}{leaf}", lab)] == sp[leaf]
+                assert types[f"{stem}{leaf}"] == "gauge"
+        finally:
+            srv.stop()
+
 
 # ---------------------------------------------------------------------
 # structured access log + client_disconnects (satellites a, b)

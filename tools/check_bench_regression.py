@@ -114,6 +114,17 @@ METRICS = {
         "session_ttft_turnN_ms",
     ("extra", "generation", "session_turnN_speedup"):
         "session_turnN_speedup",
+    # speculative decoding (ISSUE 12): decode-bound leg with a draft
+    # model proposing k tokens per round — throughput AND inter-token
+    # latency must both hold the line vs the recorded baseline (spec
+    # is a latency optimization; a tokens/sec win that regresses ITL
+    # p99 is a loss) — "new, skipped" until the next BENCH_*.json
+    # records a baseline, gated after
+    ("extra", "generation", "spec_tokens_per_sec"):
+        "generation_spec_tokens_per_sec",
+    ("extra", "generation", "spec_itl_ms_p99"): "spec_itl_p99_ms",
+    ("extra", "generation", "spec_speedup_vs_plain"):
+        "spec_speedup_vs_plain",
 }
 
 #: metric NAMES (values of METRICS) where LOWER is better — latency
@@ -133,6 +144,7 @@ LOWER_IS_BETTER = {
     "prefix_ttft_p50_ms",
     "prefix_ttft_p99_ms",
     "session_ttft_turnN_ms",
+    "spec_itl_p99_ms",
 }
 
 

@@ -118,6 +118,33 @@ def prefix_savings(traces):
             for k, v in sorted(by_src.items())}
 
 
+def spec_savings(traces):
+    """Aggregate the engine's speculative-decoding ``verify`` spans
+    (one per request that ran at least one draft/verify round): rounds
+    run, tokens proposed/accepted, the realized accept rate, and the
+    estimated milliseconds of plain decode steps the accepted runs
+    replaced — the speculation mirror of :func:`prefix_savings`."""
+    agg = {"requests": 0, "rounds": 0, "proposed": 0, "accepted": 0,
+           "spec_tokens": 0, "saved_est_ms": 0.0}
+    for t in traces:
+        for s in t.get("spans", []):
+            if s.get("kind") != "verify":
+                continue
+            a = s.get("attrs", {})
+            agg["requests"] += 1
+            agg["rounds"] += int(a.get("rounds") or 0)
+            agg["proposed"] += int(a.get("proposed") or 0)
+            agg["accepted"] += int(a.get("accepted") or 0)
+            agg["spec_tokens"] += int(a.get("spec_tokens") or 0)
+            agg["saved_est_ms"] += float(a.get("saved_est_ms") or 0.0)
+    if not agg["requests"]:
+        return {}
+    agg["accept_rate"] = round(agg["accepted"] / agg["proposed"], 4) \
+        if agg["proposed"] else 0.0
+    agg["saved_est_ms"] = round(agg["saved_est_ms"], 3)
+    return agg
+
+
 def critical_path(trace):
     """Root-to-leaf chain of longest spans: from each level's longest
     span, descend into its longest child (``parent_id`` links). Open
@@ -151,6 +178,7 @@ def report(paths):
         "n_traces": len(traces),
         "kinds": kind_stats(traces),
         "prefix_sharing": prefix_savings(traces),
+        "speculation": spec_savings(traces),
         "slowest": None if slowest is None else {
             "trace_id": slowest.get("trace_id"),
             "request_id": slowest.get("request_id"),
@@ -186,6 +214,15 @@ def _fmt_human(rep):
                 f"{st['matched_tokens']:>7} tokens matched  "
                 f"{st['cow_copies']:>4} cow  "
                 f"~{st['saved_est_ms']:.1f} ms prefill saved")
+    sp = rep.get("speculation")
+    if sp:
+        lines.append("-- speculative-decoding savings (verify spans)")
+        lines.append(
+            f"   {sp['requests']:>5} request(s)  "
+            f"{sp['rounds']:>6} rounds  "
+            f"{sp['accepted']}/{sp['proposed']} accepted "
+            f"({sp['accept_rate']:.1%})  "
+            f"~{sp['saved_est_ms']:.1f} ms decode saved")
     s = rep.get("slowest")
     if s:
         lines.append(f"-- slowest trace {s['trace_id']} "
