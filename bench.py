@@ -610,6 +610,27 @@ tr_dt, tr_tok, tr_out, tr_traces = run_all_traced(eng)
 trace_overhead = max(0.0, (cb_tok / cb_dt) / (tr_tok / tr_dt) - 1.0)
 trace_spans = sum(len(t.spans) for t in tr_traces)
 
+# -- scheduler-overhead probe (ISSUE 13): a dedicated OpProfiler
+# OPERATIONS pass over the SAME saturated continuous-batching
+# workload. Device time is the sum of the profiled generation
+# sections (prefill + decode_step + spec draft/verify); everything
+# else in the wall clock is host-side scheduling — queue hops, slot
+# bookkeeping, Python dispatch. The gated number is that host-side
+# fraction of the wall clock (lower is better).
+from deeplearning4j_tpu.profiler import OpProfiler, ProfilingMode
+prof = OpProfiler.get_instance()
+prof.reset()
+prof.set_mode(ProfilingMode.OPERATIONS)
+ov_dt, ov_tok, _ = run_all(eng, concurrent=True)
+prof.set_mode(ProfilingMode.DISABLED)
+_DEV_SECTIONS = ("generation.prefill", "generation.decode_step",
+                 "generation.spec_draft", "generation.spec_verify")
+sched_device_s = sum(v["total_s"] for k, v in prof.timings().items()
+                     if k in _DEV_SECTIONS)
+scheduler_overhead_frac = round(
+    max(0.0, (ov_dt - sched_device_s) / ov_dt), 4)
+prof.reset()
+
 # -- chaos probe (ISSUE 4): the SAME engine and workload with ~1% of
 # decode steps raising an injected transient fault, plus a scripted
 # cache-corrupting fault (two at full scale) forcing recompute-
@@ -927,6 +948,7 @@ print(json.dumps({
     "trace_overhead_frac": round(trace_overhead, 4),
     "trace_spans_recorded": trace_spans,
     "tokens_identical_traced": tr_out == cb_out,
+    "scheduler_overhead_frac": scheduler_overhead_frac,
     "prefix_hit_rate": round(shr_hits / N_USERS, 4),
     "prefix_tokens_matched": shr_matched,
     "prefix_prefill_tokens_saved_frac": round(
@@ -1548,6 +1570,37 @@ FaultTolerantTrainer(m_clean, clean_dir,
                      save_every_n_steps=50).fit(it(), epochs=EPOCHS)
 clean_dt = time.perf_counter() - t0
 
+# -- traced leg (ISSUE 13): the SAME clean schedule with the full
+# observability plane attached — tracer, event timeline, fleet
+# telemetry, StatsListener — so the gated number is the steps/sec
+# cost of tracing ENABLED (< 5% in acceptance; disabled is zero-cost
+# by construction, the step loop carries no tracing code at all).
+from deeplearning4j_tpu.tracing import Tracer
+from deeplearning4j_tpu.parallel.telemetry import (EventTimeline,
+                                                   FleetTelemetry)
+from deeplearning4j_tpu.ui import InMemoryStatsStorage, StatsListener
+traced_dir = tempfile.mkdtemp(prefix="bench_tchaos_traced_")
+m_traced = build()
+m_traced.set_listeners(StatsListener(InMemoryStatsStorage(),
+                                     session_id="bench",
+                                     collect_params=False))
+tracer = Tracer(enabled=True, ring=64)
+tr_tr = FaultTolerantTrainer(m_traced, traced_dir,
+                             save_every_n_steps=50,
+                             tracer=tracer,
+                             events=EventTimeline(),
+                             fleet_telemetry=FleetTelemetry())
+t0 = time.perf_counter()
+tr_tr.fit(it(), epochs=EPOCHS)
+traced_dt = time.perf_counter() - t0
+training_trace_overhead = max(0.0, traced_dt / clean_dt - 1.0)
+tr_phases = tr_tr.telemetry_snapshot()["phases"]
+traced_spans = sum(len(t["spans"]) for t in tracer.dump(limit=64))
+traced_identical = all(
+    bool(np.array_equal(np.asarray(a), np.asarray(b)))
+    for a, b in zip(jax.tree_util.tree_leaves(m_clean._params),
+                    jax.tree_util.tree_leaves(m_traced._params)))
+
 # -- chaos run: ~1% transient step faults + 20ms-slow checkpoint disk
 # + a scripted preemption at the midpoint, then restart and resume
 chaos_dir = tempfile.mkdtemp(prefix="bench_tchaos_")
@@ -1599,6 +1652,12 @@ print(json.dumps({
     "checkpoint_stall_s": round(f1["checkpoint_stall_s"]
                                 + f2["checkpoint_stall_s"], 4),
     "params_identical_to_clean": identical,
+    "traced_steps_per_sec": round(TOTAL_STEPS / traced_dt, 1),
+    "training_trace_overhead_frac": round(training_trace_overhead, 4),
+    "training_trace_spans_recorded": traced_spans,
+    "params_identical_traced": traced_identical,
+    "data_wait_frac": tr_phases["data_wait_frac"],
+    "checkpoint_stall_frac": tr_phases["checkpoint_stall_frac"],
     "synthetic_data": True}))
 """
 
@@ -1659,6 +1718,15 @@ FaultTolerantTrainer(m_ref, ref_dir, save_every_n_steps=50,
                      sharded_checkpoints=True).fit(it(), epochs=EPOCHS)
 
 # timed elastic run: preempt at the midpoint, resume on HALF the fleet
+# — with the full observability plane attached (ISSUE 13): tracer,
+# event timeline, fleet telemetry all live INSIDE the timed window,
+# because a production spot fleet runs instrumented
+from deeplearning4j_tpu.tracing import Tracer
+from deeplearning4j_tpu.parallel.telemetry import (EventTimeline,
+                                                   FleetTelemetry)
+el_tracer = Tracer(enabled=True, ring=64)
+el_events = EventTimeline()
+el_fleet = FleetTelemetry()
 el_dir = tempfile.mkdtemp(prefix="bench_elastic_")
 t0 = time.perf_counter()
 m1 = build()
@@ -1667,7 +1735,9 @@ pw1 = ParallelWrapper(m1, workers=W0,
 tr1 = FaultTolerantTrainer(
     m1, el_dir, save_every_n_steps=50, wrapper=pw1,
     sharded_checkpoints=True,
-    fault_injector=FaultInjector(plan={"preempt": [TOTAL_STEPS // 2]}))
+    fault_injector=FaultInjector(plan={"preempt": [TOTAL_STEPS // 2]}),
+    tracer=el_tracer, events=el_events, fleet_telemetry=el_fleet,
+    worker_id=0)
 try:
     tr1.fit(it(), epochs=EPOCHS)
     preempted = False
@@ -1681,9 +1751,14 @@ pw2 = ParallelWrapper(m2, workers=W1,
 pw2.ensure_step()             # consumes _resume_extra, re-buckets
 resume_wall_s = time.perf_counter() - t_resume
 tr2 = FaultTolerantTrainer(m2, el_dir, save_every_n_steps=50,
-                           wrapper=pw2, sharded_checkpoints=True)
+                           wrapper=pw2, sharded_checkpoints=True,
+                           tracer=el_tracer, events=el_events,
+                           fleet_telemetry=el_fleet, worker_id=0)
 tr2.fit(it(), epochs=EPOCHS)
 elastic_dt = time.perf_counter() - t0
+el_phases = tr2.telemetry_snapshot()["phases"]
+el_counts = el_events.counts()
+el_straggler = el_fleet.straggler()
 
 flat = lambda m: np.concatenate(
     [np.asarray(a).ravel() for a in jax.tree_util.tree_leaves(m._params)])
@@ -1704,6 +1779,14 @@ print(json.dumps({
     "elastic_sharded_checkpoints": (f1["sharded_checkpoints"]
                                     + f2["sharded_checkpoints"]),
     "elastic_params_rel_err_vs_fixed_shape": round(rel_err, 6),
+    "elastic_data_wait_frac": el_phases["data_wait_frac"],
+    "elastic_checkpoint_stall_frac": el_phases["checkpoint_stall_frac"],
+    "elastic_step_ewma_ms": el_straggler["slowest_ms"],
+    "elastic_events": {k: el_counts.get(k, 0)
+                       for k in ("preempt_broadcast", "checkpoint_commit",
+                                 "re_mesh", "resume")},
+    "elastic_trace_spans_recorded": sum(
+        len(t["spans"]) for t in el_tracer.dump(limit=64)),
     "synthetic_data": True}))
 """
 
@@ -1985,6 +2068,7 @@ def main():
                                      "trace_overhead_frac",
                                      "trace_spans_recorded",
                                      "tokens_identical_traced",
+                                     "scheduler_overhead_frac",
                                      "prefix_hit_rate",
                                      "prefix_tokens_matched",
                                      "prefix_prefill_tokens_saved_frac",
@@ -2032,7 +2116,13 @@ def main():
                                          "async_checkpoints",
                                          "sync_checkpoints",
                                          "checkpoint_stall_s",
-                                         "params_identical_to_clean")
+                                         "params_identical_to_clean",
+                                         "traced_steps_per_sec",
+                                         "training_trace_overhead_frac",
+                                         "training_trace_spans_recorded",
+                                         "params_identical_traced",
+                                         "data_wait_frac",
+                                         "checkpoint_stall_frac")
                                         if k in tc}
         # elastic leg (ISSUE 7): 4-worker compressed run with sharded
         # v3 checkpoints, scripted preemption, re-meshed resume at 2
@@ -2049,7 +2139,11 @@ def main():
                   "elastic_resume_wall_s", "elastic_total_steps",
                   "elastic_preempted", "elastic_remeshed",
                   "elastic_sharded_checkpoints",
-                  "elastic_params_rel_err_vs_fixed_shape")
+                  "elastic_params_rel_err_vs_fixed_shape",
+                  "elastic_data_wait_frac",
+                  "elastic_checkpoint_stall_frac",
+                  "elastic_step_ewma_ms", "elastic_events",
+                  "elastic_trace_spans_recorded")
                  if k in te})
     # static cost model (tools/perf_audit.py — chip-independent): the
     # roofline predictions the measured numbers are judged against

@@ -282,6 +282,22 @@ class ParallelWrapper:
     def num_workers(self) -> int:
         return int(self.mesh.shape["data"])
 
+    def telemetry_snapshot(self) -> dict:
+        """Fleet-facing wrapper telemetry for the training /metrics
+        plane: worker count, the last elastic re-mesh (if any), and
+        gradient-compression effectiveness (achieved sparsity, residual
+        norm, bytes-on-wire vs dense). Fetches device scalars — call at
+        snapshot cadence, never inside the step loop."""
+        from .telemetry import compression_stats
+        d = {"workers": self.num_workers}
+        if self.last_remesh is not None:
+            d["remesh_from"], d["remesh_to"] = (
+                int(self.last_remesh[0]), int(self.last_remesh[1]))
+        comp = compression_stats(self)
+        if comp is not None:
+            d["compression"] = comp
+        return d
+
     def _build_step(self, guard: bool = False):
         m = self.model
         if m._params is None:

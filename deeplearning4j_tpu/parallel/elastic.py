@@ -77,8 +77,10 @@ import os
 import re
 import shutil
 import signal
+import sys
 import threading
 import time
+from collections import deque
 from typing import Callable, List, Optional
 
 import jax
@@ -90,6 +92,7 @@ from ..util.serializer import (MANIFEST_NAME, CheckpointFormatError,  # noqa: F4
                                ModelSerializer, shard_name,
                                shard_training_snapshot,
                                snapshot_training_state, write_shard)
+from ..tracing import new_request_id
 from .multihost import PreemptionCoordinator, split_data_cursor  # noqa: F401
 from .resilience import (AsyncCheckpointWriter, TrainingAnomalyError,
                          TrainingSupervisor)
@@ -160,7 +163,10 @@ class FaultTolerantTrainer:
                  wrapper=None,
                  sharded_checkpoints: bool = False,
                  coordinator: Optional[PreemptionCoordinator] = None,
-                 worker_id: Optional[int] = None):
+                 worker_id: Optional[int] = None,
+                 tracer=None,
+                 events=None,
+                 fleet_telemetry=None):
         self.model = model
         self.dir = checkpoint_dir
         self.save_every = max(1, save_every_n_epochs)
@@ -184,6 +190,23 @@ class FaultTolerantTrainer:
             retry_backoff_ms=retry_backoff_ms,
             anomaly_guard=anomaly_guard,
             rollback_after=rollback_after)
+        # observability plane (all optional; see docs/observability.md).
+        # The hot loop never calls into the tracer: when a trace is
+        # live, phase timings ride an append-only ring of (kind, t0,
+        # t1, attrs) tuples and spans are rebuilt retroactively at fit
+        # exit — with no tracer the ring is None and the loop carries
+        # only a dead None-check
+        self.tracer = tracer
+        self.events = events
+        self.fleet = fleet_telemetry
+        self.supervisor.events = events
+        self.supervisor.fleet = fleet_telemetry
+        self.supervisor.worker = self.worker_id
+        self._obs = None
+        self._trace = None
+        self._root_span = None
+        self._remesh_reported = False
+        self._phases = {"data_wait_s": 0.0, "device_step_s": 0.0}
         # rollback-snapshot cadence: default to the disk cadence (the
         # same host copy feeds both); a guarded run with no disk
         # cadence still needs a rollback source, so it snapshots every
@@ -296,9 +319,32 @@ class FaultTolerantTrainer:
         if self.sharded_checkpoints:
             sup.sharded_checkpoints.inc()
         self._prune_and_sweep()
+        dur = time.perf_counter() - t0
         # single-writer by construction (the async worker, or the loop
         # thread after _writer.wait()), so += cannot lose increments
-        self.supervisor.checkpoint_write_s += time.perf_counter() - t0
+        self.supervisor.checkpoint_write_s += dur
+        obs = self._obs     # deque.append is thread-safe from the
+        if obs is not None:  # async writer thread
+            obs.append(("checkpoint_write", t0, t0 + dur,
+                        {"path": os.path.basename(path)}))
+        if self.events is not None:
+            self.events.record("checkpoint_commit",
+                               worker=self.worker_id,
+                               path=os.path.basename(path),
+                               duration_ms=round(dur * 1e3, 3),
+                               bytes=self._ckpt_bytes(path))
+
+    @staticmethod
+    def _ckpt_bytes(path: str) -> int:
+        """On-disk size of a committed checkpoint (sum of files for a
+        v3 shard directory)."""
+        try:
+            if os.path.isdir(path):
+                return sum(os.path.getsize(os.path.join(r, f))
+                           for r, _, fs in os.walk(path) for f in fs)
+            return os.path.getsize(path)
+        except OSError:
+            return 0
 
     def _write_once(self, snap: dict, path: str):
         # pid-unique temp name IN the checkpoint directory (rename
@@ -551,11 +597,98 @@ class FaultTolerantTrainer:
                 "batches_into_epoch": self._batches_done,
                 "iterator": self._epoch_it_state}
 
+    # -- observability (zero-cost-when-disabled) -----------------------
+    def _begin_observed(self, cursor: Optional[dict], t_fit0: float):
+        """Open a per-fit trace (if a tracer is attached and enabled)
+        and arm the retro-span ring. The step loop itself never calls
+        the tracer: it appends plain (kind, t0, t1, attrs) tuples to
+        ``self._obs`` — None when no trace is live, so a disabled run's
+        loop carries only a dead None-check — and
+        :meth:`_finish_observed` rebuilds real spans from the ring at
+        fit exit. Resume / re-mesh are recorded up front; events go to
+        the timeline even when no tracer is attached."""
+        self._phases = {"data_wait_s": 0.0, "device_step_s": 0.0}
+        self.model._phase_breakdown = self._phases
+        rm = (getattr(self.wrapper, "last_remesh", None)
+              if self.wrapper is not None else None)
+        if rm is not None and self._remesh_reported:
+            rm = None
+        if self.events is not None:
+            if cursor is not None:
+                self.events.record(
+                    "resume", worker=self.worker_id,
+                    epoch=int(cursor.get("epoch") or 0),
+                    step=int(self.model._step))
+            if rm is not None:
+                self.events.record("re_mesh", worker=self.worker_id,
+                                   from_workers=rm[0], to_workers=rm[1])
+        trc = self.tracer
+        self._trace = None
+        self._obs = None
+        self.supervisor.obs = None
+        if trc is None:
+            return
+        if not trc.enabled:         # disabled: stay zero-cost — don't
+            if rm is not None:      # even mint a request id
+                self._remesh_reported = True
+            return
+        wid = (self.worker_id if self.worker_id is not None
+               else os.getpid())
+        t = trc.begin(request_id=f"train-w{wid}-{new_request_id()}")
+        if t is None:
+            if rm is not None:
+                self._remesh_reported = True
+            return
+        self._trace = t
+        self._obs = deque(maxlen=4096)
+        self.supervisor.obs = self._obs
+        self._root_span = t.span("fit", worker=self.worker_id,
+                                 epoch=int(self.model._epoch),
+                                 step=int(self.model._step))
+        now = time.perf_counter()
+        if cursor is not None:
+            t.span("resume", parent=self._root_span,
+                   t_start=t_fit0, t_end=now,
+                   epoch=int(cursor.get("epoch") or 0),
+                   batches_into_epoch=int(
+                       cursor.get("batches_into_epoch") or 0))
+        if rm is not None:
+            t.span("re_mesh", parent=self._root_span,
+                   t_start=t_fit0, t_end=now,
+                   from_workers=rm[0], to_workers=rm[1])
+        if rm is not None:
+            self._remesh_reported = True
+
+    def _finish_observed(self, error: bool = False):
+        """Rebuild spans from the retro-ring and close the trace. Runs
+        once per fit, off the hot path; the writer thread is already
+        joined so no more ring appends can race this drain."""
+        t = self._trace
+        if t is None:
+            return
+        self._trace = None
+        obs, self._obs = self._obs, None
+        self.supervisor.obs = None
+        root = self._root_span
+        self._root_span = None
+        if obs:
+            for kind, s0, s1, attrs in obs:
+                t.span(kind, parent=root, t_start=s0, t_end=s1,
+                       **(attrs or {}))
+        ph = self._phases
+        root.end(
+            data_wait_s=round(ph["data_wait_s"], 6),
+            device_step_s=round(ph["device_step_s"], 6),
+            checkpoint_stall_s=round(
+                self.supervisor.checkpoint_stall_s, 6))
+        self.tracer.finish(t, error=error)
+
     def _fit_supervised(self, iterator, epochs: int):
         m = self.model
         if m._params is None:
             m.init()
         sup = self.supervisor
+        t_fit0 = time.perf_counter()
         step_fn = self._ensure_step()
         if self.async_write and (self._writer is None
                                  or self._writer.closed):
@@ -568,6 +701,7 @@ class FaultTolerantTrainer:
             iterator = list(iterator)
         cursor = getattr(m, "_resume_cursor", None)
         m._resume_cursor = None
+        self._begin_observed(cursor, t_fit0)
         mesh_ctx = (self.wrapper.mesh if self.wrapper is not None
                     else contextlib.nullcontext())
         # coordinated preemption: notices are generation-based — only
@@ -630,6 +764,7 @@ class FaultTolerantTrainer:
                     # The object stays referenced for stats; the next
                     # fit() builds a fresh one
                     self._writer.close()
+                self._finish_observed(error=sys.exc_info()[0] is not None)
         return m
 
     def _run_one_epoch(self, iterator, step_fn, cursor: Optional[dict]):
@@ -649,7 +784,17 @@ class FaultTolerantTrainer:
             skip = int(cursor.get("batches_into_epoch", 0))
         self._epoch_it_state = it_state
         self._batches_done = 0
-        for item in iterator:
+        obs = self._obs           # None unless a live span ring is
+        fleet = self.fleet        # armed — see _begin_observed
+        phases = self._phases
+        wid = self.worker_id if self.worker_id is not None else 0
+        it = iter(iterator)
+        while True:
+            t_w0 = time.perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                break
             if skip > 0:
                 # fast-forward WITHOUT consuming the model's PRNG key:
                 # the checkpointed key already reflects these batches'
@@ -657,7 +802,17 @@ class FaultTolerantTrainer:
                 skip -= 1
                 self._batches_done += 1
                 continue
+            t_w1 = time.perf_counter()
             self._run_one_step(step_fn, item)
+            t_s1 = time.perf_counter()
+            phases["data_wait_s"] += t_w1 - t_w0
+            phases["device_step_s"] += t_s1 - t_w1
+            if obs is not None:
+                obs.append(("data_wait", t_w0, t_w1, None))
+                obs.append(("device_step", t_w1, t_s1,
+                            {"step": int(m._step), "worker": wid}))
+            if fleet is not None:
+                fleet.observe_step(wid, t_s1 - t_w1)
             self._batches_done += 1
             self._after_step()
         m._epoch += 1
@@ -716,6 +871,7 @@ class FaultTolerantTrainer:
     def _after_step(self):
         m = self.model
         sup = self.supervisor
+        obs = self._obs
         if self._advanced:
             t0 = time.perf_counter()
             snapped = False
@@ -723,13 +879,26 @@ class FaultTolerantTrainer:
                     and m._step % self.snapshot_every_n_steps == 0:
                 sup.capture_good(m, cursor=self._current_cursor())
                 snapped = True
+                if obs is not None:
+                    obs.append(("host_snapshot", t0, time.perf_counter(),
+                                {"step": int(m._step)}))
             if self.save_every_n_steps \
                     and m._step % self.save_every_n_steps == 0:
+                t1 = time.perf_counter()
                 if not snapped:
                     sup.capture_good(m, cursor=self._current_cursor())
+                    if obs is not None:
+                        obs.append(("host_snapshot", t1,
+                                    time.perf_counter(),
+                                    {"step": int(m._step)}))
+                t2 = time.perf_counter()
                 self._checkpoint(
                     self._step_ckpt_path(m._epoch, m._step),
                     snap=sup.last_good)
+                if obs is not None:
+                    obs.append(("checkpoint_submit", t2,
+                                time.perf_counter(),
+                                {"step": int(m._step)}))
             sup.checkpoint_stall_s += time.perf_counter() - t0
         # preemption checks ride the step boundary: the injected seam
         # (scripted chaos), the SIGTERM flag (real platform notice),
@@ -744,14 +913,24 @@ class FaultTolerantTrainer:
                     self.injector.fire("preempt")
             except PreemptionFault:
                 sup.preemptions.inc()
+                t_d = time.perf_counter()
                 self._signal_fleet()
                 self._flush_step_checkpoint()
+                if obs is not None:
+                    obs.append(("preemption_drain", t_d,
+                                time.perf_counter(),
+                                {"step": int(m._step),
+                                 "origin": "injected"}))
                 raise
         if self._preempt_requested.is_set():
             self._preempt_requested.clear()
             sup.preemptions.inc()
+            t_d = time.perf_counter()
             self._signal_fleet()
             self._flush_step_checkpoint()
+            if obs is not None:
+                obs.append(("preemption_drain", t_d, time.perf_counter(),
+                            {"step": int(m._step), "origin": "sigterm"}))
             handler, self._preempt_handler = self._preempt_handler, None
             if handler is not None:
                 # on_preempt + chaining run HERE, on the loop's thread,
@@ -771,7 +950,20 @@ class FaultTolerantTrainer:
             # consistent, resumable step
             sup.preemptions.inc()
             sup.preempts_received.inc()
+            if self.events is not None:
+                self.events.record(
+                    "preempt_received", worker=self.worker_id,
+                    step=int(m._step),
+                    source=self.coordinator.last_source)
+            if self.fleet is not None:
+                self.fleet.inc(
+                    self.worker_id if self.worker_id is not None
+                    else 0, "preempts")
+            t_d = time.perf_counter()
             self._flush_step_checkpoint()
+            if obs is not None:
+                obs.append(("preemption_drain", t_d, time.perf_counter(),
+                            {"step": int(m._step), "origin": "fleet"}))
             raise PreemptionFault(
                 f"coordinated preemption at step {m._step} (fleet "
                 f"notice from worker "
@@ -801,6 +993,14 @@ class FaultTolerantTrainer:
         bump also marks our own gen0 as stale, but every locally-
         originated path raises before re-checking the channel, so we
         never double-count our own notice."""
+        if self.events is not None:
+            self.events.record("preempt_broadcast",
+                               worker=self.worker_id,
+                               step=int(self.model._step),
+                               coordinated=self.coordinator is not None)
+        if self.fleet is not None:
+            self.fleet.inc(self.worker_id if self.worker_id is not None
+                           else 0, "preempts")
         if self.coordinator is None:
             return
         self.supervisor.preempts_broadcast.inc()
@@ -840,6 +1040,41 @@ class FaultTolerantTrainer:
             d["async_writes"] = self._writer.writes
         if self.injector is not None:
             d["injector"] = self.injector.snapshot()
+        return d
+
+    def telemetry_snapshot(self) -> dict:
+        """The one dict the training /metrics plane renders (UIServer
+        registers this as a metrics provider): supervisor counters, the
+        step-phase breakdown, async-writer queue/stall state, wrapper
+        telemetry (worker count / re-mesh / compression effectiveness)
+        and fleet/event rollups. Every numeric leaf here lands in the
+        Prometheus exposition — the generic parity walker asserts it."""
+        sup = self.supervisor
+        ph = self._phases
+        data_wait = ph.get("data_wait_s", 0.0)
+        device = ph.get("device_step_s", 0.0)
+        wall = data_wait + device + sup.checkpoint_stall_s
+        d = {
+            "supervisor": sup.snapshot(),
+            "phases": {
+                "data_wait_s": round(data_wait, 6),
+                "device_step_s": round(device, 6),
+                "checkpoint_stall_s": round(sup.checkpoint_stall_s, 6),
+                "data_wait_frac": (round(data_wait / wall, 4)
+                                   if wall > 0 else 0.0),
+                "checkpoint_stall_frac": (
+                    round(sup.checkpoint_stall_s / wall, 4)
+                    if wall > 0 else 0.0),
+            },
+        }
+        if self._writer is not None:
+            d["checkpoint_writer"] = self._writer.snapshot()
+        if self.wrapper is not None:
+            d["wrapper"] = self.wrapper.telemetry_snapshot()
+        if self.fleet is not None:
+            d["fleet_workers"] = self.fleet.snapshot()
+        if self.events is not None:
+            d["events"] = self.events.counts()
         return d
 
     @staticmethod

@@ -104,6 +104,14 @@ class TrainingSupervisor:
         # accumulator …), registered by the trainer/wrapper
         self.extra_state_fn: Optional[Callable[[], Optional[Dict]]] = None
         self.load_extra_fn: Optional[Callable[[Dict], None]] = None
+        # observability hooks, attached by the trainer when telemetry
+        # was requested; all default None so an unobserved run pays
+        # nothing on the cold (retry/anomaly/rollback) branches and
+        # NOTHING AT ALL on the happy path
+        self.events = None           # EventTimeline
+        self.fleet = None            # FleetTelemetry
+        self.worker: Optional[int] = None
+        self.obs = None              # trainer's retro-span ring
 
     # -- retry ----------------------------------------------------------
     def _fire_retrying(self, seam: str):
@@ -148,7 +156,12 @@ class TrainingSupervisor:
                 self.retries.inc()
                 if attempt >= self.max_step_retries:
                     raise
+                t_r = time.perf_counter()
                 time.sleep(self.retry_backoff_ms * (2 ** attempt) / 1e3)
+                if self.obs is not None:
+                    self.obs.append(("retry", t_r, time.perf_counter(),
+                                     {"attempt": attempt + 1,
+                                      "seam": "train_step"}))
                 attempt += 1
         if self.anomaly_guard:
             params, opt, net, loss, ok = out
@@ -165,6 +178,11 @@ class TrainingSupervisor:
             self._rollbacks_since_good = 0
             return True, loss
         self.anomalies_skipped.inc()
+        if self.events is not None:
+            self.events.record("anomaly_skip", worker=self.worker,
+                               step=int(model._step))
+        if self.fleet is not None:
+            self.fleet.inc(self.worker or 0, "anomaly_skips")
         self._consecutive += 1
         if self._consecutive >= self.rollback_after:
             self._consecutive = 0
@@ -203,6 +221,7 @@ class TrainingSupervisor:
         snap = self._last_good
         if snap is None:
             return False
+        t0 = time.perf_counter()
         model._params = _unflatten_like(model._params, snap["params"])
         if snap.get("opt_state") is not None:
             model._opt_state = _unflatten_like(model._opt_state,
@@ -220,6 +239,14 @@ class TrainingSupervisor:
             self.load_extra_fn(snap["extra"])
         self.rollbacks.inc()
         self._rollbacks_since_good += 1
+        if self.obs is not None:
+            self.obs.append(("rollback", t0, time.perf_counter(),
+                             {"to_step": int(meta["step"])}))
+        if self.events is not None:
+            self.events.record("rollback", worker=self.worker,
+                               to_step=int(meta["step"]))
+        if self.fleet is not None:
+            self.fleet.inc(self.worker or 0, "rollbacks")
         return True
 
     def snapshot(self) -> Dict:
@@ -328,6 +355,19 @@ class AsyncCheckpointWriter:
     @property
     def closed(self) -> bool:
         return self._closed
+
+    def snapshot(self) -> Dict:
+        """Queue/stall state for the training /metrics plane: completed
+        writes, cumulative background write seconds, and whether a
+        write is in flight or queued right now."""
+        with self._cv:
+            return {
+                "writes": self.writes,
+                "write_s_total": round(self.write_s_total, 6),
+                "busy": int(self._busy),
+                "pending": int(self._pending is not None),
+                "closed": int(self._closed),
+            }
 
     def close(self):
         with self._cv:

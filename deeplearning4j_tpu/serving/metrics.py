@@ -331,6 +331,14 @@ _PROM_COUNTERS = frozenset({
     "streams", "sheds", "cooldowns", "breaker_trips",
     "breaker_probes", "breaker_recoveries", "fleet_shed",
     "session_affinity_hits",
+    # training-side counters (supervisor / async writer / per-worker
+    # fleet telemetry / event-timeline rollups / stats router)
+    "anomalies_skipped", "async_checkpoints", "sync_checkpoints",
+    "sharded_checkpoints", "preemptions", "preempts_broadcast",
+    "preempts_received", "writes", "steps", "preempts",
+    "anomaly_skips", "dropped",
+    "preempt_broadcast", "preempt_received", "anomaly_skip",
+    "rollback", "checkpoint_commit", "re_mesh", "resume",
 })
 
 _RESERVOIR_KEYS = frozenset(RESERVOIR_SNAPSHOT_KEYS)
@@ -404,7 +412,11 @@ def _walk(w: _PromWriter, base: str, labels: Dict, obj) -> None:
             w.sample(base + "_mean", "gauge", labels, obj["mean"])
             w.sample(base + "_max", "gauge", labels, obj["max"])
             return
-        if obj and all(_is_int_key(k) for k in obj):
+        if obj and all(_is_int_key(k) for k in obj) and \
+                all(isinstance(v, (int, float)) for v in obj.values()):
+            # CountHistogram shape: int keys, numeric values -> one
+            # bucket-labelled series. Int-keyed dicts of DICTS (e.g.
+            # per-worker fleet telemetry) fall through to nested paths
             for k, v in obj.items():
                 w.sample(base, "gauge", {**labels, "bucket": k}, v)
             return

@@ -17,6 +17,7 @@ import time
 from collections import defaultdict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
@@ -147,6 +148,11 @@ class RemoteUIStatsStorageRouter:
         self._shutdown.set()
         self._thread.join(timeout)
 
+    def snapshot(self) -> dict:
+        """Queue/drop state for the /metrics plane — ``dropped`` was
+        always counted but never exposed anywhere scrapable."""
+        return {"dropped": self.dropped, "queued": self._q.qsize()}
+
     # storage-protocol stubs: a router is write-only (the reference's
     # StatsStorageRouter is exactly the put-side interface)
     def list_session_ids(self):
@@ -176,12 +182,26 @@ class StatsListener(TrainingListener):
         self.histogram_bins = int(histogram_bins)
         self._last_time = None
         self._prev_params: Optional[dict] = None
+        # throughput accumulation (ref: PerformanceListener's
+        # samples/sec) — fed by on_timing, reported per update
+        self._samples = 0
+        self._seconds = 0.0
+        self.last_samples_per_sec: Optional[float] = None
 
     @staticmethod
     def _flat_items(params):
         for lkey, ptree in params.items():
             for pname, arr in ptree.items():
                 yield f"{lkey}.{pname}", np.asarray(arr)
+
+    def on_timing(self, model, seconds: float, batch_size: int):
+        """Step-duration hook (dispatched by the training loops after
+        iteration_done): accumulates the PerformanceListener-style
+        samples/sec throughput reported with the NEXT update."""
+        self._samples += int(batch_size)
+        self._seconds += float(seconds)
+        if self._seconds > 0:
+            self.last_samples_per_sec = self._samples / self._seconds
 
     def iteration_done(self, model, iteration: int, epoch: int):
         if iteration % self.report_every:
@@ -192,6 +212,14 @@ class StatsListener(TrainingListener):
         if self._last_time is not None:
             update["iter_seconds"] = now - self._last_time
         self._last_time = now
+        if self.last_samples_per_sec is not None:
+            update["samples_per_sec"] = round(self.last_samples_per_sec, 3)
+        # step-phase breakdown, maintained by the resilient trainer
+        # (FaultTolerantTrainer) on the model it drives
+        ph = getattr(model, "_phase_breakdown", None)
+        if ph:
+            update["phases"] = {k: round(float(v), 6)
+                                for k, v in ph.items()}
         if self.collect_params and getattr(model, "_params", None):
             mm, um, hists, snap = {}, {}, {}, {}
             for name, a in self._flat_items(model._params):
@@ -386,6 +414,14 @@ class UIServer:
     def __init__(self, port: int = 0):
         self.storages: List = []
         self._remote_storage = None
+        # training observability plane (PR 10's serving endpoints,
+        # grown onto the training UI): a Tracer for /debug/traces, an
+        # EventTimeline for /events, and named snapshot providers
+        # (trainer.telemetry_snapshot, router.snapshot, ...) whose
+        # merged dict /metrics renders as Prometheus text
+        self.tracer = None
+        self.events = None
+        self._metrics_providers: Dict[str, callable] = {}
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -399,6 +435,15 @@ class UIServer:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _text(self, body: str, code=200):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "text/plain; "
+                                 "version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
 
             def do_POST(self):
                 # remote stats routing (ref: PlayUIServer.java:401
@@ -481,6 +526,37 @@ class UIServer:
                             hists[k] = v  # keep latest
                     self._json({"iterations": iters, "params": params,
                                 "updates": updates, "histograms": hists})
+                elif self.path.partition("?")[0] == "/metrics":
+                    # one source of truth, two encodings: the same
+                    # snapshot dicts /metrics renders are what the
+                    # stats plane serves as JSON (parity test-asserted)
+                    from ..serving.metrics import prometheus_text
+                    self._text(prometheus_text(server.metrics_snapshot()))
+                elif self.path.partition("?")[0] == "/debug/traces":
+                    if server.tracer is None:
+                        self._json({"error": "no tracer attached"}, 404)
+                        return
+                    q = parse_qs(urlparse(self.path).query)
+                    rid = (q.get("request_id") or q.get("id")
+                           or [None])[0]
+                    limit = int((q.get("limit") or [50])[0])
+                    self._json({
+                        "traces": server.tracer.dump(
+                            request_id=rid, limit=limit),
+                        "tracer": server.tracer.snapshot()})
+                elif self.path.partition("?")[0] == "/events":
+                    if server.events is None:
+                        self._json({"error": "no event timeline "
+                                    "attached"}, 404)
+                        return
+                    q = parse_qs(urlparse(self.path).query)
+                    kind = (q.get("kind") or [None])[0]
+                    limit = q.get("limit")
+                    self._json({
+                        "events": server.events.dump(
+                            limit=int(limit[0]) if limit else None,
+                            kind=kind),
+                        "counts": server.events.counts()})
                 else:
                     self._json({"error": "not found"}, 404)
 
@@ -498,6 +574,50 @@ class UIServer:
 
     def attach(self, storage):
         self.storages.append(storage)
+
+    def attach_tracer(self, tracer):
+        """Serve this Tracer's rings at ``GET /debug/traces``."""
+        self.tracer = tracer
+
+    def attach_events(self, timeline):
+        """Serve this EventTimeline at ``GET /events``."""
+        self.events = timeline
+
+    def add_metrics_provider(self, name: str, fn):
+        """Register a named snapshot callable (e.g. a trainer's
+        ``telemetry_snapshot`` or a stats router's ``snapshot``); its
+        dict lands under ``name`` in :meth:`metrics_snapshot` and so in
+        the ``GET /metrics`` Prometheus exposition."""
+        self._metrics_providers[name] = fn
+
+    def remove_metrics_provider(self, name: str):
+        self._metrics_providers.pop(name, None)
+
+    def metrics_snapshot(self) -> dict:
+        """The single stats dict ``GET /metrics`` renders: every
+        registered provider's snapshot plus the latest StatsListener
+        update per attached session (phase breakdown and samples/sec
+        included) — the exposition and the JSON stats plane cannot
+        drift because both read this."""
+        snap: Dict[str, dict] = {}
+        for name, fn in self._metrics_providers.items():
+            try:
+                snap[name] = fn()
+            except Exception as e:  # noqa: BLE001 — one broken
+                snap[name] = {"provider_error": repr(e)}  # provider
+                # must not take down the whole scrape
+        sessions: Dict[str, dict] = {}
+        for st in self.storages:
+            try:
+                for sid in st.list_session_ids():
+                    ups = st.get_updates(sid)
+                    if ups:
+                        sessions[sid] = ups[-1]
+            except Exception:  # noqa: BLE001
+                pass
+        if sessions:
+            snap["training_sessions"] = sessions
+        return snap
 
     def enable_remote_listener(self, storage=None):
         """Accept POSTed stats from remote workers at /remoteReceive

@@ -252,31 +252,10 @@ class TestReplicaTracingHTTP:
 # ---------------------------------------------------------------------
 # /metrics: Prometheus text exposition
 # ---------------------------------------------------------------------
-_SAMPLE_RE = re.compile(
-    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
-    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
-    r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?'
-    r' (-?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?)$')
-_TYPE_RE = re.compile(
-    r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) (counter|gauge|summary)$")
-
-
-def _parse_prometheus(text):
-    """Mini exposition parser: validates the grammar line by line and
-    returns {(name, labels_str): float} plus {name: type}."""
-    samples, types = {}, {}
-    for line in text.splitlines():
-        if not line.strip():
-            continue
-        mt = _TYPE_RE.match(line)
-        if mt:
-            types[mt.group(1)] = mt.group(2)
-            continue
-        assert not line.startswith("#"), f"unexpected comment: {line!r}"
-        ms = _SAMPLE_RE.match(line)
-        assert ms, f"invalid exposition line: {line!r}"
-        samples[(ms.group(1), ms.group(2) or "")] = float(ms.group(3))
-    return samples, types
+# parser + generic snapshot-vs-exposition walker live in _obs_util so
+# the training-side tests share them (ISSUE 13)
+from _obs_util import assert_exposition_parity  # noqa: E402
+from _obs_util import parse_prometheus as _parse_prometheus  # noqa: E402
 
 
 class TestPrometheusExposition:
@@ -295,24 +274,17 @@ class TestPrometheusExposition:
                 "text/plain; version=0.0.4")
             samples, types = _parse_prometheus(resp.read().decode())
             assert types, "no # TYPE lines"
+            # EVERY numeric leaf of the /stats snapshot must appear on
+            # /metrics with the documented name/type/value (the generic
+            # walker replaces per-family hand asserts — ISSUE 13)
+            checked = assert_exposition_parity(stats, samples, types)
+            assert checked > 20
+            # spot-check the mapping conventions survived
             key = ("dl4j_model_requests_total", '{model="default"}')
             assert samples[key] == stats["models"]["default"]["requests"]
-            assert types["dl4j_model_requests_total"] == "counter"
-            key = ("dl4j_model_responses_total", '{model="default"}')
-            assert samples[key] == stats["models"]["default"]["responses"]
-            # reservoir -> summary with quantile labels
-            q99 = ("dl4j_model_latency_ms",
-                   '{model="default",quantile="0.99"}')
-            assert q99 in samples
             assert types["dl4j_model_latency_ms"] == "summary"
-            cnt = ("dl4j_model_latency_ms_count", '{model="default"}')
-            assert samples[cnt] == \
-                stats["models"]["default"]["latency_ms"]["count"]
-            # batch histogram -> bucket-labelled series
             assert any(n == "dl4j_model_batch_hist" and "bucket=" in lab
                        for n, lab in samples)
-            # summary-level counter from the server block
-            assert ("dl4j_server_client_disconnects_total", "") in samples
         finally:
             srv.stop()
 
@@ -331,11 +303,9 @@ class TestPrometheusExposition:
             stats = _get_json(base + "/stats")
             resp = urllib.request.urlopen(base + "/metrics", timeout=30)
             samples, types = _parse_prometheus(resp.read().decode())
+            assert_exposition_parity(stats, samples, types)
             assert samples[("dl4j_fleet_requests_total", "")] == \
                 stats["fleet"]["requests"]
-            assert samples[("dl4j_fleet_responses_total", "")] == \
-                stats["fleet"]["responses"]
-            assert types["dl4j_fleet_requests_total"] == "counter"
             # per-replica families carry {replica=...}
             assert any(n == "dl4j_replica_in_flight" and "replica=" in lab
                        for n, lab in samples)
@@ -360,23 +330,20 @@ class TestPrometheusExposition:
             g.generate(prompt, max_tokens=3, timeout_ms=60_000,
                        session_id="s1")
             base = f"http://{srv.host}:{srv.port}"
-            pc = _get_json(base + "/stats")["models"]["lm"]["paged"][
-                "prefix_cache"]
+            stats = _get_json(base + "/stats")
+            pc = stats["models"]["lm"]["paged"]["prefix_cache"]
             assert pc["prefix_hits"] >= 1 and pc["sessions_live"] == 1
             samples, types = _parse_prometheus(urllib.request.urlopen(
                 base + "/metrics", timeout=30).read().decode())
+            # the generic walker covers every prefix-cache leaf
+            # (counters as _total, gauges bare) plus the rest of the
+            # snapshot in one pass
+            assert_exposition_parity(stats, samples, types)
             lab = '{model="lm"}'
             stem = "dl4j_model_paged_prefix_cache_"
-            for leaf in ("prefix_hits", "session_hits",
-                         "session_misses", "prefix_tokens_matched",
-                         "prefill_tokens", "cow_copies",
-                         "prefix_evictions", "session_evictions"):
-                assert samples[(f"{stem}{leaf}_total", lab)] == pc[leaf]
-                assert types[f"{stem}{leaf}_total"] == "counter"
-            for leaf in ("shared_blocks", "prefix_blocks",
-                         "sessions_live"):
-                assert samples[(f"{stem}{leaf}", lab)] == pc[leaf]
-                assert types[f"{stem}{leaf}"] == "gauge"
+            assert samples[(f"{stem}prefix_hits_total", lab)] == \
+                pc["prefix_hits"]
+            assert types[f"{stem}sessions_live"] == "gauge"
         finally:
             srv.stop()
 
@@ -395,23 +362,20 @@ class TestPrometheusExposition:
                 g.generate([1 + i, 5, 2, 9], max_tokens=8,
                            temperature=0.0, seed=i, timeout_ms=60_000)
             base = f"http://{srv.host}:{srv.port}"
-            sp = _get_json(base + "/stats")["models"]["lm"]["spec"]
+            stats = _get_json(base + "/stats")
+            sp = stats["models"]["lm"]["spec"]
             assert sp["enabled"] is True
             assert sp["verify_batches"] >= 1
             assert sp["draft_tokens_proposed"] == \
                 2 * sp["verify_batches"]
             samples, types = _parse_prometheus(urllib.request.urlopen(
                 base + "/metrics", timeout=30).read().decode())
+            # every spec leaf (and everything else) via the walker
+            assert_exposition_parity(stats, samples, types)
             lab = '{model="lm"}'
-            stem = "dl4j_model_spec_"
-            for leaf in ("draft_tokens_proposed",
-                         "draft_tokens_accepted", "verify_batches",
-                         "rollbacks", "draft_fallbacks"):
-                assert samples[(f"{stem}{leaf}_total", lab)] == sp[leaf]
-                assert types[f"{stem}{leaf}_total"] == "counter"
-            for leaf in ("enabled", "speculation_k", "accept_rate"):
-                assert samples[(f"{stem}{leaf}", lab)] == sp[leaf]
-                assert types[f"{stem}{leaf}"] == "gauge"
+            assert samples[("dl4j_model_spec_verify_batches_total",
+                            lab)] == sp["verify_batches"]
+            assert types["dl4j_model_spec_accept_rate"] == "gauge"
         finally:
             srv.stop()
 

@@ -1,0 +1,600 @@
+"""Training observability tests (ISSUE 13): step-phase tracing,
+per-worker fleet telemetry, the structured event timeline, and the
+training /metrics plane on the UIServer.
+
+Covers:
+- zero-cost-when-disabled discipline for the training step loop: the
+  hot functions carry NO tracing code at all (source-scanned) and an
+  instrumented-but-disabled fit allocates nothing attributable to the
+  tracing module (tracemalloc-asserted);
+- the retroactive span construction: a traced fit yields per-phase
+  spans (data_wait, device_step, host_snapshot, checkpoint_submit,
+  checkpoint_write) hung off one `fit` root without the loop ever
+  calling the tracer;
+- EventTimeline bounds/dump/counts and FleetTelemetry EWMAs/straggler
+  spread;
+- satellite exposure: RemoteUIStatsStorageRouter.dropped, the
+  supervisor's checkpoint_write_s, and AsyncCheckpointWriter
+  queue/stall state all land on the training `GET /metrics`;
+- tools/trace_report.py's training sections (phase breakdown,
+  straggler report, event timeline);
+- the stitched acceptance scenario: a 3-worker elastic run with one
+  injected mid-run preemption, reconstructed ENTIRELY from
+  /debug/traces + /events + /metrics via trace_report.
+"""
+import importlib.util
+import inspect
+import json
+import os
+import threading
+import time
+import tracemalloc
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+from deeplearning4j_tpu.faults import FaultInjector, PreemptionFault
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel.elastic import FaultTolerantTrainer
+from deeplearning4j_tpu.parallel.multihost import PreemptionCoordinator
+from deeplearning4j_tpu.parallel.resilience import (AsyncCheckpointWriter,
+                                                    TrainingSupervisor)
+from deeplearning4j_tpu.parallel.telemetry import (EventTimeline,
+                                                   FleetTelemetry)
+from deeplearning4j_tpu.tracing import Tracer
+from deeplearning4j_tpu.ui import (InMemoryStatsStorage,
+                                   RemoteUIStatsStorageRouter,
+                                   StatsListener, UIServer)
+
+from _obs_util import assert_exposition_parity, parse_prometheus
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mlp(seed=0):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=2, loss="mcxent",
+                               activation="softmax"))
+            .input_type_feed_forward(4).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _arrays(n=64, seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.rand(n, 4).astype(np.float32)
+    return X, np.eye(2, dtype=np.float32)[rs.randint(0, 2, n)]
+
+
+def _it(X, Y, batch=16):
+    return ArrayDataSetIterator(X, Y, batch=batch, shuffle=True, seed=3)
+
+
+def _get_json(url, timeout=30):
+    return json.loads(urllib.request.urlopen(url, timeout=timeout).read())
+
+
+def _load_trace_report():
+    spec = importlib.util.spec_from_file_location(
+        "trp_training", os.path.join(ROOT, "tools", "trace_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------
+# zero-cost-when-disabled
+# ---------------------------------------------------------------------
+class TestZeroCostDisabled:
+
+    def test_hot_path_sources_carry_no_tracing_code(self):
+        """The retroactive-span design means the per-step functions
+        must not even MENTION tracing: the loop appends plain tuples to
+        a ring that is None unless a trace is live. A 'trace' string
+        appearing in these sources is a design regression, not a
+        style nit."""
+        for fn in (FaultTolerantTrainer._run_one_step,
+                   FaultTolerantTrainer._after_step,
+                   TrainingSupervisor.step):
+            src = inspect.getsource(fn).lower()
+            assert "trace" not in src, \
+                f"{fn.__qualname__} mentions tracing in the hot path"
+
+    def test_disabled_instrumented_fit_allocates_nothing_in_tracing(
+            self, tmp_path):
+        """An attached-but-disabled Tracer costs the step loop nothing:
+        no allocation in the run is attributable to tracing.py."""
+        X, Y = _arrays()
+        m = _mlp()
+        tr = FaultTolerantTrainer(m, str(tmp_path / "ck"),
+                                  save_every_n_steps=3,
+                                  tracer=Tracer(enabled=False))
+        tr.fit(_it(X, Y), epochs=1)          # warm/compile pass
+        trace_py = os.path.join("deeplearning4j_tpu", "tracing.py")
+        tracemalloc.start()
+        try:
+            tr.fit(_it(X, Y), epochs=1)
+            snap = tracemalloc.take_snapshot()
+        finally:
+            tracemalloc.stop()
+        hits = [st for st in snap.statistics("filename")
+                if st.traceback[0].filename.endswith(trace_py)]
+        assert not hits, (
+            "disabled training tracing must allocate nothing: "
+            f"{[(h.traceback[0].filename, h.size) for h in hits]}")
+        assert tr._obs is None and tr.supervisor.obs is None
+
+    def test_traced_fit_builds_phase_spans_retroactively(self, tmp_path):
+        """Enabled tracer: one `fit` root per fit() call, per-step
+        data_wait/device_step spans and checkpoint-cadence spans all
+        reconstructed from the ring at fit exit."""
+        X, Y = _arrays()
+        m = _mlp()
+        tracer = Tracer(enabled=True)
+        tr = FaultTolerantTrainer(m, str(tmp_path / "ck"),
+                                  save_every_n_steps=2, tracer=tracer,
+                                  worker_id=5)
+        tr.fit(_it(X, Y), epochs=1)          # 4 steps, ckpt every 2
+        traces = tracer.dump()
+        assert len(traces) == 1
+        t = traces[0]
+        assert t["request_id"].startswith("train-w5-")
+        kinds = {}
+        for s in t["spans"]:
+            kinds[s["kind"]] = kinds.get(s["kind"], 0) + 1
+        assert kinds["fit"] == 1
+        assert kinds["data_wait"] == 4
+        assert kinds["device_step"] == 4
+        assert kinds["host_snapshot"] == 2
+        assert kinds["checkpoint_submit"] == 2
+        assert kinds["checkpoint_write"] >= 1
+        # every non-root span hangs off the fit root
+        root = next(s for s in t["spans"] if s["kind"] == "fit")
+        assert all(s["parent_id"] == root["span_id"]
+                   for s in t["spans"] if s is not root)
+        # the root carries the phase totals the fractions derive from
+        assert "data_wait_s" in root["attrs"]
+        assert "checkpoint_stall_s" in root["attrs"]
+        # device_step spans are worker-attributed for straggler reports
+        ds = next(s for s in t["spans"] if s["kind"] == "device_step")
+        assert ds["attrs"]["worker"] == 5
+        # and the trainer's own snapshot exposes the phase fractions
+        ph = tr.telemetry_snapshot()["phases"]
+        assert ph["device_step_s"] > 0
+        assert 0.0 <= ph["data_wait_frac"] <= 1.0
+
+
+# ---------------------------------------------------------------------
+# telemetry primitives
+# ---------------------------------------------------------------------
+class TestEventTimeline:
+
+    def test_ring_is_bounded_but_counts_survive_eviction(self):
+        ev = EventTimeline(capacity=4)
+        for i in range(10):
+            ev.record("anomaly_skip", worker=0, step=i)
+        assert len(ev) == 4
+        assert ev.counts() == {"anomaly_skip": 10}
+        # oldest evicted: the dump starts at step 6
+        assert [e["step"] for e in ev.dump()] == [6, 7, 8, 9]
+
+    def test_dump_filters_by_kind_and_limits(self):
+        ev = EventTimeline()
+        ev.record("preempt_broadcast", worker=1, step=4)
+        ev.record("preempt_received", worker=0, step=4)
+        ev.record("preempt_received", worker=2, step=4)
+        ev.record("checkpoint_commit", worker=1, duration_ms=2.0)
+        got = ev.dump(kind="preempt_received")
+        assert [e["worker"] for e in got] == [0, 2]
+        assert len(ev.dump(limit=2)) == 2
+        assert all("ts" in e for e in got)
+        ev.clear()
+        assert len(ev) == 0 and ev.counts() == {}
+
+
+class TestFleetTelemetry:
+
+    def test_ewma_seeds_on_first_observation(self):
+        ft = FleetTelemetry(alpha=0.5)
+        ft.observe_step(0, 0.100)
+        assert ft.snapshot()["workers"]["0"]["ewma_ms"] == 100.0
+        ft.observe_step(0, 0.200)              # 0.5*100 + 0.5*200
+        assert ft.snapshot()["workers"]["0"]["ewma_ms"] == 150.0
+
+    def test_straggler_spread_is_slowest_over_median(self):
+        ft = FleetTelemetry()
+        for w, s in ((0, 0.010), (1, 0.010), (2, 0.030)):
+            ft.observe_step(w, s)
+        st = ft.straggler()
+        assert st["slowest_worker"] == 2
+        assert st["median_ms"] == 10.0
+        assert st["spread"] == 3.0
+
+    def test_counters_and_unknown_key_raises(self):
+        ft = FleetTelemetry()
+        ft.inc(1, "preempts")
+        ft.inc(1, "rollbacks", 2)
+        w = ft.snapshot()["workers"]["1"]
+        assert (w["preempts"], w["rollbacks"], w["anomaly_skips"]) \
+            == (1, 2, 0)
+        with pytest.raises(KeyError):
+            ft.inc(1, "nonsense")
+
+
+# ---------------------------------------------------------------------
+# training /metrics exposure (satellite: dropped / checkpoint_write_s /
+# writer queue state)
+# ---------------------------------------------------------------------
+class TestTrainingMetricsPlane:
+
+    def test_trainer_snapshot_exports_with_full_parity(self, tmp_path):
+        """The whole telemetry_snapshot tree — supervisor counters
+        (checkpoint_write_s included), phase breakdown, async-writer
+        queue/stall state — lands on the UIServer's /metrics with
+        documented names/types/values (generic walker)."""
+        X, Y = _arrays()
+        m = _mlp()
+        tr = FaultTolerantTrainer(m, str(tmp_path / "ck"),
+                                  save_every_n_steps=2,
+                                  fleet_telemetry=FleetTelemetry(),
+                                  events=EventTimeline(), worker_id=0)
+        tr.fit(_it(X, Y), epochs=1)
+        snap = tr.telemetry_snapshot()
+        assert snap["supervisor"]["checkpoint_write_s"] > 0
+        assert snap["checkpoint_writer"]["writes"] >= 1
+        assert snap["checkpoint_writer"]["busy"] in (0, 1)
+        assert snap["checkpoint_writer"]["pending"] in (0, 1)
+        ui = UIServer(port=0)
+        try:
+            ui.add_metrics_provider("training", tr.telemetry_snapshot)
+            base = f"http://127.0.0.1:{ui.port}"
+            resp = urllib.request.urlopen(base + "/metrics", timeout=30)
+            assert resp.headers.get("Content-Type", "").startswith(
+                "text/plain; version=0.0.4")
+            samples, types = parse_prometheus(resp.read().decode())
+            assert_exposition_parity(ui.metrics_snapshot(), samples,
+                                     types)
+            # the satellite's named leaves, by their exposition names
+            assert ("dl4j_training_supervisor_checkpoint_write_s",
+                    "") in samples
+            assert samples[("dl4j_training_checkpoint_writer_"
+                            "writes_total", "")] >= 1
+            assert types["dl4j_training_checkpoint_writer_busy"] \
+                == "gauge"
+            # per-worker fleet telemetry renders as nested families
+            assert ("dl4j_training_fleet_workers_workers_0_steps_total",
+                    "") in samples
+        finally:
+            ui.stop()
+
+    def test_stats_router_dropped_is_scrapable(self, tmp_path):
+        """RemoteUIStatsStorageRouter.dropped (always counted, never
+        exposed before) reaches /metrics as a counter."""
+        ui = UIServer(port=0)   # remote listener NOT enabled -> 403
+        try:
+            router = RemoteUIStatsStorageRouter(
+                f"http://127.0.0.1:{ui.port}", max_retries=1,
+                retry_backoff_s=0.01)
+            router.put_update("s1", {"iteration": 0, "score": 1.0})
+            router.shutdown()
+            assert router.snapshot()["dropped"] == 1
+            ui.add_metrics_provider("stats_router", router.snapshot)
+            base = f"http://127.0.0.1:{ui.port}"
+            samples, types = parse_prometheus(urllib.request.urlopen(
+                base + "/metrics", timeout=30).read().decode())
+            assert samples[("dl4j_stats_router_dropped_total", "")] == 1
+            assert types["dl4j_stats_router_dropped_total"] == "counter"
+            assert samples[("dl4j_stats_router_queued", "")] == 0
+        finally:
+            ui.stop()
+
+    def test_broken_provider_does_not_take_down_the_scrape(self):
+        ui = UIServer(port=0)
+        try:
+            ui.add_metrics_provider("good", lambda: {"steps": 3})
+            ui.add_metrics_provider(
+                "bad", lambda: (_ for _ in ()).throw(RuntimeError("x")))
+            base = f"http://127.0.0.1:{ui.port}"
+            samples, _ = parse_prometheus(urllib.request.urlopen(
+                base + "/metrics", timeout=30).read().decode())
+            assert samples[("dl4j_good_steps_total", "")] == 3
+            snap = ui.metrics_snapshot()
+            assert "provider_error" in snap["bad"]
+        finally:
+            ui.stop()
+
+    def test_stats_listener_reports_phases_and_samples_per_sec(
+            self, tmp_path):
+        """StatsListener picks up the trainer-maintained phase
+        breakdown and the on_timing-fed samples/sec, and the latest
+        update reaches /metrics under training_sessions."""
+        X, Y = _arrays()
+        m = _mlp()
+        storage = InMemoryStatsStorage()
+        m.set_listeners(StatsListener(storage, session_id="sess",
+                                      collect_params=False))
+        tr = FaultTolerantTrainer(m, str(tmp_path / "ck"),
+                                  save_every_n_steps=100)
+        tr.fit(_it(X, Y), epochs=1)
+        ups = storage.get_updates("sess")
+        assert ups, "no StatsListener updates collected"
+        last = ups[-1]
+        assert last["samples_per_sec"] > 0
+        assert last["phases"]["device_step_s"] > 0
+        assert "data_wait_s" in last["phases"]
+        ui = UIServer(port=0)
+        try:
+            ui.attach(storage)
+            base = f"http://127.0.0.1:{ui.port}"
+            samples, types = parse_prometheus(urllib.request.urlopen(
+                base + "/metrics", timeout=30).read().decode())
+            assert ("dl4j_training_sessions_sess_samples_per_sec",
+                    "") in samples
+            assert samples[("dl4j_training_sessions_sess_phases_"
+                            "device_step_s", "")] == \
+                last["phases"]["device_step_s"]
+            assert_exposition_parity(ui.metrics_snapshot(), samples,
+                                     types)
+        finally:
+            ui.stop()
+
+    def test_traces_and_events_endpoints_404_until_attached(self):
+        ui = UIServer(port=0)
+        try:
+            base = f"http://127.0.0.1:{ui.port}"
+            for path in ("/debug/traces", "/events"):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(base + path, timeout=30)
+                assert ei.value.code == 404
+            ui.attach_tracer(Tracer(enabled=True))
+            ui.attach_events(EventTimeline())
+            assert _get_json(base + "/debug/traces")["traces"] == []
+            assert _get_json(base + "/events")["events"] == []
+        finally:
+            ui.stop()
+
+
+# ---------------------------------------------------------------------
+# trace_report training sections (unit)
+# ---------------------------------------------------------------------
+def _span(sid, pid, kind, off, dur, **attrs):
+    return {"span_id": sid, "parent_id": pid, "kind": kind,
+            "t_offset_ms": off, "duration_ms": dur, "attrs": attrs}
+
+
+def _training_trace(worker, step_ms):
+    spans = [_span(1, None, "fit", 0.0, 100.0, worker=worker)]
+    sid = 2
+    off = 0.0
+    for i in range(4):
+        spans.append(_span(sid, 1, "data_wait", off, 1.0))
+        spans.append(_span(sid + 1, 1, "device_step", off + 1.0,
+                           step_ms, worker=worker, step=i))
+        sid += 2
+        off += 1.0 + step_ms
+    spans.append(_span(sid, 1, "host_snapshot", off, 2.0))
+    spans.append(_span(sid + 1, 1, "checkpoint_submit", off + 2.0, 0.5))
+    spans.append(_span(sid + 2, 1, "checkpoint_write", off + 2.5, 30.0))
+    return {"trace_id": f"t{worker}", "request_id": f"train-w{worker}",
+            "duration_ms": 100.0, "error": False, "spans": spans}
+
+
+class TestTraceReportTraining:
+
+    def test_training_phases_fractions(self):
+        trp = _load_trace_report()
+        tp = trp.training_phases([_training_trace(0, 10.0)])
+        # wall = 4*1 data_wait + 4*10 device + 2 snapshot + 0.5 submit
+        assert tp["totals_ms"]["device_step"] == 40.0
+        assert tp["data_wait_frac"] == round(4.0 / 46.5, 4)
+        assert tp["checkpoint_stall_frac"] == round(2.5 / 46.5, 4)
+        # the writer-thread spans are listed but NOT in the stall frac
+        assert tp["totals_ms"]["checkpoint_write"] == 30.0
+        assert tp["kinds"]["device_step"]["count"] == 4
+        assert trp.training_phases([]) == {}
+
+    def test_straggler_report_groups_device_steps_by_worker(self):
+        trp = _load_trace_report()
+        sr = trp.straggler_report(
+            [_training_trace(0, 10.0), _training_trace(1, 10.0),
+             _training_trace(2, 30.0)])
+        assert set(sr["workers"]) == {"0", "1", "2"}
+        assert sr["slowest_worker"] == "2"
+        assert sr["median_p50_ms"] == 10.0
+        assert sr["spread"] == 3.0
+        assert trp.straggler_report([]) == {}
+
+    def test_event_timeline_rebases_and_orders(self):
+        trp = _load_trace_report()
+        evs = [{"ts": 105.0, "kind": "checkpoint_commit", "worker": 1,
+                "duration_ms": 4.0, "bytes": 2048},
+               {"ts": 100.0, "kind": "preempt_broadcast", "worker": 1,
+                "step": 4}]
+        tl = trp.event_timeline(evs)
+        assert [e["kind"] for e in tl] == ["preempt_broadcast",
+                                          "checkpoint_commit"]
+        assert tl[0]["t_offset_s"] == 0.0
+        assert tl[1]["t_offset_s"] == 5.0
+        assert tl[1]["attrs"]["bytes"] == 2048
+        assert trp.event_timeline([]) == []
+
+    def test_report_partitions_event_dumps_and_renders_human(
+            self, tmp_path):
+        trp = _load_trace_report()
+        tf = tmp_path / "traces.json"
+        tf.write_text(json.dumps(
+            {"traces": [_training_trace(0, 10.0),
+                        _training_trace(1, 20.0)]}))
+        ef = tmp_path / "events.json"
+        ef.write_text(json.dumps({"events": [
+            {"ts": 10.0, "kind": "preempt_broadcast", "worker": 1,
+             "step": 4},
+            {"ts": 10.2, "kind": "checkpoint_commit", "worker": 0,
+             "duration_ms": 3.0, "bytes": 4096}],
+            "counts": {"preempt_broadcast": 1, "checkpoint_commit": 1}}))
+        rep = trp.report([str(tf), str(ef)])
+        assert rep["n_traces"] == 2
+        assert rep["training"]["kinds"]["device_step"]["count"] == 8
+        assert rep["stragglers"]["spread"] == round(20.0 / 15.0, 4)
+        assert [e["kind"] for e in rep["events"]] == \
+            ["preempt_broadcast", "checkpoint_commit"]
+        human = trp._fmt_human(rep)
+        assert "training phase breakdown" in human
+        assert "stragglers" in human
+        assert "event timeline" in human
+
+
+# ---------------------------------------------------------------------
+# the acceptance scenario: a 3-worker elastic fleet with one injected
+# mid-run preemption, reconstructed from the three HTTP endpoints alone
+# ---------------------------------------------------------------------
+class TestStitchedFleetObservability:
+
+    def test_preempted_fleet_reconstructs_from_endpoints(self, tmp_path):
+        X, Y = _arrays(n=96)
+        n_workers = 3
+        coord = PreemptionCoordinator()
+        injector = FaultInjector(plan={"preempt": {1: [4]}},
+                                 rates={"train_step": 1.0},
+                                 slow_ms={"train_step": 4.0})
+        tracer = Tracer(enabled=True, ring=16)
+        events = EventTimeline()
+        fleet = FleetTelemetry()
+        models = [_mlp() for _ in range(n_workers)]
+        barrier = threading.Barrier(n_workers)
+
+        class SyncFirstStep:
+            def __init__(self):
+                self.passed = False
+
+            def iteration_done(self, m, step, epoch):
+                if not self.passed:
+                    self.passed = True
+                    barrier.wait(timeout=90)
+        for m in models:
+            m.set_listeners(SyncFirstStep())
+        trainers = [FaultTolerantTrainer(
+            models[i], str(tmp_path / f"w{i}"), save_every_n_steps=100,
+            fault_injector=injector, coordinator=coord, worker_id=i,
+            tracer=tracer, events=events, fleet_telemetry=fleet)
+            for i in range(n_workers)]
+        outcomes = [None] * n_workers
+
+        def run(i):
+            try:
+                trainers[i].fit(_it(X, Y, batch=8), epochs=4)
+                outcomes[i] = "done"
+            except PreemptionFault:
+                outcomes[i] = "preempted"
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(n_workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert outcomes == ["preempted"] * n_workers, outcomes
+
+        ui = UIServer(port=0)
+        try:
+            ui.attach_tracer(tracer)
+            ui.attach_events(events)
+            for i, tr in enumerate(trainers):
+                ui.add_metrics_provider(f"w{i}", tr.telemetry_snapshot)
+            base = f"http://127.0.0.1:{ui.port}"
+            traces_doc = _get_json(base + "/debug/traces?limit=16")
+            events_doc = _get_json(base + "/events")
+            metrics_txt = urllib.request.urlopen(
+                base + "/metrics", timeout=30).read().decode()
+
+            # -- /metrics: full parity + the fleet story in counters
+            samples, types = parse_prometheus(metrics_txt)
+            assert_exposition_parity(ui.metrics_snapshot(), samples,
+                                     types)
+            assert samples[("dl4j_w1_supervisor_preempts_broadcast"
+                            "_total", "")] == 1
+            for i in (0, 2):
+                assert samples[(f"dl4j_w{i}_supervisor_preempts_"
+                                "received_total", "")] == 1
+            # shared fleet telemetry: every worker has steps + an EWMA
+            for i in range(n_workers):
+                assert samples[("dl4j_w0_fleet_workers_workers_"
+                                f"{i}_steps_total", "")] >= 4
+            assert ("dl4j_w0_fleet_workers_straggler_spread",
+                    "") in samples
+
+            # -- /events: broadcast precedes the receipts, every
+            # worker committed a drain checkpoint
+            kinds = [e["kind"] for e in events_doc["events"]]
+            b = kinds.index("preempt_broadcast")
+            assert [e["worker"] for e in events_doc["events"]
+                    if e["kind"] == "preempt_broadcast"] == [1]
+            recv = [i for i, k in enumerate(kinds)
+                    if k == "preempt_received"]
+            assert len(recv) == 2 and all(i > b for i in recv)
+            commits = [e for e in events_doc["events"]
+                       if e["kind"] == "checkpoint_commit"]
+            assert {e["worker"] for e in commits} == {0, 1, 2}
+            assert all(e["duration_ms"] > 0 and e["bytes"] > 0
+                       for e in commits)
+            assert events_doc["counts"]["preempt_broadcast"] == 1
+            assert events_doc["counts"]["preempt_received"] == 2
+
+            # -- trace_report over the dumped endpoints alone
+            tf = tmp_path / "traces.json"
+            tf.write_text(json.dumps(traces_doc))
+            ef = tmp_path / "events.json"
+            ef.write_text(json.dumps(events_doc))
+            trp = _load_trace_report()
+            rep = trp.report([str(tf), str(ef)])
+            assert rep["n_traces"] == n_workers
+            tp = rep["training"]
+            for kind in ("data_wait", "device_step", "preemption_drain"):
+                assert tp["kinds"][kind]["count"] >= 1
+                assert tp["kinds"][kind]["p99_ms"] >= \
+                    tp["kinds"][kind]["p50_ms"]
+            assert 0.0 <= tp["data_wait_frac"] <= 1.0
+            sr = rep["stragglers"]
+            assert set(sr["workers"]) == {"0", "1", "2"}
+            assert sr["spread"] >= 1.0
+            tl = rep["events"]
+            assert [e["t_offset_s"] for e in tl] == \
+                sorted(e["t_offset_s"] for e in tl)
+            story = [e["kind"] for e in tl]
+            assert story.index("preempt_broadcast") < \
+                story.index("checkpoint_commit")
+            human = trp._fmt_human(rep)
+            assert "preempt_broadcast" in human
+            assert "stragglers" in human
+        finally:
+            ui.stop()
+
+    def test_resume_records_resume_event_and_span(self, tmp_path):
+        """After the drain, a resumed worker's new fit records the
+        `resume` event (and span) that closes the timeline's story."""
+        X, Y = _arrays()
+        m = _mlp()
+        inj = FaultInjector(plan={"preempt": [3]})
+        tr = FaultTolerantTrainer(m, str(tmp_path / "ck"),
+                                  save_every_n_steps=100,
+                                  fault_injector=inj)
+        with pytest.raises(PreemptionFault):
+            tr.fit(_it(X, Y), epochs=2)
+        tracer = Tracer(enabled=True)
+        events = EventTimeline()
+        m2 = FaultTolerantTrainer.resume(str(tmp_path / "ck"))
+        tr2 = FaultTolerantTrainer(m2, str(tmp_path / "ck"),
+                                   save_every_n_steps=100,
+                                   tracer=tracer, events=events,
+                                   worker_id=0)
+        tr2.fit(_it(X, Y), epochs=2)
+        evs = events.dump(kind="resume")
+        assert len(evs) == 1 and evs[0]["step"] == 3
+        spans = [s for t in tracer.dump() for s in t["spans"]
+                 if s["kind"] == "resume"]
+        assert len(spans) == 1
+        assert spans[0]["attrs"]["epoch"] >= 0

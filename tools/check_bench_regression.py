@@ -96,6 +96,17 @@ METRICS = {
     # per-request tracing enabled — guards the <5% overhead claim
     ("extra", "generation", "traced_tokens_per_sec"):
         "generation_traced_tokens_per_sec",
+    # host-side scheduler overhead (ISSUE 13): fraction of the
+    # saturated continuous-batching wall clock NOT spent inside the
+    # profiled device sections (prefill/decode/spec) — lower is
+    # better; "new, skipped" until a BENCH_*.json records a baseline
+    ("extra", "generation", "scheduler_overhead_frac"):
+        "generation_scheduler_overhead_frac",
+    # training-trace overhead (ISSUE 13): steps/sec cost of running
+    # the clean supervised schedule with tracer + events + fleet
+    # telemetry + StatsListener attached — guards the <5% claim
+    ("extra", "training_chaos", "training_trace_overhead_frac"):
+        "training_trace_overhead_frac",
     # closed-loop serving tail latency (recorded since BENCH_r05)
     ("extra", "serving", "p99_ms"): "serving_p99_ms",
     # block-level prefix sharing + persistent sessions (ISSUE 11):
@@ -140,6 +151,8 @@ LOWER_IS_BETTER = {
     "overload_latency_admission_p99_ms",
     "overload_latency_device_p99_ms",
     "serving_p99_ms",
+    "generation_scheduler_overhead_frac",
+    "training_trace_overhead_frac",
     "prefix_kv_bytes_per_request",
     "prefix_ttft_p50_ms",
     "prefix_ttft_p99_ms",

@@ -4,11 +4,14 @@ slow-request runbook (docs/observability.md) reaches for, after the
 dump itself.
 
 Input: one or more JSON files, each either a raw ``/debug/traces``
-response (``{"traces": [...], "tracer": {...}}``) or a bare list of
-trace dicts. Passing SEVERAL files merges them by trace id — dump the
-router's ``/debug/traces?request_id=...`` and each replica's into
-separate files and this tool stitches the cross-tier view back
-together, exactly as the propagated ``X-Request-Id`` intended.
+response (``{"traces": [...], "tracer": {...}}``), a bare list of
+trace dicts, or a ``GET /events`` dump (``{"events": [...],
+"counts": {...}}``) from the training UIServer. Passing SEVERAL files
+merges traces by trace id — dump the router's
+``/debug/traces?request_id=...`` and each replica's into separate
+files and this tool stitches the cross-tier view back together,
+exactly as the propagated ``X-Request-Id`` intended. Event dumps from
+several workers merge into one wall-clock-ordered timeline.
 
 Output:
 
@@ -17,7 +20,11 @@ Output:
 - the slowest trace's CRITICAL PATH: starting from its root span,
   repeatedly descend into the longest child (by ``parent_id``), so
   the one chain of spans that bounded the request's latency reads
-  top to bottom.
+  top to bottom;
+- for TRAINING dumps (the FaultTolerantTrainer span kinds): the
+  per-phase breakdown with data-wait and checkpoint-stall fractions,
+  a per-worker straggler report over ``device_step`` spans, and the
+  preemption→drain→checkpoint→resume event timeline.
 
 Deliberately framework-free: reads JSON only (no jax, no numpy, no
 package imports) — safe to run on a wedged host mid-incident, or on
@@ -39,6 +46,14 @@ def _pct(xs, p):
     xs = sorted(xs)
     return xs[min(len(xs) - 1, int(round(p / 100.0 * (len(xs) - 1))))] \
         if xs else 0.0
+
+
+#: span kinds the training loop emits (FaultTolerantTrainer /
+#: TrainingSupervisor / AsyncCheckpointWriter). ``fit`` is the per-fit
+#: root; the rest are its children.
+TRAINING_KINDS = ("fit", "data_wait", "device_step", "host_snapshot",
+                  "checkpoint_submit", "checkpoint_write", "retry",
+                  "rollback", "preemption_drain", "resume", "re_mesh")
 
 
 def load_traces(paths):
@@ -145,6 +160,91 @@ def spec_savings(traces):
     return agg
 
 
+def training_phases(traces):
+    """Training step-phase breakdown over the trainer's span kinds:
+    the per-kind latency table plus total milliseconds per phase and
+    the two runbook fractions — how much of the step loop's wall time
+    went to waiting on data, and how much to checkpoint work on the
+    loop thread (host snapshot + submit; the background
+    ``checkpoint_write`` spans ride the writer thread and are listed
+    but excluded from the stall fraction)."""
+    sums = {}
+    for t in traces:
+        for s in t.get("spans", []):
+            k = s.get("kind")
+            if k == "fit" or k not in TRAINING_KINDS \
+                    or s.get("duration_ms") is None:
+                continue
+            sums[k] = sums.get(k, 0.0) + s["duration_ms"]
+    if not sums:
+        return {}
+    ks = kind_stats(traces)
+    out = {"kinds": {k: ks[k] for k in ks if k in TRAINING_KINDS},
+           "totals_ms": {k: round(v, 3) for k, v in sorted(sums.items())}}
+    wall = (sums.get("data_wait", 0.0) + sums.get("device_step", 0.0)
+            + sums.get("host_snapshot", 0.0)
+            + sums.get("checkpoint_submit", 0.0))
+    if wall > 0:
+        out["data_wait_frac"] = round(sums.get("data_wait", 0.0) / wall, 4)
+        out["checkpoint_stall_frac"] = round(
+            (sums.get("host_snapshot", 0.0)
+             + sums.get("checkpoint_submit", 0.0)) / wall, 4)
+    return out
+
+
+def straggler_report(traces):
+    """Per-worker ``device_step`` latency (count/p50/p99) and the
+    straggler spread — the slowest worker's p50 over the fleet median
+    p50, so 1.0 reads as an even fleet."""
+    by_w = {}
+    for t in traces:
+        for s in t.get("spans", []):
+            if s.get("kind") != "device_step" \
+                    or s.get("duration_ms") is None:
+                continue
+            w = s.get("attrs", {}).get("worker")
+            by_w.setdefault("?" if w is None else str(w), []).append(
+                s["duration_ms"])
+    if not by_w:
+        return {}
+    workers = {w: {"count": len(v),
+                   "p50_ms": round(_pct(v, 50), 3),
+                   "p99_ms": round(_pct(v, 99), 3)}
+               for w, v in sorted(by_w.items())}
+    p50s = sorted(st["p50_ms"] for st in workers.values())
+    n = len(p50s)
+    median = p50s[n // 2] if n % 2 else (p50s[n // 2 - 1]
+                                         + p50s[n // 2]) / 2.0
+    slowest = max(workers, key=lambda w: workers[w]["p50_ms"])
+    return {"workers": workers,
+            "slowest_worker": slowest,
+            "slowest_p50_ms": workers[slowest]["p50_ms"],
+            "median_p50_ms": round(median, 3),
+            "spread": round(workers[slowest]["p50_ms"] / median, 4)
+            if median > 0 else 0.0}
+
+
+def event_timeline(events):
+    """Merge ``/events`` dumps into one wall-clock-ordered timeline,
+    re-based so the first event reads ``+0.000s`` — the
+    preemption→drain→checkpoint→resume story top to bottom."""
+    evs = sorted((e for e in events if isinstance(e, dict)),
+                 key=lambda e: e.get("ts") or 0.0)
+    if not evs:
+        return []
+    t0 = evs[0].get("ts") or 0.0
+    out = []
+    for e in evs:
+        d = {"t_offset_s": round((e.get("ts") or 0.0) - t0, 3),
+             "kind": e.get("kind"), "worker": e.get("worker")}
+        attrs = {k: v for k, v in e.items()
+                 if k not in ("ts", "kind", "worker")}
+        if attrs:
+            d["attrs"] = attrs
+        out.append(d)
+    return out
+
+
 def critical_path(trace):
     """Root-to-leaf chain of longest spans: from each level's longest
     span, descend into its longest child (``parent_id`` links). Open
@@ -170,7 +270,18 @@ def critical_path(trace):
 
 
 def report(paths):
-    traces = load_traces(paths)
+    # partition inputs: an /events dump is a dict with "events" and no
+    # "traces"; everything else goes through the trace loader
+    trace_paths, events = [], []
+    for p in paths:
+        with open(p) as f:
+            doc = json.load(f)
+        if isinstance(doc, dict) and "events" in doc \
+                and "traces" not in doc:
+            events.extend(doc.get("events") or [])
+        else:
+            trace_paths.append(p)
+    traces = load_traces(trace_paths)
     slowest = (max(traces, key=lambda t: t.get("duration_ms") or 0.0)
                if traces else None)
     return {
@@ -179,6 +290,9 @@ def report(paths):
         "kinds": kind_stats(traces),
         "prefix_sharing": prefix_savings(traces),
         "speculation": spec_savings(traces),
+        "training": training_phases(traces),
+        "stragglers": straggler_report(traces),
+        "events": event_timeline(events),
         "slowest": None if slowest is None else {
             "trace_id": slowest.get("trace_id"),
             "request_id": slowest.get("request_id"),
@@ -223,6 +337,38 @@ def _fmt_human(rep):
             f"{sp['accepted']}/{sp['proposed']} accepted "
             f"({sp['accept_rate']:.1%})  "
             f"~{sp['saved_est_ms']:.1f} ms decode saved")
+    tr = rep.get("training")
+    if tr:
+        lines.append("-- training phase breakdown")
+        for k, ms in tr.get("totals_ms", {}).items():
+            lines.append(f"   {k:<18} {ms:>12.3f} ms total")
+        if "data_wait_frac" in tr:
+            lines.append(
+                f"   data-wait fraction {tr['data_wait_frac']:.2%}  "
+                "checkpoint-stall fraction "
+                f"{tr['checkpoint_stall_frac']:.2%}")
+    st = rep.get("stragglers")
+    if st:
+        lines.append("-- stragglers (device_step spans per worker)")
+        for w, s in st["workers"].items():
+            lines.append(f"   worker {w:<4} {s['count']:>6} step(s)  "
+                         f"p50 {s['p50_ms']:>9.3f} ms  "
+                         f"p99 {s['p99_ms']:>9.3f} ms")
+        lines.append(f"   slowest worker {st['slowest_worker']} "
+                     f"(p50 {st['slowest_p50_ms']:.3f} ms) — spread "
+                     f"{st['spread']:.2f}x vs median "
+                     f"{st['median_p50_ms']:.3f} ms")
+    evs = rep.get("events")
+    if evs:
+        lines.append(f"-- event timeline ({len(evs)} event(s))")
+        for e in evs:
+            w = e.get("worker")
+            attrs = " ".join(f"{k}={v}" for k, v in
+                             e.get("attrs", {}).items())
+            lines.append(
+                f"   +{e['t_offset_s']:>8.3f}s  "
+                f"{'w' + str(w) if w is not None else '--':<4} "
+                f"{e['kind']:<18} {attrs}".rstrip())
     s = rep.get("slowest")
     if s:
         lines.append(f"-- slowest trace {s['trace_id']} "
