@@ -1140,6 +1140,198 @@ router.stop()
 fleet.stop(stop_replicas=True)
 """
 
+CONNSCALE_CODE = _COMMON + r"""
+# Connection-scale scenario (ISSUE 14 tentpole): hold ~1,000
+# mostly-idle open STREAMING connections through the router while a
+# probe client measures interactive /predict latency — the regime
+# where thread-per-connection front-ends collapse (one OS thread per
+# open conn at BOTH tiers, ~2 threads + 4 fds per idle stream in this
+# single-process harness) and the event-loop front-end holds (an idle
+# stream is two socket buffers and a parked coroutine). Both backends
+# run at the SAME conn count; the gated numbers are the aio leg's held
+# streams and probe p99, with the thread leg recorded beside them as
+# the honest degradation reference. Idle-ness is real, not simulated:
+# a 4-slot generator with a deep admission queue answers every stream
+# 200 + chunked headers immediately, then leaves all but 4 of them
+# waiting for a slot with zero token traffic.
+import resource
+import socket
+import threading
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.serving import FleetRouter, InferenceServer, \
+    ReplicaFleet
+from deeplearning4j_tpu.zoo.transformer_lm import CausalTransformerLM
+
+N_CONNS = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+N_PROBE = int(sys.argv[2]) if len(sys.argv) > 2 else 50
+
+# fd budget: client sock + router-side sock + router->replica pair =
+# 4 fds per proxied stream, all in THIS process; leave headroom
+soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+try:
+    resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+    soft = hard
+except (ValueError, OSError):
+    pass
+N_CONNS = min(N_CONNS, max((soft - 512) // 5, 16))
+
+conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3)).list()
+        .layer(DenseLayer(n_out=64, activation="relu"))
+        .layer(OutputLayer(n_out=10, loss="mcxent", activation="softmax"))
+        .input_type_feed_forward(16).build())
+mlp = MultiLayerNetwork(conf).init()
+lm = CausalTransformerLM(vocab_size=64, d_model=16, n_layers=1,
+                         n_heads=2, max_seq_len=512, seed=0,
+                         implementation="plain").init()
+probe_req = json.dumps(
+    {"inputs": np.random.RandomState(0).randn(1, 16).tolist(),
+     "timeout_ms": 60_000}).encode()
+stream_body = json.dumps(
+    {"prompt": [1, 2, 3, 4], "max_tokens": 500, "stream": True,
+     "temperature": 0.8, "seed": 0, "timeout_ms": 900_000}).encode()
+stream_head = (b"POST /v1/models/lm/generate HTTP/1.1\r\n"
+               b"Host: bench\r\nContent-Type: application/json\r\n"
+               b"Content-Length: %d\r\n\r\n" % len(stream_body)
+               ) + stream_body
+
+def build(backend):
+    s = InferenceServer(port=0, max_batch_size=8, max_latency_ms=2.0,
+                        max_queue=256, http_backend=backend)
+    s.register("default", mlp)
+    s.served().warmup([1])
+    g = s.register_generator("lm", lm, num_slots=4,
+                             max_queue=N_CONNS + 128,
+                             default_timeout_ms=900_000,
+                             max_seq_len=512, prompt_buckets=[8])
+    g.warmup()
+    fleet = ReplicaFleet(poll_interval_s=0.5)
+    fleet.add(s)
+    router = FleetRouter(fleet, timeout_s=600.0)
+    host, port = router.serve(backend=backend)
+    return s, fleet, router, host, port
+
+def open_streams(host, port, n, failures):
+    socks = [None] * n
+
+    def worker(lo, hi):
+        for i in range(lo, hi):
+            try:
+                sk = socket.create_connection((host, port), timeout=30.0)
+                sk.settimeout(30.0)
+                sk.sendall(stream_head)
+                buf = b""
+                while b"\r\n\r\n" not in buf:
+                    d = sk.recv(4096)
+                    if not d:
+                        raise ConnectionError("closed before headers")
+                    buf += d
+                if not buf.startswith(b"HTTP/1.1 200"):
+                    raise ConnectionError(
+                        buf.split(b"\r\n", 1)[0].decode("latin-1"))
+                socks[i] = sk
+            except Exception as e:  # record, never raise: a dead
+                failures.append(repr(e))  # worker would undercount
+    nw = 16
+    step = (n + nw - 1) // nw
+    ths = [threading.Thread(target=worker, args=(lo, min(lo + step, n)))
+           for lo in range(0, n, step)]
+    t0 = time.perf_counter()
+    for t in ths: t.start()
+    for t in ths: t.join()
+    return socks, time.perf_counter() - t0
+
+def still_open(socks):
+    # an open conn either has nothing pending (mid-stream idle) or
+    # buffered chunks (active / finished keep-alive); a server-side
+    # close reads as EOF
+    n = 0
+    for sk in socks:
+        if sk is None:
+            continue
+        try:
+            sk.setblocking(False)
+            try:
+                n += 1 if sk.recv(65536, socket.MSG_PEEK) else 0
+            except (BlockingIOError, InterruptedError):
+                n += 1
+            finally:
+                sk.setblocking(True)
+        except OSError:
+            pass
+    return n
+
+def probe(host, port, n, fails):
+    import http.client
+    lat = []
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    for _ in range(n):
+        t0 = time.perf_counter()
+        try:
+            conn.request("POST", "/predict", body=probe_req)
+            r = conn.getresponse()
+            r.read()
+            if r.status != 200:
+                fails.append(r.status)
+                continue
+        except (ConnectionError, OSError, http.client.HTTPException) as e:
+            fails.append(repr(e))
+            conn.close()
+            conn = http.client.HTTPConnection(host, port, timeout=60)
+            continue
+        lat.append((time.perf_counter() - t0) * 1e3)
+    conn.close()
+    return lat
+
+def pct(v, p):
+    v = sorted(v)
+    return v[min(len(v) - 1, int(round(p / 100.0 * (len(v) - 1))))] \
+        if v else 0.0
+
+def leg(backend):
+    base_threads = threading.active_count()
+    s, fleet, router, host, port = build(backend)
+    probe(host, port, 3, [])            # warm the probe path unloaded
+    conn_fails, probe_fails = [], []
+    socks, est_s = open_streams(host, port, N_CONNS, conn_fails)
+    time.sleep(0.5)                     # let accept/admission settle
+    threads = threading.active_count() - base_threads
+    lat = probe(host, port, N_PROBE, probe_fails)
+    open_n = still_open(socks)
+    for sk in socks:
+        if sk is not None:
+            try:
+                sk.close()
+            except OSError:
+                pass
+    m = router.metrics
+    out = {"streaming_conns": open_n,
+           "conns_attempted": N_CONNS,
+           "conn_failures": len(conn_fails),
+           "establish_s": round(est_s, 2),
+           "server_threads": threads,
+           "p50_ms": round(pct(lat, 50), 2),
+           "p99_ms": round(pct(lat, 99), 2),
+           "probe_failures": len(probe_fails),
+           "streams_proxied": m.streams,
+           "requests_lost": m.requests_lost}
+    router.stop()
+    fleet.stop(stop_replicas=True)
+    return out
+
+aio = leg("aio")
+thr = leg("thread")
+d = jax.devices()[0]
+print(json.dumps({
+    "model": f"conn-scale router+replica ({N_CONNS} idle streams, "
+             f"{N_PROBE} interactive probes)",
+    "platform": d.platform, "device_kind": d.device_kind,
+    **aio,
+    **{f"thread_{k}": v for k, v in thr.items()},
+    "synthetic_data": True}))
+"""
+
 OVERLOAD_CODE = _COMMON + r"""
 # Open-loop overload harness (ISSUE 9): PRODUCTION-shaped traffic —
 # Poisson arrivals at a configured rate, NOT N looping clients. A
@@ -1980,6 +2172,27 @@ def main():
                                 "hedges", "hedges_won",
                                 "hedge_budget_denied", "ejections")
                                if k in flt}
+        # connection scale (ISSUE 14): ~1,000 idle streaming conns held
+        # through the router on the event-loop front-end vs the thread
+        # backend at the same count, with interactive probe latency
+        # measured under that load (CPU-JAX by design — host-side)
+        cs = _run(CONNSCALE_CODE, _CPU_ENV, timeout=900)
+        if cs:
+            extras["connscale"] = {k: cs[k] for k in
+                                   ("model", "streaming_conns",
+                                    "conns_attempted", "conn_failures",
+                                    "establish_s", "server_threads",
+                                    "p50_ms", "p99_ms",
+                                    "probe_failures", "streams_proxied",
+                                    "requests_lost",
+                                    "thread_streaming_conns",
+                                    "thread_conn_failures",
+                                    "thread_establish_s",
+                                    "thread_server_threads",
+                                    "thread_p50_ms", "thread_p99_ms",
+                                    "thread_probe_failures",
+                                    "thread_requests_lost")
+                                   if k in cs}
         # open-loop overload harness (ISSUE 9): Poisson arrivals with
         # a diurnal ramp and a 2x-measured-capacity overload leg —
         # goodput, shed order, and admitted-interactive SLO under

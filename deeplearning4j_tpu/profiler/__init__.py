@@ -93,6 +93,18 @@ class OpProfiler:
                     self._totals[name] += dt
                     self._counts[name] += 1
 
+    def note(self, name: str, dt_s: float):
+        """Record an externally-measured duration into a section. The
+        pipelined decode loop measures dispatch->sync spans that START
+        in one loop iteration and END in the next — no lexical scope a
+        ``with record()`` block could wrap — so the scheduler times the
+        span itself and deposits it here. Same mode gate and lock as
+        :meth:`record`."""
+        if self.mode in (ProfilingMode.OPERATIONS, ProfilingMode.ALL):
+            with self._rec_lock:
+                self._totals[name] += dt_s
+                self._counts[name] += 1
+
     def check(self, tree, label: str = "array"):
         """Apply the active panic mode to a pytree of arrays."""
         if self.mode in (ProfilingMode.NAN_PANIC, ProfilingMode.ANY_PANIC,

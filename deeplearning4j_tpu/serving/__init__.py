@@ -211,6 +211,17 @@ class InferenceServer:
 
     ``host`` defaults to loopback; pass ``host="0.0.0.0"`` to bind
     externally for multi-host deployments.
+
+    ``http_backend`` selects the socket tier (docs/serving.md
+    "Front-end architecture"): ``"aio"`` (default) serves every
+    connection off one event loop — open connections cost a socket
+    buffer, not a thread, so thousands of idle keep-alive or
+    streaming clients don't breed thousands of blocked threads — with
+    engine-blocking work on a bounded daemon pool and a
+    ``http_header_timeout_s`` slow-loris cap the thread tier never
+    had. ``"thread"`` is the original thread-per-connection
+    ``ThreadingHTTPServer``. Routes, status codes, streaming framing,
+    headers and the access log are identical across backends.
     """
 
     DEFAULT_MODEL = "default"
@@ -230,7 +241,9 @@ class InferenceServer:
                  tracing: bool = False,
                  trace_ring: int = 256,
                  trace_slow_ms: float = 1000.0,
-                 log_requests=False):
+                 log_requests=False,
+                 http_backend: str = "aio",
+                 http_header_timeout_s: float = 10.0):
         self.max_body_bytes = int(max_body_bytes)
         self.registry = registry or ModelRegistry()
         self._owns_registry = registry is None
@@ -545,12 +558,27 @@ class InferenceServer:
                     server._count_disconnect()
                     self.close_connection = True
 
-        self.httpd = _HTTPServer((host, port), Handler)
-        self.host = self.httpd.server_address[0]
-        self.port = self.httpd.server_address[1]
-        self._thread = threading.Thread(target=self.httpd.serve_forever,
-                                        daemon=True)
-        self._thread.start()
+        self.http_backend = http_backend
+        self._aio = None
+        self.httpd = None
+        self._thread = None
+        if http_backend == "thread":
+            self.httpd = _HTTPServer((host, port), Handler)
+            self.host = self.httpd.server_address[0]
+            self.port = self.httpd.server_address[1]
+            self._thread = threading.Thread(
+                target=self.httpd.serve_forever, daemon=True)
+            self._thread.start()
+        elif http_backend == "aio":
+            from .aio import AioReplicaFrontend
+            self._aio = AioReplicaFrontend(
+                self, host, port,
+                header_timeout_s=http_header_timeout_s)
+            self.host = self._aio.host
+            self.port = self._aio.port
+        else:
+            raise ValueError(f"unknown http_backend {http_backend!r} "
+                             "(use 'aio' or 'thread')")
 
     # -- model management ----------------------------------------------
     def register(self, name: str, model, **opts) -> ServedModel:
@@ -828,7 +856,10 @@ class InferenceServer:
         # through as terminal. Shedding 503 + Retry-After instead keeps
         # even a hard (drain-less) stop retryable upstream.
         self._ready = False
-        self.httpd.shutdown()
-        self.httpd.server_close()
+        if self.httpd is not None:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+        if self._aio is not None:
+            self._aio.stop()
         if self._owns_registry:
             self.registry.stop()

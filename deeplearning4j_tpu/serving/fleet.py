@@ -834,6 +834,7 @@ class FleetRouter:
         self.host: Optional[str] = None
         self.port: Optional[int] = None
         self._http_thread: Optional[threading.Thread] = None
+        self._aio = None
 
     # -- replica selection --------------------------------------------
     def _pick(self, excluded: Set[str],
@@ -1359,15 +1360,36 @@ class FleetRouter:
     # -- HTTP front-end ------------------------------------------------
     def serve(self, host: str = "127.0.0.1", port: int = 0,
               max_body_bytes: int = 256 * 1024 * 1024,
-              log_requests=False):
+              log_requests=False, backend: str = "aio",
+              header_timeout_s: float = 10.0):
         """Start the fleet's own HTTP listener (same route table as a
         replica, fleet-level probes/stats) and return (host, port).
         ``log_requests`` (off by default) enables a structured JSON
         access log — ``True`` logs to stderr, any file-like object
-        logs there (same format as the replica's)."""
+        logs there (same format as the replica's).
+
+        ``backend="aio"`` (default) serves off one event loop with a
+        NATIVELY async streaming proxy: an open proxied stream is two
+        socket buffers and a coroutine, so connection count — the
+        router's actual scaling axis — no longer breeds blocked
+        threads, and upstream keep-alives ride an async checkout pool
+        (docs/serving.md "Front-end architecture").
+        ``backend="thread"`` is the original thread-per-connection
+        listener. Routes and proxy semantics are identical."""
         router = self
         self._log_stream = (sys.stderr if log_requests is True
                             else (log_requests or None))
+        if backend == "aio":
+            from .aio import AioRouterFrontend
+            self._aio = AioRouterFrontend(
+                self, host, port, max_body_bytes=max_body_bytes,
+                header_timeout_s=header_timeout_s)
+            self.host = self._aio.host
+            self.port = self._aio.port
+            return self.host, self.port
+        if backend != "thread":
+            raise ValueError(f"unknown backend {backend!r} "
+                             "(use 'aio' or 'thread')")
 
         class _Server(ThreadingHTTPServer):
             request_queue_size = 128
@@ -1667,4 +1689,8 @@ class FleetRouter:
             self.httpd.shutdown()
             self.httpd.server_close()
             self.httpd = None
+        if self._aio is not None:
+            self._aio.stop()
+            self._aio = None
+        self._pool.close_all()
         self._pool.close_all()

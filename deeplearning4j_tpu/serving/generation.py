@@ -44,7 +44,8 @@ import collections
 import queue
 import threading
 import time
-from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+from typing import (Any, Callable, Dict, Iterator, List, Optional,
+                    Sequence, Tuple)
 
 import jax
 import jax.numpy as jnp
@@ -149,11 +150,12 @@ class _GenRequest:
     __slots__ = ("prompt", "max_tokens", "temperature", "top_k", "seed",
                  "eos_id", "deadline", "priority", "session_id", "event",
                  "tokens", "error", "finish_reason", "stream_q",
+                 "stream_notify",
                  "t_submit", "t_first", "t_last", "abandoned",
                  "recoveries", "_lock", "_timeout_counted", "trace",
                  "qspan", "spec_rounds", "spec_proposed",
                  "spec_accepted", "spec_emitted", "spec_dt0", "spec_dt1",
-                 "spec_vt0", "spec_vt1")
+                 "spec_vt0", "spec_vt1", "pipe_d0", "pipe_w0")
 
     def __init__(self, prompt, max_tokens, temperature, top_k, seed,
                  eos_id, deadline, stream: bool,
@@ -177,6 +179,11 @@ class _GenRequest:
         # a slow streaming consumer (head-of-line for every other slot)
         self.stream_q: Optional["queue.Queue"] = (
             queue.Queue() if stream else None)
+        # optional post-put hook for event-loop consumers: lets an
+        # async front-end park on an asyncio.Event instead of holding
+        # a blocking-get thread per open stream. Must never raise into
+        # the scheduler, so pushes go through _stream_push.
+        self.stream_notify: Optional[Callable[[], None]] = None
         self.t_submit = time.perf_counter()
         self.t_first: Optional[float] = None
         self.t_last: Optional[float] = None
@@ -197,6 +204,22 @@ class _GenRequest:
         self.spec_dt1: Optional[float] = None
         self.spec_vt0: Optional[float] = None
         self.spec_vt1: Optional[float] = None
+        # engine-cumulative pipeline counters snapshotted at decode
+        # entry; the terminal span reports the deltas over this
+        # request's decode lifetime (engine-wide, not per-lane — the
+        # sync is shared by the whole batch). None = never decoded on
+        # a pipelining engine
+        self.pipe_d0: Optional[float] = None
+        self.pipe_w0: Optional[float] = None
+
+    def _stream_push(self, item) -> None:
+        self.stream_q.put(item)
+        cb = self.stream_notify
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — consumer bug, not ours
+                pass
 
     def count_timeout_once(self, metrics) -> None:
         """The waiter and the scheduler can both observe this request's
@@ -369,7 +392,8 @@ class GenerationEngine:
                  stall_timeout_s: float = 30.0,
                  batch_queue_fraction: float = 0.5,
                  speculation_k: int = 0,
-                 draft_model=None):
+                 draft_model=None,
+                 decode_pipeline: bool = True):
         if getattr(model, "_params", None) is None:
             model.init()
         self.model = model
@@ -548,8 +572,44 @@ class GenerationEngine:
         # batching loses its amortization (measured 0.5x vs sequential
         # on CPU with copies; 4x+ with donation)
         self._donate = (1, 2)
+        # -- pipelined decode (ISSUE 14) ----------------------------
+        # With the pipeline on (default; speculation forces it off —
+        # verify rounds are inherently synchronous), the scheduler
+        # dispatches decode step t+1 BEFORE syncing step t's tokens,
+        # so host bookkeeping (emit, retire, admit) overlaps device
+        # compute. Donation already forces device program order, so
+        # the overlap changes WHEN the host learns each token, never
+        # WHICH token. The knob exists for A/B identity tests.
+        self.decode_pipeline = bool(decode_pipeline) \
+            and not self.speculation_k
+        # in-flight decode steps, oldest first (depth is at most 2 for
+        # the moment between dispatching t+1 and collecting t)
+        self._pending: "collections.deque" = collections.deque()
+        # device handle of the LAST dispatched step's sampled tokens
+        # ([num_slots] int32, never synced) — fed back as the next
+        # step's tok_dev input; None until the first dispatch
+        self._nxt_dev = None
+        # lanes whose current token lives ONLY on the device (True
+        # after a pipelined dispatch; False on prefill / free /
+        # recovery, which refresh the host mirror)
+        self._tok_on_dev = np.zeros(self.num_slots, bool)
+        # constants for the non-pipelined path: read host tokens for
+        # every lane, no device feedback (never mutated, safe to share
+        # across calls without the defensive .copy())
+        self._all_host = np.ones(self.num_slots, bool)
+        self._no_dev_tok = np.zeros(self.num_slots, np.int32)
+        # engine-cumulative pipeline accounting (seconds): the span
+        # from dispatch to results-on-host, and how long the host
+        # actually BLOCKED at the sync — terminal request spans and
+        # tools/trace_report.py's phase table read the deltas
+        self._step_span_s = 0.0
+        self._sync_wait_s = 0.0
         self._queue: "queue.Queue[_GenRequest]" = queue.Queue(
             maxsize=int(max_queue))
+        # submit-wake: an idle scheduler parks on this event instead
+        # of polling the queue every 50 ms (ISSUE 14) — set by
+        # _enqueue after each put and by stop()/drain()
+        self._wake = threading.Event()
         # priority shedding: batch-class work only gets the front
         # fraction of the queue; interactive gets all of it
         self.batch_queue_fraction = float(batch_queue_fraction)
@@ -643,26 +703,50 @@ class GenerationEngine:
     # loop — failed alone with 500, slot/blocks freed — instead of
     # silently emitting garbage or wedging the batch.
     def _decode_fn(self):
+        """One decode step over the full slot batch.
+
+        Two ISSUE 14 additions, both in-graph so the pipelined
+        scheduler never needs an extra host round-trip:
+
+        - **Token merge.** Each lane's input token comes from EITHER
+          the host mirror (``tok_host`` — fresh prefills, recovery
+          resumes, the non-pipelined path) OR the PREVIOUS step's
+          device output fed straight back in (``tok_dev``), selected
+          per lane by ``use_host``. That is what lets the scheduler
+          dispatch step t+1 before step t's tokens ever reach the
+          host: a continuing lane's token never leaves the device.
+        - **Fused termination.** ``done`` = sampled-EOS | length-cap,
+          computed from the per-lane ``eos`` id (-1 = none; sampled
+          tokens are >= 0 so -1 never matches) and ``max_steps``
+          (``steps`` counts tokens already emitted, so this step is
+          number ``steps + 1``). Retirement needs no host-side
+          re-derivation from request state."""
         model = self.model
         impl = self.decode_impl
 
         if self.cache_backend == "paged":
-            def step(params, kcs, vcs, tokens, pos, tables, seeds,
-                     steps, temps, top_ks):
+            def step(params, kcs, vcs, tok_host, tok_dev, use_host,
+                     pos, tables, seeds, steps, temps, top_ks, eos,
+                     max_steps):
+                tokens = jnp.where(use_host, tok_host, tok_dev)
                 logits, kcs, vcs = model.forward_decode_paged(
                     params, tokens, pos, kcs, vcs, tables, impl)
                 ok = jnp.all(jnp.isfinite(logits), axis=-1)  # per lane
                 nxt = _sample_batch(logits, temps, top_ks, seeds, steps)
-                return nxt, ok, kcs, vcs
+                done = ((nxt == eos) & (eos >= 0)) \
+                    | (steps + 1 >= max_steps)
+                return nxt, ok, done, kcs, vcs
             return step
 
-        def step(params, kcs, vcs, tokens, pos, seeds, steps, temps,
-                 top_ks):
+        def step(params, kcs, vcs, tok_host, tok_dev, use_host, pos,
+                 seeds, steps, temps, top_ks, eos, max_steps):
+            tokens = jnp.where(use_host, tok_host, tok_dev)
             logits, kcs, vcs = model.forward_decode(params, tokens, pos,
                                                     kcs, vcs, impl)
             ok = jnp.all(jnp.isfinite(logits), axis=-1)      # per lane
             nxt = _sample_batch(logits, temps, top_ks, seeds, steps)
-            return nxt, ok, kcs, vcs
+            done = ((nxt == eos) & (eos >= 0)) | (steps + 1 >= max_steps)
+            return nxt, ok, done, kcs, vcs
         return step
 
     def _chunk_fn(self):
@@ -724,15 +808,19 @@ class GenerationEngine:
             if self.cache_backend == "paged":
                 args = (self.model._params, self._kcs, self._vcs,
                         np.zeros(S, np.int32), np.zeros(S, np.int32),
+                        np.ones(S, bool), np.zeros(S, np.int32),
                         np.full((S, self._blocks_per_seq), NULL_BLOCK,
                                 np.int32),
                         np.zeros(S, np.uint32), np.zeros(S, np.int32),
-                        np.zeros(S, np.float32), np.zeros(S, np.int32))
+                        np.zeros(S, np.float32), np.zeros(S, np.int32),
+                        np.full(S, -1, np.int32), np.zeros(S, np.int32))
             else:
                 args = (self.model._params, self._kcs, self._vcs,
                         np.zeros(S, np.int32), np.zeros(S, np.int32),
+                        np.ones(S, bool), np.zeros(S, np.int32),
                         np.zeros(S, np.uint32), np.zeros(S, np.int32),
-                        np.zeros(S, np.float32), np.zeros(S, np.int32))
+                        np.zeros(S, np.float32), np.zeros(S, np.int32),
+                        np.full(S, -1, np.int32), np.zeros(S, np.int32))
             with self._profiler.record("generation.compile"):
                 exe = compile_memoized(self._decode_fn(), args,
                                        self._donate)
@@ -1113,6 +1201,7 @@ class GenerationEngine:
             raise QueueFullError(
                 f"generation queue full ({self.metrics.queue_max}); "
                 "shedding load")
+        self._wake.set()  # unpark an idle scheduler immediately
         if not self._running:
             req.abandoned = True
             raise ServingError("generation engine is stopped")
@@ -1241,6 +1330,22 @@ class GenerationEngine:
         else:
             tr.span("error" if exc is not None else "decode",
                     **attrs).end()
+        if req.pipe_d0 is not None and self._step_span_s > req.pipe_d0:
+            # pipelined-decode accounting over this request's decode
+            # lifetime, rebuilt retroactively from engine-cumulative
+            # counters snapshotted at admission (the hot loop stores
+            # two floats per request, nothing else). ENGINE-wide, not
+            # per-lane: every lane in the batch shares one dispatch
+            # and one sync. device_ms is the dispatch->results span;
+            # sync_wait_ms is how long the scheduler actually blocked
+            # — their gap is host work that overlapped device compute.
+            dev_s = self._step_span_s - req.pipe_d0
+            wait_s = self._sync_wait_s - req.pipe_w0
+            tr.span("step_pipeline",
+                    device_ms=round(dev_s * 1e3, 3),
+                    sync_wait_ms=round(wait_s * 1e3, 3),
+                    overlap_frac=round(
+                        max(0.0, 1.0 - wait_s / dev_s), 4)).end()
         if req.spec_rounds:
             # speculative participation, rebuilt retroactively from the
             # per-request aggregates (the hot loop never touches the
@@ -1273,7 +1378,7 @@ class GenerationEngine:
             self.metrics.inc("server_errors")
         self._trace_terminal(req, exc=exc)
         if req.stream_q is not None:
-            req.stream_q.put(("error", exc))
+            req._stream_push(("error", exc))
         req.event.set()
 
     def _emit(self, req: _GenRequest, token: int, now: float,
@@ -1292,7 +1397,7 @@ class GenerationEngine:
             self.metrics.itl_ms.record((now - req.t_last) * 1e3)
         req.t_last = now
         if req.stream_q is not None:
-            req.stream_q.put(("token", token))
+            req._stream_push(("token", token))
             fi = self._faults
             if fi is not None and fi.fire("client_disconnect"):
                 # simulate the HTTP consumer hanging up mid-stream:
@@ -1307,6 +1412,7 @@ class GenerationEngine:
         it uses and lengths mask the rest (`serving/paging.py`
         invariants)."""
         self._slots.free(slot)
+        self._tok_on_dev[slot] = False
         if self.cache_backend == "paged":
             table = self._slot_blocks[slot]
             if table is not None:
@@ -1329,7 +1435,7 @@ class GenerationEngine:
             self._release_slot(slot)
         self._trace_terminal(req, reason=reason)
         if req.stream_q is not None:
-            req.stream_q.put(("done", reason))
+            req._stream_push(("done", reason))
         req.event.set()
 
     def _check_done(self, slot: int, req: _GenRequest, token: int,
@@ -1355,6 +1461,61 @@ class GenerationEngine:
             return True
         return False
 
+    def _retire(self, slot: int, req: _GenRequest, token: int,
+                done: bool, now: float) -> bool:
+        """Retirement off the decode executable's FUSED ``done`` flag
+        (EOS | length, computed in-graph — see :meth:`_decode_fn`):
+        the host only disambiguates WHICH of the two tripped, for the
+        finish_reason, with EOS winning when both trip at once —
+        identical semantics to :meth:`_check_done`, which remains the
+        host-side test for paths without fused flags (prefill's first
+        token, speculative commits). Abandonment and deadline stay
+        host-side: both are wall-clock/consumer conditions the device
+        cannot know."""
+        if req.abandoned:
+            self._release_slot(slot)
+            return True
+        if done:
+            if req.eos_id is not None and token == req.eos_id:
+                self._finish(slot, req, "eos")
+            else:
+                self._finish(slot, req, "length")
+            return True
+        if now > req.deadline:
+            self._release_slot(slot)
+            self._fail(req, DeadlineExceededError(
+                "deadline exceeded mid-generation "
+                f"({len(req.tokens)} tokens emitted)"))
+            return True
+        return False
+
+    def _next_queued(self, busy: bool) -> Optional[_GenRequest]:
+        """Pop the next queued request without idle-spinning. A BUSY
+        engine (active lanes / chunks mid-prefill) must keep its
+        decode loop stepping, so the pop is non-blocking exactly as
+        before. A fully IDLE engine used to poll ``get(timeout=0.05)``
+        — 20 wakeups/s and up to 50 ms of added TTFT per idle engine;
+        it now parks on the submit-wake event (_enqueue sets it after
+        every put; stop()/drain() set it too), with a 1 s backstop
+        wait in case a wake is ever lost."""
+        try:
+            return self._queue.get_nowait()
+        except queue.Empty:
+            if busy:
+                return None
+        # clear-then-recheck closes the lost-wakeup race: a submit
+        # landing between the failed pop and clear() re-sets the
+        # event and the second pop sees its request. The backstop
+        # wait is bounded well under the stall watchdog so an idle
+        # engine's heartbeat never looks wedged to /healthz.
+        self._wake.clear()
+        try:
+            return self._queue.get_nowait()
+        except queue.Empty:
+            self._wake.wait(
+                max(0.05, min(1.0, self._stall_timeout_s / 4.0)))
+            return None
+
     def _admit(self):
         """Fill free slots from the queue (the re-admission deque
         first — transient-faulted and recovery re-admissions were
@@ -1375,12 +1536,9 @@ class GenerationEngine:
             if self._requeue:
                 req = self._requeue.popleft()
             else:
-                try:
-                    if self._slots.active_count:
-                        req = self._queue.get_nowait()
-                    else:
-                        req = self._queue.get(timeout=0.05)
-                except queue.Empty:
+                req = self._next_queued(
+                    busy=bool(self._slots.active_count))
+                if req is None:
                     return
                 self.metrics.queue_depth = self._queue.qsize()
             if req.abandoned:
@@ -1535,12 +1693,10 @@ class GenerationEngine:
             elif self._held is not None:
                 req, self._held = self._held, None
             else:
-                try:
-                    if self._slots.active_count or self._prefilling:
-                        req = self._queue.get_nowait()
-                    else:
-                        req = self._queue.get(timeout=0.05)
-                except queue.Empty:
+                req = self._next_queued(
+                    busy=bool(self._slots.active_count
+                              or self._prefilling))
+                if req is None:
                     return
                 self.metrics.queue_depth = self._queue.qsize()
             if req.abandoned:
@@ -1745,6 +1901,14 @@ class GenerationEngine:
         slots.seed[st.slot] = req.seed
         slots.temp[st.slot] = req.temperature
         slots.top_k[st.slot] = req.top_k
+        slots.eos[st.slot] = -1 if req.eos_id is None else req.eos_id
+        slots.max_steps[st.slot] = req.max_tokens
+        # the lane's current token was just written host-side — the
+        # next dispatch must feed it from tok_host, not the device
+        self._tok_on_dev[st.slot] = False
+        if req.pipe_d0 is None:
+            req.pipe_d0 = self._step_span_s
+            req.pipe_w0 = self._sync_wait_s
         self._tables[st.slot] = st.table.padded(self._blocks_per_seq)
         if self.enable_prefix_sharing and not resumed:
             # the prompt's full blocks now hold finished, immutable
@@ -1820,6 +1984,7 @@ class GenerationEngine:
             self._fail(req, ServingError(f"generation step failed: "
                                          f"{why}"))
         self.metrics.active_slots = 0
+        self._drop_pending()
         if self.cache_backend == "paged":
             # mid-prefill requests hold slots too, so they were failed
             # above; reset the block bookkeeping wholesale — including
@@ -1854,6 +2019,10 @@ class GenerationEngine:
             recovered.append(st.requests[slot])
             st.free(slot)
         self.metrics.active_slots = 0
+        # any in-flight pipelined step died with the caches; its
+        # tokens were never emitted, so the recovery replay below
+        # regenerates them bit-identically (same PRNG fold indices)
+        self._drop_pending()
         if self.cache_backend == "paged":
             # mid-prefill requests hold slots too, so the slot sweep
             # above already collected them EXACTLY once (collecting
@@ -1978,6 +2147,14 @@ class GenerationEngine:
         st.seed[slot] = req.seed
         st.temp[slot] = req.temperature
         st.top_k[slot] = req.top_k
+        st.eos[slot] = -1 if req.eos_id is None else req.eos_id
+        st.max_steps[slot] = req.max_tokens
+        # the lane's current token was just written host-side — the
+        # next dispatch must feed it from tok_host, not the device
+        self._tok_on_dev[slot] = False
+        if req.pipe_d0 is None:
+            req.pipe_d0 = self._step_span_s
+            req.pipe_w0 = self._sync_wait_s
         if self.speculation_k:
             self._spec_prime(slot, seq)
         self.metrics.active_slots = st.active_count
@@ -2245,18 +2422,27 @@ class GenerationEngine:
         t0 = time.perf_counter()
         with self._profiler.record("generation.decode_step"):
             if self.cache_backend == "paged":
-                nxt, okd, self._kcs, self._vcs = self._get_decode_exe()(
-                    self.model._params, self._kcs, self._vcs,
-                    st.token.copy(), st.pos.copy(), self._tables.copy(),
-                    st.seed.copy(), st.step.copy(), st.temp.copy(),
-                    st.top_k.copy())
+                nxt, okd, dnd, self._kcs, self._vcs = \
+                    self._get_decode_exe()(
+                        self.model._params, self._kcs, self._vcs,
+                        st.token.copy(), self._no_dev_tok,
+                        self._all_host, st.pos.copy(),
+                        self._tables.copy(), st.seed.copy(),
+                        st.step.copy(), st.temp.copy(),
+                        st.top_k.copy(), st.eos.copy(),
+                        st.max_steps.copy())
             else:
-                nxt, okd, self._kcs, self._vcs = self._get_decode_exe()(
-                    self.model._params, self._kcs, self._vcs,
-                    st.token.copy(), st.pos.copy(), st.seed.copy(),
-                    st.step.copy(), st.temp.copy(), st.top_k.copy())
+                nxt, okd, dnd, self._kcs, self._vcs = \
+                    self._get_decode_exe()(
+                        self.model._params, self._kcs, self._vcs,
+                        st.token.copy(), self._no_dev_tok,
+                        self._all_host, st.pos.copy(), st.seed.copy(),
+                        st.step.copy(), st.temp.copy(),
+                        st.top_k.copy(), st.eos.copy(),
+                        st.max_steps.copy())
             nxt = np.asarray(nxt)  # device sync: the step really ran
             ok = np.asarray(okd)
+            done = np.asarray(dnd)
         now = time.perf_counter()
         dt_ms = (now - t0) * 1e3
         self.metrics.decode_step_ms.record(dt_ms)
@@ -2269,6 +2455,7 @@ class GenerationEngine:
         self.metrics.inc("decode_steps")
         self.metrics.occupancy_hist.record(len(active))
         tokens = nxt.tolist()
+        flags = done.tolist()
         emitted = 0
         itl: List[float] = []
         for slot in active:
@@ -2292,7 +2479,7 @@ class GenerationEngine:
             st.step[slot] += 1
             self._emit(req, token, now, itl_out=itl)
             emitted += 1
-            self._check_done(slot, req, token, now)
+            self._retire(slot, req, token, flags[slot], now)
         # count only tokens actually delivered — a quarantined lane
         # emitted nothing, and pre-counting len(active) would inflate
         # tokens/sec under poison load
@@ -2302,6 +2489,130 @@ class GenerationEngine:
             self.metrics.itl_ms.record_many(itl)
         if self.cache_backend == "paged":
             self._update_block_gauges()
+
+    def _dispatch_decode(self) -> bool:
+        """Launch one decode step WITHOUT waiting for its results (the
+        pipelined half of ISSUE 14). The sampled-token array stays on
+        the device and feeds the NEXT dispatch directly (tok_dev);
+        pos/step are pure +1 increments the host advances immediately,
+        so the next step's inputs never depend on anything the sync
+        would deliver. Donation already serializes device execution in
+        program order — a later prefill or chunk can never overtake
+        this step on the device."""
+        st = self._slots
+        active = self._ready_slots()
+        if not active:
+            return False
+        # injection seam: BEFORE the device call (and its donation), so
+        # a TransientFault here is retryable with all state intact —
+        # the not-yet-collected previous step stays queued
+        self._hit("device_step")
+        c0 = self.metrics.compiles
+        tok_dev = self._nxt_dev
+        if tok_dev is None:
+            tok_dev = self._no_dev_tok
+        use_host = ~self._tok_on_dev
+        t0 = time.perf_counter()
+        if self.cache_backend == "paged":
+            nxt, okd, dnd, self._kcs, self._vcs = self._get_decode_exe()(
+                self.model._params, self._kcs, self._vcs,
+                st.token.copy(), tok_dev, use_host, st.pos.copy(),
+                self._tables.copy(), st.seed.copy(), st.step.copy(),
+                st.temp.copy(), st.top_k.copy(), st.eos.copy(),
+                st.max_steps.copy())
+        else:
+            nxt, okd, dnd, self._kcs, self._vcs = self._get_decode_exe()(
+                self.model._params, self._kcs, self._vcs,
+                st.token.copy(), tok_dev, use_host, st.pos.copy(),
+                st.seed.copy(), st.step.copy(), st.temp.copy(),
+                st.top_k.copy(), st.eos.copy(), st.max_steps.copy())
+        self._nxt_dev = nxt
+        self._tok_on_dev[:] = False
+        self._tok_on_dev[active] = True
+        # batched cursor bookkeeping: two vectorized adds, no per-lane
+        # Python in the dispatch path
+        st.pos[active] += 1
+        st.step[active] += 1
+        self.metrics.inc("decode_steps")
+        self.metrics.occupancy_hist.record(len(active))
+        self._pending.append(
+            (nxt, okd, dnd, [(s, st.requests[s]) for s in active],
+             t0, c0))
+        return True
+
+    def _collect_decode(self, keep: int = 0):
+        """Sync and apply in-flight decode steps, oldest first, until
+        only ``keep`` remain (keep=1 right after a dispatch: the new
+        step stays in flight while THIS host work overlaps it — that
+        overlap is the entire point of the pipeline). The sync is the
+        only blocking point; everything after runs off host arrays."""
+        st = self._slots
+        while len(self._pending) > keep:
+            nxt_d, okd, dnd, lanes, t0, c0 = self._pending.popleft()
+            t_wait = time.perf_counter()
+            nxt = np.asarray(nxt_d)  # device sync: the step really ran
+            ok = np.asarray(okd)
+            done = np.asarray(dnd)
+            now = time.perf_counter()
+            span_s = now - t0         # dispatch -> results on host
+            wait_s = now - t_wait     # how long the host BLOCKED
+            self._profiler.note("generation.decode_step", span_s)
+            self._step_span_s += span_s
+            self._sync_wait_s += wait_s
+            dt_ms = span_s * 1e3
+            self.metrics.decode_step_ms.record(dt_ms)
+            self.metrics.decode_sync_wait_ms.record(wait_s * 1e3)
+            if self.metrics.compiles == c0:
+                self._decode_ewma_ms = dt_ms \
+                    if not self._decode_ewma_ms \
+                    else 0.8 * self._decode_ewma_ms + 0.2 * dt_ms
+            tokens = nxt.tolist()
+            flags = done.tolist()
+            emitted = 0
+            itl: List[float] = []
+            for slot, req in lanes:
+                if st.requests[slot] is not req \
+                        or req.finish_reason is not None \
+                        or req.error is not None:
+                    # the lane retired (or its slot changed hands)
+                    # while this step was in flight: its junk write
+                    # landed past the retired sequence's valid length
+                    # — masked and later overwritten, per the
+                    # no-zeroing invariant — and its sampled token is
+                    # simply never read
+                    continue
+                if not ok[slot]:
+                    # poison quarantine, same contract as the
+                    # synchronous path
+                    self.metrics.inc("quarantined")
+                    exc = PoisonRequestError(
+                        "request produced non-finite logits at decode "
+                        f"step {int(st.step[slot])}; quarantined")
+                    self._release_slot(slot)
+                    self._fail(req, exc)
+                    continue
+                token = tokens[slot]
+                # backfill the host mirror; the NEXT step's input (if
+                # already dispatched) came from tok_dev, not this
+                st.token[slot] = token
+                self._emit(req, token, now, itl_out=itl)
+                emitted += 1
+                self._retire(slot, req, token, flags[slot], now)
+            if emitted:
+                self.metrics.tokens.record(emitted)
+            if itl:
+                self.metrics.itl_ms.record_many(itl)
+            if self.cache_backend == "paged":
+                self._update_block_gauges()
+
+    def _drop_pending(self):
+        """Discard in-flight pipelined state (recovery/poison/stop:
+        the device buffers it refers to are gone or about to be).
+        Nothing from a dropped step was ever emitted, so a recovery
+        replay regenerates the same tokens from the same PRNG folds."""
+        self._pending.clear()
+        self._nxt_dev = None
+        self._tok_on_dev[:] = False
 
     def _loop(self):
         """The supervised scheduler loop. One iteration = admit, one
@@ -2329,7 +2640,14 @@ class GenerationEngine:
                 self._admit()
                 if paged and self._prefilling:
                     self._prefill_chunk_step()
-                if self._ready_slots():
+                if self.decode_pipeline:
+                    # dispatch step t+1 FIRST, then collect step t:
+                    # the admit/prefill work above and the emit/retire
+                    # work inside the collect all overlap the device
+                    # computing the step just dispatched
+                    launched = self._dispatch_decode()
+                    self._collect_decode(keep=1 if launched else 0)
+                elif self._ready_slots():
                     # speculative round first (no-op at k=0); lanes it
                     # advanced sit out the plain step that finishes
                     # everyone else
@@ -2368,6 +2686,7 @@ class GenerationEngine:
         # shutdown cleanup runs HERE, on the scheduler thread — stop()
         # must not mutate the slot table from another thread while a
         # final device call might still be in flight
+        self._drop_pending()
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -2472,6 +2791,7 @@ class GenerationEngine:
         self._draining = True
         if first:
             self.metrics.inc("drains")
+        self._wake.set()  # an idle-parked scheduler should re-check
         clean = poll_until_idle(self._idle, timeout_s)
         self.stop()
         return clean
@@ -2483,4 +2803,5 @@ class GenerationEngine:
         if the join times out); waiters are additionally bounded by
         their deadlines."""
         self._running = False
+        self._wake.set()  # unpark an idle scheduler immediately
         self._thread.join(timeout=timeout_s)

@@ -79,6 +79,14 @@ class SlotTable:
         self.seed = np.zeros(self.num_slots, np.uint32)
         self.temp = np.zeros(self.num_slots, np.float32)
         self.top_k = np.zeros(self.num_slots, np.int32)
+        # fused in-graph termination (ISSUE 14): the decode executable
+        # computes per-lane done = hit-EOS | hit-max_tokens itself, so
+        # retirement needs no extra host reads. eos = -1 means "no EOS
+        # id" (sampled tokens are always >= 0, so -1 never matches);
+        # max_steps is the request's max_tokens, 0 for inactive lanes
+        # (their done flags are never read)
+        self.eos = np.full(self.num_slots, -1, np.int32)
+        self.max_steps = np.zeros(self.num_slots, np.int32)
         # speculative decoding (serving/speculative.py): slots with
         # spec_ok=False fall back to plain one-token decode — set at
         # draft-prime time, cleared on free() and on per-lane draft
@@ -117,6 +125,8 @@ class SlotTable:
         self.seed[slot] = 0
         self.temp[slot] = 0.0
         self.top_k[slot] = 0
+        self.eos[slot] = -1
+        self.max_steps[slot] = 0
         self.spec_ok[slot] = False
         self._free.append(slot)
 

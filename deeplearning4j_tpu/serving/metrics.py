@@ -139,6 +139,11 @@ class GenerationMetrics:
         self.itl_ms = Reservoir(latency_window)     # inter-token gap
         self.prefill_ms = Reservoir(latency_window)
         self.decode_step_ms = Reservoir(latency_window)
+        # pipelined decode (ISSUE 14): how long the scheduler actually
+        # BLOCKED at the step-t sync after dispatching step t+1 — near
+        # zero when host bookkeeping fully overlaps device compute,
+        # approaching decode_step_ms when the device is the bottleneck
+        self.decode_sync_wait_ms = Reservoir(latency_window)
         self.queue_depth = 0       # gauge, updated by the scheduler
         self.queue_max = 0
         self.active_slots = 0      # gauge
@@ -282,6 +287,9 @@ class GenerationMetrics:
                            self.prefill_ms.snapshot().items()},
             "decode_step_ms": {k: round(v, 3) for k, v in
                                self.decode_step_ms.snapshot().items()},
+            "decode_sync_wait_ms": {
+                k: round(v, 3) for k, v in
+                self.decode_sync_wait_ms.snapshot().items()},
             "kv_cache_bytes": self.cache_bytes,
             "compile_cache": {
                 "compiles": self.compiles,

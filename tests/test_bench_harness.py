@@ -364,6 +364,75 @@ def test_check_bench_regression_direction_registry():
         "higher_is_better"
 
 
+def test_check_bench_regression_connscale_metrics_gated():
+    """ISSUE 14 satellite: the connection-scale leg gates both ways —
+    held streaming conns are higher-is-better, the interactive probe
+    p99 measured UNDER that connection load flips direction."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "cbr7", os.path.join(ROOT, "tools", "check_bench_regression.py"))
+    cbr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cbr)
+    names = set(cbr.METRICS.values())
+    assert {"connscale_streaming_conns", "connscale_p99_ms"} <= names
+    assert cbr.direction("connscale_streaming_conns") == \
+        "higher_is_better"
+    assert cbr.direction("connscale_p99_ms") == "lower_is_better"
+    rec = {"value": 100.0,
+           "extra": {"connscale": {"streaming_conns": 1000,
+                                   "p99_ms": 12.0}}}
+    # fewer held conns AND a fatter probe tail both regress
+    worse = {"value": 100.0,
+             "extra": {"connscale": {"streaming_conns": 600,
+                                     "p99_ms": 40.0}}}
+    r = cbr.compare(rec, worse, 0.2)
+    assert sorted(e["metric"] for e in r["regressions"]) == \
+        ["connscale_p99_ms", "connscale_streaming_conns"]
+    # holding more conns at a lower p99 passes
+    better = {"value": 100.0,
+              "extra": {"connscale": {"streaming_conns": 1200,
+                                      "p99_ms": 9.0}}}
+    assert not cbr.compare(rec, better, 0.2)["regressions"]
+
+
+def test_check_bench_regression_zero_floor_overhead_gated():
+    """ISSUE 14 satellite: a scheduler_overhead_frac recorded at its
+    0.0 floor (pipelining fully hid the scheduler) must stay GATED via
+    an absolute ceiling, not be skipped as a degenerate baseline — a
+    fresh run re-exposing the overhead is a regression."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "cbr8", os.path.join(ROOT, "tools", "check_bench_regression.py"))
+    cbr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cbr)
+    assert "generation_scheduler_overhead_frac" in \
+        cbr.ABS_CEILING_FROM_ZERO
+    rec = {"value": 100.0,
+           "extra": {"generation": {"scheduler_overhead_frac": 0.0}}}
+    cap = cbr.ABS_CEILING_FROM_ZERO["generation_scheduler_overhead_frac"]
+    worse = {"value": 100.0,
+             "extra": {"generation":
+                       {"scheduler_overhead_frac": cap + 0.2}}}
+    r = cbr.compare(rec, worse, 0.2)
+    assert [e["metric"] for e in r["regressions"]] == \
+        ["generation_scheduler_overhead_frac"]
+    assert r["regressions"][0]["ceiling"] == cap
+    held = {"value": 100.0,
+            "extra": {"generation": {"scheduler_overhead_frac": 0.0}}}
+    r = cbr.compare(rec, held, 0.2)
+    assert not r["regressions"]
+    assert any(e["metric"] == "generation_scheduler_overhead_frac"
+               for e in r["ok"])
+    # the --list audit surface reports it as gated, not skipped
+    rows = {row["metric"]: row for row in cbr.list_metrics(rec)}
+    assert rows["generation_scheduler_overhead_frac"]["status"] == \
+        "gated"
+    # a throughput metric at zero is still a broken baseline
+    rec0 = {"value": 0.0}
+    r = cbr.compare(rec0, {"value": 50.0}, 0.2)
+    assert any("non-positive" in e["note"] for e in r["skipped"])
+
+
 def test_check_bench_regression_speculative_metrics_gated():
     """ISSUE 12 satellite: the speculative-decoding leg gates BOTH
     ways — tokens/sec and speedup-vs-plain are higher-is-better, but

@@ -776,8 +776,11 @@ class TestGracefulDrain:
         try:
             eng._stall_timeout_s = 0.5
             eng._faults = _Jam()
-            time.sleep(1.2)  # loop is stuck inside the iteration; the
-            # heartbeat has gone stale past the watchdog
+            time.sleep(2.2)  # loop is stuck inside the iteration; the
+            # heartbeat has gone stale past the watchdog. The settle
+            # time covers one full idle submit-wake park (up to 1 s,
+            # started before _stall_timeout_s shrank) plus comfortably
+            # more than the 0.5 s watchdog after the wedge engages.
             assert not eng.alive()
             with pytest.raises(urllib.error.HTTPError) as ei:
                 urllib.request.urlopen(base + "/healthz", timeout=10)

@@ -530,7 +530,10 @@ class TestTraceOverhead:
 
     def test_decode_hot_loop_carries_no_tracing_code(self):
         from deeplearning4j_tpu.serving.generation import GenerationEngine
-        for fn in (GenerationEngine._decode_step, GenerationEngine._loop):
+        for fn in (GenerationEngine._decode_step, GenerationEngine._loop,
+                   GenerationEngine._dispatch_decode,
+                   GenerationEngine._collect_decode,
+                   GenerationEngine._retire):
             assert "trace" not in inspect.getsource(fn).lower(), (
                 f"{fn.__name__} must stay free of tracing code; the "
                 "decode span is rebuilt retroactively in _trace_terminal")

@@ -136,6 +136,12 @@ METRICS = {
     ("extra", "generation", "spec_itl_ms_p99"): "spec_itl_p99_ms",
     ("extra", "generation", "spec_speedup_vs_plain"):
         "spec_speedup_vs_plain",
+    # connection scale (ISSUE 14): idle streaming conns held open
+    # through the event-loop front-end, and interactive probe p99
+    # measured UNDER that load — "new, skipped" until the next
+    # BENCH_*.json records a baseline, gated after
+    ("extra", "connscale", "streaming_conns"): "connscale_streaming_conns",
+    ("extra", "connscale", "p99_ms"): "connscale_p99_ms",
 }
 
 #: metric NAMES (values of METRICS) where LOWER is better — latency
@@ -158,6 +164,17 @@ LOWER_IS_BETTER = {
     "prefix_ttft_p99_ms",
     "session_ttft_turnN_ms",
     "spec_itl_p99_ms",
+    "connscale_p99_ms",
+}
+
+# A LOWER_IS_BETTER metric recorded at exactly 0.0 hit its FLOOR —
+# e.g. an overhead fraction fully hidden by decode pipelining — which
+# is an achievement to hold, not a degenerate run. Ratio gating is
+# impossible from a zero baseline, so these gate on an absolute
+# ceiling instead: a fresh value above the ceiling is a regression.
+ABS_CEILING_FROM_ZERO = {
+    "generation_scheduler_overhead_frac": 0.05,
+    "training_trace_overhead_frac": 0.05,
 }
 
 
@@ -236,6 +253,17 @@ def compare(recorded: dict, fresh: dict, threshold: float) -> dict:
                                 "note": "new, skipped (no recorded "
                                         "baseline yet)"})
             continue
+        if old == 0 and name in ABS_CEILING_FROM_ZERO:
+            if new is None:
+                skipped.append({"metric": name, "recorded": old,
+                                "note": "missing from fresh run"})
+                continue
+            cap = ABS_CEILING_FROM_ZERO[name]
+            entry = {"metric": name, "recorded": 0.0,
+                     "fresh": round(new, 3), "ceiling": cap,
+                     "direction": direction(name)}
+            (regressions if new > cap else ok).append(entry)
+            continue
         if old <= 0:
             # recorded, but by a degenerate run — that is a broken
             # BASELINE, not a new metric; say which
@@ -269,7 +297,8 @@ def list_metrics(recorded: dict, fresh: dict = None) -> list:
     for path, name in METRICS.items():
         old = _dig(recorded, path)
         new = _dig(fresh, path) if fresh is not None else None
-        if old is not None and old > 0:
+        if old is not None and (old > 0 or (
+                old == 0 and name in ABS_CEILING_FROM_ZERO)):
             status = "gated"
         elif old is not None:
             status = "recorded baseline non-positive, skipped"

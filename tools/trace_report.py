@@ -160,6 +160,38 @@ def spec_savings(traces):
     return agg
 
 
+def step_pipeline(traces):
+    """Aggregate the decode scheduler's ``step_pipeline`` spans (one
+    per request on a pipelining engine): how much device time the
+    request's decode lifetime covered, how long the scheduler actually
+    BLOCKED waiting for results, and the realized overlap — the gap
+    between the two is host work (sampling, bookkeeping, admission)
+    that ran while the device computed. ``overlap_frac`` near 0 reads
+    as a synchronous lockstep loop; near 1, the host never waited."""
+    agg = {"requests": 0, "device_ms": 0.0, "sync_wait_ms": 0.0}
+    fracs = []
+    for t in traces:
+        for s in t.get("spans", []):
+            if s.get("kind") != "step_pipeline":
+                continue
+            a = s.get("attrs", {})
+            agg["requests"] += 1
+            agg["device_ms"] += float(a.get("device_ms") or 0.0)
+            agg["sync_wait_ms"] += float(a.get("sync_wait_ms") or 0.0)
+            if a.get("overlap_frac") is not None:
+                fracs.append(float(a["overlap_frac"]))
+    if not agg["requests"]:
+        return {}
+    agg["overlap_frac"] = round(
+        max(0.0, 1.0 - agg["sync_wait_ms"] / agg["device_ms"]), 4) \
+        if agg["device_ms"] > 0 else 0.0
+    agg["overlap_frac_p50"] = round(_pct(fracs, 50), 4)
+    agg["overlap_frac_p99"] = round(_pct(fracs, 99), 4)
+    agg["device_ms"] = round(agg["device_ms"], 3)
+    agg["sync_wait_ms"] = round(agg["sync_wait_ms"], 3)
+    return agg
+
+
 def training_phases(traces):
     """Training step-phase breakdown over the trainer's span kinds:
     the per-kind latency table plus total milliseconds per phase and
@@ -290,6 +322,7 @@ def report(paths):
         "kinds": kind_stats(traces),
         "prefix_sharing": prefix_savings(traces),
         "speculation": spec_savings(traces),
+        "step_pipeline": step_pipeline(traces),
         "training": training_phases(traces),
         "stragglers": straggler_report(traces),
         "events": event_timeline(events),
@@ -337,6 +370,16 @@ def _fmt_human(rep):
             f"{sp['accepted']}/{sp['proposed']} accepted "
             f"({sp['accept_rate']:.1%})  "
             f"~{sp['saved_est_ms']:.1f} ms decode saved")
+    pl = rep.get("step_pipeline")
+    if pl:
+        lines.append("-- decode pipelining (step_pipeline spans)")
+        lines.append(
+            f"   {pl['requests']:>5} request(s)  "
+            f"device {pl['device_ms']:.1f} ms  "
+            f"host-sync wait {pl['sync_wait_ms']:.1f} ms  "
+            f"overlap {pl['overlap_frac']:.1%} "
+            f"(p50 {pl['overlap_frac_p50']:.1%}, "
+            f"p99 {pl['overlap_frac_p99']:.1%})")
     tr = rep.get("training")
     if tr:
         lines.append("-- training phase breakdown")
