@@ -904,6 +904,82 @@ sp_recompiles = sp.metrics.compiles - sp_compiles
 sp_spec = sp.stats()["spec"]
 sp.stop()
 
+# -- quantized KV pool (ISSUE 15): the SAME fixed-shape workload at
+# EQUAL POOL BYTES across kv_dtype in {f32, bf16, int8}. The byte
+# budget is set by a deliberately small f32 pool (3 resident
+# requests); each leg gets as many blocks as fit that budget — so the
+# int8 leg's win shows up as CONCURRENT-USER CAPACITY (gated >= 2x
+# f32 at equal bytes: 4x raw int8 shrink minus the f32 scale
+# sidecar), with tokens/sec per dtype and the max-|logit| relative
+# error vs the exact f32 cache recorded alongside. Accuracy is
+# measured at the model surface (one decode step against a cache
+# prefilled at each dtype), the number docs/generation.md documents
+# as the quantization tolerance.
+from deeplearning4j_tpu.kernels.kv_quant import (kv_nbytes,
+                                                 kv_update_slice)
+from deeplearning4j_tpu.serving.kvcache import KVCache
+from deeplearning4j_tpu.serving.paging import blocks_for
+
+QBS, QP, QG = 16, 32, 32
+q_shapes = [tuple(s) for s in lm.cache_shapes(QBS)]
+def q_block_bytes(dt):
+    return int(sum(2 * kv_nbytes((1,) + s, dt) for s in q_shapes))
+q_bpr = blocks_for(QP + QG, QBS)          # blocks per resident request
+budget = (3 * q_bpr + 1) * q_block_bytes("f32")
+q_reqs = [(rs.randint(0, VOCAB, QP).tolist(), QG) for _ in range(12)]
+
+def run_quant_leg(dt):
+    nb = budget // q_block_bytes(dt)
+    cap = (nb - 1) // q_bpr               # simultaneously-resident users
+    e = GenerationEngine(lm, num_slots=min(N_SLOTS, cap), max_queue=64,
+                         cache="paged", block_size=QBS, num_blocks=nb,
+                         prompt_buckets=[32], prefill_chunk_tokens=32,
+                         enable_prefix_sharing=False, kv_dtype=dt)
+    e.warmup()
+    def burst():
+        outs = [None] * len(q_reqs)
+        def go(i):
+            p, n = q_reqs[i]
+            outs[i] = e.generate(p, max_tokens=n, temperature=0.0,
+                                 seed=i, timeout_ms=600_000)["tokens"]
+        ts = [threading.Thread(target=go, args=(i,))
+              for i in range(len(q_reqs))]
+        t0 = time.perf_counter()
+        for t in ts: t.start()
+        for t in ts: t.join()
+        return time.perf_counter() - t0, outs
+    burst()                               # warmup pass
+    cb = e.metrics.compiles
+    dt_s, outs = burst()
+    rc = e.metrics.compiles - cb
+    pool_bytes = e.metrics.cache_bytes
+    e.stop()
+    return {"users": cap, "blocks": nb, "pool_bytes": pool_bytes,
+            "tps": sum(len(t) for t in outs) / dt_s, "recompiles": rc}
+
+q_legs = {dt: run_quant_leg(dt) for dt in ("f32", "bf16", "int8")}
+
+# model-surface accuracy: prefill a 48-token prompt into a
+# single-slot cache at each dtype, one decode step, compare logits
+QT = 48
+q_toks = jnp.asarray(rs.randint(0, VOCAB, (1, QT)), jnp.int32)
+_, q_ks, q_vs = lm.forward_prefill(lm._params, q_toks,
+                                   jnp.ones((1, QT), jnp.float32))
+def q_logits(dt):
+    c = KVCache(lm.cache_shapes(64), 1, kv_dtype=dt)
+    kcs = [kv_update_slice(kc, k, (0, 0, 0, 0))
+           for kc, k in zip(c.ks, q_ks)]
+    vcs = [kv_update_slice(vc, v, (0, 0, 0, 0))
+           for vc, v in zip(c.vs, q_vs)]
+    lg, _, _ = lm.forward_decode(
+        lm._params, q_toks[:, -1], jnp.asarray([QT], jnp.int32),
+        kcs, vcs)
+    return np.asarray(lg[0])
+q_ref = q_logits("f32")
+def q_relerr(dt):
+    return float(np.max(np.abs(q_logits(dt) - q_ref))
+                 / np.max(np.abs(q_ref)))
+
 d = jax.devices()[0]
 print(json.dumps({
     "model": f"CausalTransformerLM d{DM}xL{NL} generation "
@@ -988,6 +1064,19 @@ print(json.dumps({
     "spec_draft_fallbacks": sp_spec["draft_fallbacks"],
     "spec_tokens_identical_vs_plain": sp_out == sp0_out,
     "spec_recompiles_post_warmup": sp_recompiles,
+    "kv_equal_pool_bytes": budget,
+    "kv_f32_tokens_per_sec": round(q_legs["f32"]["tps"], 1),
+    "kv_bf16_tokens_per_sec": round(q_legs["bf16"]["tps"], 1),
+    "kv_int8_tokens_per_sec": round(q_legs["int8"]["tps"], 1),
+    "kv_f32_concurrent_users": q_legs["f32"]["users"],
+    "kv_bf16_concurrent_users": q_legs["bf16"]["users"],
+    "kv_int8_concurrent_users": q_legs["int8"]["users"],
+    "kv_int8_concurrent_users_vs_f32": round(
+        q_legs["int8"]["users"] / q_legs["f32"]["users"], 2),
+    "kv_bf16_logit_rel_err": round(q_relerr("bf16"), 5),
+    "kv_int8_logit_rel_err": round(q_relerr("int8"), 5),
+    "kv_quant_recompiles_post_warmup": sum(
+        l["recompiles"] for l in q_legs.values()),
     "synthetic_data": True}))
 """
 
@@ -2313,7 +2402,18 @@ def main():
                                      "spec_rollbacks",
                                      "spec_draft_fallbacks",
                                      "spec_tokens_identical_vs_plain",
-                                     "spec_recompiles_post_warmup")
+                                     "spec_recompiles_post_warmup",
+                                     "kv_equal_pool_bytes",
+                                     "kv_f32_tokens_per_sec",
+                                     "kv_bf16_tokens_per_sec",
+                                     "kv_int8_tokens_per_sec",
+                                     "kv_f32_concurrent_users",
+                                     "kv_bf16_concurrent_users",
+                                     "kv_int8_concurrent_users",
+                                     "kv_int8_concurrent_users_vs_f32",
+                                     "kv_bf16_logit_rel_err",
+                                     "kv_int8_logit_rel_err",
+                                     "kv_quant_recompiles_post_warmup")
                                     if k in gen}
         # resilient-training chaos probe: supervised step loop absorbing
         # ~1% transient step faults + one scripted preemption/resume

@@ -35,20 +35,53 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .flash_attention import _NEG_INF, _cdiv, default_platform
+from .kv_quant import is_quantized, kv_operands
+
+
+def _quantized_leg(x) -> bool:
+    """True when a cache operand is int8 (QuantArray) or bf16 — the
+    legs whose dots must run on bf16 operands so no f32 cache read
+    round-trips through HBM (checkable in StableHLO: the audit scans
+    dot OPERAND dtypes, tools/perf_audit.py::audit_kv_quant)."""
+    return is_quantized(x) or x.dtype == jnp.bfloat16
 
 
 def decode_attention_xla(q, k, v, lengths):
     """Fused-XLA decode attention (the CPU/GPU and reference path).
 
-    q: [S, H, D]; k/v: [S, H, T, D]; lengths: [S] — keys at positions
-    >= lengths[s] (unwritten cache tail) are masked out. Fully static
-    shapes: T is the cache capacity, not the live length.
+    q: [S, H, D]; k/v: [S, H, T, D] arrays or int8 QuantArrays with
+    per-position scales; lengths: [S] — keys at positions >= lengths[s]
+    (unwritten cache tail) are masked out. Fully static shapes: T is
+    the cache capacity, not the live length. The f32 path is
+    bit-identical to the pre-quantization kernel; bf16/int8 legs use
+    bf16-operand dots with f32 accumulation and fold the int8 scales
+    around the dots (K post-dot, V into the probabilities).
     """
     S, H, T, D = k.shape
     scale = 1.0 / jnp.sqrt(jnp.float32(D))
+    valid = jnp.arange(T)[None, None, :] < lengths[:, None, None]
+    if _quantized_leg(k) or _quantized_leg(v):
+        kb, kscale = kv_operands(k)
+        vb, vscale = kv_operands(v)
+        s = jnp.einsum("shd,shtd->sht", q.astype(jnp.bfloat16), kb,
+                       preferred_element_type=jnp.float32) * scale
+        if kscale is not None:            # [S, H, T] per-position scales
+            s = s * kscale
+        s = jnp.where(valid, s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        p = jnp.where(valid, p, 0.0)
+        if vscale is not None:
+            # fold V scales into p. The where-guard matters: a stale
+            # tail's scale may be NaN (poison is scale-carried, see
+            # kv_quant.quantize_rows) and 0 * NaN = NaN
+            p = jnp.where(valid, p * vscale, 0.0)
+        # bf16 pools can hold a non-finite stale tail directly
+        vb = jnp.where(valid[..., None], vb, jnp.bfloat16(0))
+        out = jnp.einsum("sht,shtd->shd", p.astype(jnp.bfloat16), vb,
+                         preferred_element_type=jnp.float32)
+        return out.astype(q.dtype)
     s = jnp.einsum("shd,shtd->sht", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
-    valid = jnp.arange(T)[None, None, :] < lengths[:, None, None]
     s = jnp.where(valid, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     # fully-masked rows (length 0: a free slot riding the batch) would
@@ -75,9 +108,12 @@ def _decode_kernel(q_ref, k_ref, v_ref, vm_ref, o_ref, m_s, l_s, acc_s, *,
         l_s[:] = jnp.zeros_like(l_s)
         acc_s[:] = jnp.zeros_like(acc_s)
 
-    q = q_ref[0].astype(jnp.float32)                  # [1, D]
-    k_blk = k_ref[0].astype(jnp.float32)              # [blk_k, D]
-    v_blk = v_ref[0].astype(jnp.float32)
+    # bf16 caches keep bf16 operands (MXU-native, f32 accumulation);
+    # only a true f32 cache runs f32 dots
+    od = jnp.float32 if k_ref.dtype == jnp.float32 else jnp.bfloat16
+    q = q_ref[0].astype(od)                           # [1, D]
+    k_blk = k_ref[0].astype(od)                       # [blk_k, D]
+    v_blk = v_ref[0].astype(od)
     s = jnp.dot(q, k_blk.T, precision=precision,
                 preferred_element_type=jnp.float32) * scale   # [1, blk_k]
     mask = (vm_ref[0][:, 0] > 0)[None, :]
@@ -90,17 +126,116 @@ def _decode_kernel(q_ref, k_ref, v_ref, vm_ref, o_ref, m_s, l_s, acc_s, *,
     p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
     # zero masked V rows too: p=0 there, but 0 * NaN = NaN would leak
     # a recycled slot's non-finite stale tail into the accumulator
-    v_blk = jnp.where(mask.reshape(-1, 1), v_blk, 0.0)
+    v_blk = jnp.where(mask.reshape(-1, 1), v_blk, jnp.zeros((), od))
     corr = jnp.exp(m_prev - m_new)
     m_s[:, 0] = m_new
     l_s[:, 0] = l_prev * corr + p.sum(axis=1)
     acc_s[:] = acc_s[:] * corr[:, None] + jnp.dot(
-        p, v_blk, precision=precision, preferred_element_type=jnp.float32)
+        p.astype(od), v_blk, precision=precision,
+        preferred_element_type=jnp.float32)
 
     @pl.when(ki == num_kb - 1)
     def _finalize():
         l = jnp.maximum(l_s[:, 0], 1e-30)
         o_ref[0] = (acc_s[:] / l[:, None]).astype(o_ref.dtype)
+
+
+def _decode_kernel_quant(q_ref, k_ref, v_ref, ks_ref, vs_ref, vm_ref,
+                         o_ref, m_s, l_s, acc_s, *,
+                         blk_k: int, scale: float, precision):
+    """int8 variant: K/V refs hold int8 values, ks/vs the per-position
+    f32 scales. Dequant happens HERE, in VMEM — the scale is folded
+    post-dot for K and into the probabilities for V, so HBM only ever
+    streams int8 (pallas guide §quantization)."""
+    ki = pl.program_id(1)
+    num_kb = pl.num_programs(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, _NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    # int8 in [-127, 127] casts to bf16 exactly; dots stay MXU-native
+    q = q_ref[0].astype(jnp.bfloat16)                 # [1, D]
+    k_blk = k_ref[0].astype(jnp.bfloat16)             # [blk_k, D]
+    v_blk = v_ref[0].astype(jnp.bfloat16)
+    kscale = ks_ref[0][:, 0][None, :]                 # [1, blk_k]
+    vscale = vs_ref[0][:, 0][None, :]
+    s = jnp.dot(q, k_blk.T, precision=precision,
+                preferred_element_type=jnp.float32) * scale
+    s = s * kscale                                    # K dequant
+    mask = (vm_ref[0][:, 0] > 0)[None, :]
+    s = jnp.where(mask, s, _NEG_INF)
+    m_prev = m_s[:, 0]
+    l_prev = l_s[:, 0]
+    m_new = jnp.maximum(m_prev, s.max(axis=1))
+    p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
+    # V dequant folds into p. Where-guard required: a poisoned stale
+    # tail carries NaN in its SCALE (kv_quant.quantize_rows) and
+    # 0 * NaN = NaN; the int8 values themselves are always finite, so
+    # a masked lane contributes exactly 0
+    pv = jnp.where(mask, p * vscale, 0.0)
+    corr = jnp.exp(m_prev - m_new)
+    m_s[:, 0] = m_new
+    l_s[:, 0] = l_prev * corr + p.sum(axis=1)
+    acc_s[:] = acc_s[:] * corr[:, None] + jnp.dot(
+        pv.astype(jnp.bfloat16), v_blk, precision=precision,
+        preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_kb - 1)
+    def _finalize():
+        l = jnp.maximum(l_s[:, 0], 1e-30)
+        o_ref[0] = (acc_s[:] / l[:, None]).astype(o_ref.dtype)
+
+
+def _decode_pallas_quant(q, k, v, lengths, block_k, precision, interpret):
+    """Quantized-pool path of :func:`decode_attention_pallas` — same
+    grid/flatten, two extra scale operands riding the K/V index maps."""
+    S, H, T, D = k.shape
+    blk_k = min(block_k, max(8, T))
+    t_pad = _cdiv(T, blk_k) * blk_k
+    kf = k.q.reshape(S * H, T, D)
+    vf = v.q.reshape(S * H, T, D)
+    ksf = k.scale.reshape(S * H, T, 1)
+    vsf = v.scale.reshape(S * H, T, 1)
+    qf = q.reshape(S * H, 1, D)
+    vm = (jnp.arange(T)[None, :] < lengths[:, None]).astype(
+        jnp.float32)[:, :, None]                       # [S, T, 1]
+    if t_pad != T:
+        pad = ((0, 0), (0, t_pad - T), (0, 0))
+        kf, vf, vm = jnp.pad(kf, pad), jnp.pad(vf, pad), jnp.pad(vm, pad)
+        ksf, vsf = jnp.pad(ksf, pad), jnp.pad(vsf, pad)
+    kernel = functools.partial(_decode_kernel_quant, blk_k=blk_k,
+                               scale=1.0 / (D ** 0.5), precision=precision)
+    out = pl.pallas_call(
+        kernel,
+        grid=(S * H, t_pad // blk_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, D), lambda sh, ki: (sh, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk_k, D), lambda sh, ki: (sh, ki, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk_k, D), lambda sh, ki: (sh, ki, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk_k, 1), lambda sh, ki: (sh, ki, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk_k, 1), lambda sh, ki: (sh, ki, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk_k, 1), lambda sh, ki: (sh // H, ki, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, D), lambda sh, ki: (sh, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((S * H, 1, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((1, 1), jnp.float32),   # running max
+            pltpu.VMEM((1, 1), jnp.float32),   # running sum
+            pltpu.VMEM((1, D), jnp.float32),   # output accumulator
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, ksf, vsf, vm)
+    return out.reshape(S, H, D)
 
 
 def decode_attention_pallas(q, k, v, lengths, block_k: int = 128,
@@ -109,9 +244,15 @@ def decode_attention_pallas(q, k, v, lengths, block_k: int = 128,
     """Pallas decode attention. Same contract as
     :func:`decode_attention_xla`; grid (S*H, k-blocks) with the
     per-slot validity column shared across heads via the ``sh // H``
-    index map (the `flash_attention` mask idiom)."""
+    index map (the `flash_attention` mask idiom). int8 QuantArray
+    caches route to the in-kernel-dequant variant."""
     if interpret is None:
         interpret = default_platform() != "tpu"
+    if is_quantized(k) or is_quantized(v):
+        if not (is_quantized(k) and is_quantized(v)):
+            raise ValueError("K and V caches must be quantized together")
+        return _decode_pallas_quant(q, k, v, lengths, block_k, precision,
+                                    interpret)
     S, H, T, D = k.shape
     blk_k = min(block_k, max(8, T))
     t_pad = _cdiv(T, blk_k) * blk_k

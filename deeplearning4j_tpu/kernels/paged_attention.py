@@ -39,11 +39,21 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .decode_attention import decode_attention_xla
 from .flash_attention import _NEG_INF, default_platform
+from .kv_quant import QuantArray, is_quantized
 
 
 def gather_blocks(pool, block_tables):
     """[N, H, Bs, D] pool + [S, B] tables -> [S, H, B*Bs, D] dense
-    per-sequence panels (the slot-cache layout), via one fused gather."""
+    per-sequence panels (the slot-cache layout), via one fused gather.
+    QuantArray pools gather values and their scale rows together — the
+    gathered view is itself a QuantArray in slot-cache layout."""
+    if is_quantized(pool):
+        S, B = block_tables.shape
+        N, H, Bs = pool.scale.shape
+        gs = jnp.take(pool.scale, block_tables.reshape(-1), axis=0)
+        gs = gs.reshape(S, B, H, Bs).transpose(0, 2, 1, 3)
+        return QuantArray(gather_blocks(pool.q, block_tables),
+                          gs.reshape(S, H, B * Bs))
     S, B = block_tables.shape
     N, H, Bs, D = pool.shape
     g = jnp.take(pool, block_tables.reshape(-1), axis=0)   # [S*B,H,Bs,D]
@@ -80,9 +90,12 @@ def _paged_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         l_s[:] = jnp.zeros_like(l_s)
         acc_s[:] = jnp.zeros_like(acc_s)
 
-    q = q_ref[0].astype(jnp.float32)                      # [1, D]
-    k_blk = k_ref[0, 0].astype(jnp.float32)               # [Bs, D]
-    v_blk = v_ref[0, 0].astype(jnp.float32)
+    # bf16 pools keep bf16 operands (MXU-native, f32 accumulation);
+    # only a true f32 pool runs f32 dots
+    od = jnp.float32 if k_ref.dtype == jnp.float32 else jnp.bfloat16
+    q = q_ref[0].astype(od)                               # [1, D]
+    k_blk = k_ref[0, 0].astype(od)                        # [Bs, D]
+    v_blk = v_ref[0, 0].astype(od)
     sc = jnp.dot(q, k_blk.T, precision=precision,
                  preferred_element_type=jnp.float32) * scale   # [1, Bs]
     # validity from the global key position, computed in-kernel: the
@@ -100,18 +113,115 @@ def _paged_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     p = jnp.where(mask, jnp.exp(sc - m_new[:, None]), 0.0)
     # zero masked V rows too: p=0 there, but 0 * NaN = NaN would leak
     # a recycled block's non-finite stale tail into the accumulator
-    v_blk = jnp.where(mask.reshape(-1, 1), v_blk, 0.0)
+    v_blk = jnp.where(mask.reshape(-1, 1), v_blk, jnp.zeros((), od))
     corr = jnp.exp(m_prev - m_new)
     m_s[:, 0] = m_new
     l_s[:, 0] = l_prev * corr + p.sum(axis=1)
     acc_s[:] = acc_s[:] * corr[:, None] + jnp.dot(
-        p, v_blk, precision=precision,
+        p.astype(od), v_blk, precision=precision,
         preferred_element_type=jnp.float32)
 
     @pl.when(bi == num_b - 1)
     def _finalize():
         l = jnp.maximum(l_s[:, 0], 1e-30)
         o_ref[0] = (acc_s[:] / l[:, None]).astype(o_ref.dtype)
+
+
+def _paged_kernel_quant(tbl_ref, len_ref, q_ref, k_ref, v_ref, ks_ref,
+                        vs_ref, o_ref, m_s, l_s, acc_s, *,
+                        block_size: int, scale: float, precision):
+    """int8 variant: the pool refs hold int8 values, ks/vs the
+    per-block-per-head f32 scale rows — riding the SAME
+    scalar-prefetched table index maps, so each grid step's DMA pulls
+    one int8 block plus its [Bs] scale row. Dequant happens here in
+    VMEM (K post-dot, V folded into the probabilities); HBM only ever
+    streams int8 (pallas guide §quantization)."""
+    s = pl.program_id(0)
+    bi = pl.program_id(2)
+    num_b = pl.num_programs(2)
+
+    @pl.when(bi == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, _NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    # int8 in [-127, 127] casts to bf16 exactly; dots stay MXU-native
+    q = q_ref[0].astype(jnp.bfloat16)                     # [1, D]
+    k_blk = k_ref[0, 0].astype(jnp.bfloat16)              # [Bs, D]
+    v_blk = v_ref[0, 0].astype(jnp.bfloat16)
+    kscale = ks_ref[0, 0][None, :]                        # [1, Bs]
+    vscale = vs_ref[0, 0][None, :]
+    sc = jnp.dot(q, k_blk.T, precision=precision,
+                 preferred_element_type=jnp.float32) * scale
+    sc = sc * kscale                                      # K dequant
+    key_pos = bi * block_size + lax.broadcasted_iota(
+        jnp.int32, (1, block_size), 1)
+    mask = key_pos < len_ref[s]
+    sc = jnp.where(mask, sc, _NEG_INF)
+    m_prev = m_s[:, 0]
+    l_prev = l_s[:, 0]
+    m_new = jnp.maximum(m_prev, sc.max(axis=1))
+    p = jnp.where(mask, jnp.exp(sc - m_new[:, None]), 0.0)
+    # V dequant folds into p. Where-guard required: a poisoned stale
+    # tail carries NaN in its SCALE (kv_quant.quantize_rows) and
+    # 0 * NaN = NaN; the int8 values themselves are always finite, so
+    # a masked lane contributes exactly 0
+    pv = jnp.where(mask, p * vscale, 0.0)
+    corr = jnp.exp(m_prev - m_new)
+    m_s[:, 0] = m_new
+    l_s[:, 0] = l_prev * corr + p.sum(axis=1)
+    acc_s[:] = acc_s[:] * corr[:, None] + jnp.dot(
+        pv.astype(jnp.bfloat16), v_blk, precision=precision,
+        preferred_element_type=jnp.float32)
+
+    @pl.when(bi == num_b - 1)
+    def _finalize():
+        l = jnp.maximum(l_s[:, 0], 1e-30)
+        o_ref[0] = (acc_s[:] / l[:, None]).astype(o_ref.dtype)
+
+
+def _paged_pallas_quant(q, k_pool, v_pool, block_tables, lengths,
+                        precision, interpret):
+    """Quantized-pool path of :func:`paged_attention_pallas` — same
+    grid and scalar-prefetched table, two extra scale operands whose
+    index maps aim at the SAME pool block as the values."""
+    S, H, D = q.shape
+    N, _, Bs, _ = k_pool.q.shape
+    B = block_tables.shape[1]
+    kernel = functools.partial(_paged_kernel_quant, block_size=Bs,
+                               scale=1.0 / (D ** 0.5),
+                               precision=precision)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,          # block_tables, lengths
+        grid=(S, H, B),
+        in_specs=[
+            pl.BlockSpec((1, 1, D), lambda s, h, bi, tbl, lens:
+                         (s, h, 0)),
+            pl.BlockSpec((1, 1, Bs, D), lambda s, h, bi, tbl, lens:
+                         (tbl[s, bi], h, 0, 0)),
+            pl.BlockSpec((1, 1, Bs, D), lambda s, h, bi, tbl, lens:
+                         (tbl[s, bi], h, 0, 0)),
+            pl.BlockSpec((1, 1, Bs), lambda s, h, bi, tbl, lens:
+                         (tbl[s, bi], h, 0)),
+            pl.BlockSpec((1, 1, Bs), lambda s, h, bi, tbl, lens:
+                         (tbl[s, bi], h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, D), lambda s, h, bi, tbl, lens:
+                               (s, h, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, 1), jnp.float32),   # running max
+            pltpu.VMEM((1, 1), jnp.float32),   # running sum
+            pltpu.VMEM((1, D), jnp.float32),   # output accumulator
+        ],
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, D), q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(block_tables, jnp.int32),
+      jnp.asarray(lengths, jnp.int32), q, k_pool.q, v_pool.q,
+      k_pool.scale, v_pool.scale)
 
 
 def paged_attention_pallas(q, k_pool, v_pool, block_tables, lengths,
@@ -121,9 +231,15 @@ def paged_attention_pallas(q, k_pool, v_pool, block_tables, lengths,
     :func:`paged_attention_xla`; grid (S, H, blocks-per-seq) with the
     block tables scalar-prefetched so the K/V index maps aim each grid
     step's DMA at ``pool[tbl[s, bi]]`` directly — no materialized
-    gather."""
+    gather. int8 QuantArray pools route to the in-kernel-dequant
+    variant (their scale rows ride the same table index maps)."""
     if interpret is None:
         interpret = default_platform() != "tpu"
+    if is_quantized(k_pool) or is_quantized(v_pool):
+        if not (is_quantized(k_pool) and is_quantized(v_pool)):
+            raise ValueError("K and V pools must be quantized together")
+        return _paged_pallas_quant(q, k_pool, v_pool, block_tables,
+                                   lengths, precision, interpret)
     S, H, D = q.shape
     N, _, Bs, _ = k_pool.shape
     B = block_tables.shape[1]

@@ -26,8 +26,78 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ...kernels.kv_quant import QuantArray, is_quantized, kv_set, mm
 from ...weightinit import init_weights
 from . import Layer, register
+
+
+def _cache_row(cache, i):
+    """Leading-axis row of a pool — for int8 QuantArrays the scale
+    row rides along (same index: scale drops only the trailing axis)."""
+    if is_quantized(cache):
+        return QuantArray(cache.q[i], cache.scale[i])
+    return cache[i]
+
+
+def _gather_span(pool, block_table, H, Dh):
+    """Gather a sequence's block-table span out of a paged pool into
+    one [H, T, Dh] panel (T = n_blocks * Bs). Quantized pools gather
+    the int8 blocks and the [H, T] scale sidecar with the same table."""
+    if is_quantized(pool):
+        qq = jnp.swapaxes(pool.q[block_table], 0, 1).reshape(H, -1, Dh)
+        ss = jnp.swapaxes(pool.scale[block_table], 0, 1).reshape(H, -1)
+        return QuantArray(qq, ss)
+    return jnp.swapaxes(pool[block_table], 0, 1).reshape(H, -1, Dh)
+
+
+def _span_attend(q, kk, vv, gpos, p0c, out_dtype):
+    """Causal span attention over one gathered K/V panel — the shared
+    math of :meth:`SelfAttentionLayer.apply_verify` (dense slot panel)
+    and :meth:`SelfAttentionLayer.apply_prefill_paged` (block-table
+    gather).
+
+    q: [C, H, Dh] span queries; kk/vv: [H, T, Dh] panels — plain f32
+    (bit-identical to the pre-quantization math), bf16, or int8
+    QuantArrays with [H, T] scales; gpos: [C] global positions (row c
+    sees keys j <= gpos[c]); p0c: scalar — first position NOT written
+    by this sequence (p0 + C): V beyond it is a previous occupant's
+    stale leavings and may be non-finite, so it is where-masked
+    (0 * NaN = NaN). Quantized legs run bf16-operand dots with f32
+    accumulation, K scales applied post-dot and V scales folded into
+    the probabilities — the same scale placement as the decode kernels
+    (kernels/decode_attention.py), checkable in StableHLO
+    (tools/perf_audit.py::audit_kv_quant)."""
+    H, T, Dh = kk.shape
+    scale = 1.0 / jnp.sqrt(jnp.float32(Dh))
+    valid = jnp.arange(T)[None, None, :] <= gpos[None, :, None]
+    written = (jnp.arange(T) < p0c)[None, :, None]
+    if is_quantized(kk) or kk.dtype == jnp.bfloat16:
+        kb = (kk.q if is_quantized(kk) else kk).astype(jnp.bfloat16)
+        vb = (vv.q if is_quantized(vv) else vv).astype(jnp.bfloat16)
+        s = jnp.einsum("chd,htd->hct", q.astype(jnp.bfloat16), kb,
+                       preferred_element_type=jnp.float32) * scale
+        if is_quantized(kk):              # [H, T] per-position scales
+            s = s * kk.scale[:, None, :]
+        s = jnp.where(valid, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        if is_quantized(vv):
+            # fold V scales into p. The where-guard matters: a stale
+            # row's scale may be NaN (poison is scale-carried, see
+            # kv_quant.quantize_rows) and 0 * NaN = NaN
+            p = jnp.where(valid, p * vv.scale[:, None, :], 0.0)
+        else:
+            p = jnp.where(valid, p, 0.0)
+        vb = jnp.where(written, vb, jnp.bfloat16(0))
+        att = jnp.einsum("hct,htd->chd", p.astype(jnp.bfloat16), vb,
+                         preferred_element_type=jnp.float32)
+        return att.astype(out_dtype)
+    s = jnp.einsum("chd,htd->hct", q.astype(jnp.float32),
+                   kk.astype(jnp.float32)) * scale
+    s = jnp.where(valid, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(valid, p, 0.0)
+    vv = jnp.where(written, vv.astype(jnp.float32), 0.0)
+    return jnp.einsum("hct,htd->chd", p, vv).astype(out_dtype)
 
 
 @register
@@ -173,8 +243,8 @@ class SelfAttentionLayer(Layer):
         v_t = (x @ params["Wv"]).reshape(B, H, Dh)
         rows = jnp.arange(B)[:, None]
         heads = jnp.arange(H)[None, :]
-        k_cache = k_cache.at[rows, heads, pos[:, None]].set(k_t)
-        v_cache = v_cache.at[rows, heads, pos[:, None]].set(v_t)
+        k_cache = kv_set(k_cache, (rows, heads, pos[:, None]), k_t)
+        v_cache = kv_set(v_cache, (rows, heads, pos[:, None]), v_t)
         att = decode_attention(q, k_cache, v_cache, pos + 1, impl=impl)
         out = att.reshape(B, self.n_out) @ params["Wo"] + params["b"]
         return self.activation(out), k_cache, v_cache
@@ -205,8 +275,8 @@ class SelfAttentionLayer(Layer):
                                   axis=1)[:, 0]
         off = pos % Bs
         heads = jnp.arange(H)[None, :]
-        k_pool = k_pool.at[blk[:, None], heads, off[:, None]].set(k_t)
-        v_pool = v_pool.at[blk[:, None], heads, off[:, None]].set(v_t)
+        k_pool = kv_set(k_pool, (blk[:, None], heads, off[:, None]), k_t)
+        v_pool = kv_set(v_pool, (blk[:, None], heads, off[:, None]), v_t)
         att = paged_attention(q, k_pool, v_pool, block_tables, pos + 1,
                               impl=impl)
         out = att.reshape(B, self.n_out) @ params["Wo"] + params["b"]
@@ -241,26 +311,14 @@ class SelfAttentionLayer(Layer):
         v_t = (xx @ params["Wv"]).reshape(C, H, Dh)
         gpos = p0 + jnp.arange(C)
         heads = jnp.arange(H)[None, :]
-        k_cache = k_cache.at[slot, heads, gpos[:, None]].set(k_t)
-        v_cache = v_cache.at[slot, heads, gpos[:, None]].set(v_t)
+        k_cache = kv_set(k_cache, (slot, heads, gpos[:, None]), k_t)
+        v_cache = kv_set(v_cache, (slot, heads, gpos[:, None]), v_t)
         # the slot's whole panel is the gathered span: row c (global
         # position p0+c) sees keys j <= p0+c, exactly the paged math
         # with the block-table gather replaced by one dense panel
-        kk = k_cache[slot]
-        vv = v_cache[slot]
-        scale = 1.0 / jnp.sqrt(jnp.float32(Dh))
-        s = jnp.einsum("chd,htd->hct", q.astype(jnp.float32),
-                       kk.astype(jnp.float32)) * scale
-        T = kk.shape[1]
-        valid = jnp.arange(T)[None, None, :] <= gpos[None, :, None]
-        s = jnp.where(valid, s, -1e30)
-        p = jax.nn.softmax(s, axis=-1)
-        p = jnp.where(valid, p, 0.0)
-        # V beyond what this slot has written is a previous occupant's
-        # stale leavings and may be non-finite; 0 * NaN = NaN, so mask
-        written = (jnp.arange(T) < p0 + C)[None, :, None]
-        vv = jnp.where(written, vv.astype(jnp.float32), 0.0)
-        att = jnp.einsum("hct,htd->chd", p, vv).astype(x.dtype)
+        kk = _cache_row(k_cache, slot)
+        vv = _cache_row(v_cache, slot)
+        att = _span_attend(q, kk, vv, gpos, p0 + C, x.dtype)
         out = att.reshape(C, self.n_out) @ params["Wo"] + params["b"]
         return self.activation(out)[None], k_cache, v_cache
 
@@ -302,27 +360,15 @@ class SelfAttentionLayer(Layer):
         blk = block_table[gpos // Bs]
         off = gpos % Bs
         heads = jnp.arange(H)[None, :]
-        k_pool = k_pool.at[blk[:, None], heads, off[:, None]].set(k_t)
-        v_pool = v_pool.at[blk[:, None], heads, off[:, None]].set(v_t)
+        k_pool = kv_set(k_pool, (blk[:, None], heads, off[:, None]), k_t)
+        v_pool = kv_set(v_pool, (blk[:, None], heads, off[:, None]), v_t)
         # gather the sequence's whole table span and attend causally:
         # chunk query c (global position p0+c) sees keys j <= p0+c —
         # earlier chunks' K/V comes back out of the pool it went into
-        kk = jnp.swapaxes(k_pool[block_table], 0, 1).reshape(H, -1, Dh)
-        vv = jnp.swapaxes(v_pool[block_table], 0, 1).reshape(H, -1, Dh)
-        scale = 1.0 / jnp.sqrt(jnp.float32(Dh))
-        s = jnp.einsum("chd,htd->hct", q.astype(jnp.float32),
-                       kk.astype(jnp.float32)) * scale
-        T = kk.shape[1]
-        valid = jnp.arange(T)[None, None, :] <= gpos[None, :, None]
-        s = jnp.where(valid, s, -1e30)
-        p = jax.nn.softmax(s, axis=-1)
-        p = jnp.where(valid, p, 0.0)
-        # V past what this sequence has WRITTEN (j >= p0 + C) is a
-        # previous occupant's stale leavings and may be non-finite;
-        # p is 0 there but 0 * NaN = NaN, so mask V as well
-        written = (jnp.arange(T) < p0 + C)[None, :, None]
-        vv = jnp.where(written, vv.astype(jnp.float32), 0.0)
-        att = jnp.einsum("hct,htd->chd", p, vv).astype(x.dtype)
+        # (quantized on write, scales gathered alongside)
+        kk = _gather_span(k_pool, block_table, H, Dh)
+        vv = _gather_span(v_pool, block_table, H, Dh)
+        att = _span_attend(q, kk, vv, gpos, p0 + C, x.dtype)
         out = att.reshape(C, self.n_out) @ params["Wo"] + params["b"]
         return self.activation(out)[None], k_pool, v_pool
 
@@ -424,10 +470,15 @@ class TransformerEncoderLayer(Layer):
                 if k.startswith("attn_")}
 
     def _mlp(self, params, x):
+        # serving-path MLP: kv_quant.mm dispatches int8 weight-only
+        # matmuls (bf16 operands, f32 accumulation, per-output-channel
+        # dequant after the dot) when W1/W2 are QuantWeights — plain
+        # f32 weights fall through to the ordinary `@` unchanged. The
+        # training MLP (apply_seq) never sees QuantWeights.
         from ..functional import layer_norm as _ln
         h = _ln(x, params["ln2_g"], params["ln2_b"])
-        h = jax.nn.gelu(h @ params["W1"] + params["b1"])
-        return x + (h @ params["W2"] + params["b2"])
+        h = jax.nn.gelu(mm(h, params["W1"]) + params["b1"])
+        return x + (mm(h, params["W2"]) + params["b2"])
 
     def apply_prefill(self, params, x, key_mask=None):
         """Block prefill: the apply_seq math without dropout, also

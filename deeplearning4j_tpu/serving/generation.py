@@ -57,6 +57,8 @@ from .batcher import (PRIORITIES, DeadlineExceededError, DrainingError,
 from .engine import ClientError, ServingError, compile_memoized
 from .faults import (CorruptedStateFault, PoisonRequestError,
                      TransientFault, poll_until_idle)
+from ..kernels.kv_quant import (canonical_kv_dtype, kv_bytes_per_token,
+                                kv_copy_row, kv_update_slice)
 from .kvcache import KVCache, SlotTable
 from .metrics import GenerationMetrics
 from .paging import (NULL_BLOCK, BlockAllocator, BlockTable, PagedKVCache,
@@ -393,7 +395,8 @@ class GenerationEngine:
                  batch_queue_fraction: float = 0.5,
                  speculation_k: int = 0,
                  draft_model=None,
-                 decode_pipeline: bool = True):
+                 decode_pipeline: bool = True,
+                 kv_dtype: str = "f32"):
         if getattr(model, "_params", None) is None:
             model.init()
         self.model = model
@@ -423,6 +426,12 @@ class GenerationEngine:
         self._vbucket = (verify_bucket(self.speculation_k)
                          if self.speculation_k else 0)
         self.decode_impl = decode_impl
+        # quantized serving plane (ISSUE 15): storage precision of the
+        # KV pool — "f32" (exact, default), "bf16" (half the bytes),
+        # "int8" (quarter; per-row f32 scale sidecars ride the same
+        # pytrees). The draft cache stays f32: it is tiny and its
+        # tokens are only proposals, verified by the target anyway.
+        self.kv_dtype = canonical_kv_dtype(kv_dtype)
         self.default_timeout_ms = float(default_timeout_ms)
         self.min_prompt_bucket = int(min_prompt_bucket)
         if prompt_buckets is None:
@@ -511,6 +520,12 @@ class GenerationEngine:
         self.metrics.cache_backend = self.cache_backend
         self._cache = self._fresh_cache()
         self.metrics.cache_bytes = self._cache.nbytes()
+        self.metrics.kv_dtype = self.kv_dtype
+        self.metrics.kv_bits = {"f32": 32, "bf16": 16, "int8": 8}[
+            self.kv_dtype]
+        self.metrics.kv_bytes_per_token = kv_bytes_per_token(
+            self._cache.layer_shapes, self.kv_dtype)
+        self.metrics.quant_scale_bytes = self._cache.scale_nbytes()
         self._kcs = self._cache.ks
         self._vcs = self._cache.vs
         self._slots = SlotTable(self.num_slots)
@@ -650,9 +665,10 @@ class GenerationEngine:
         per-block layer shapes come from the same model surface."""
         if self.cache_backend == "paged":
             return PagedKVCache(self.model.cache_shapes(self.block_size),
-                                self.num_blocks)
+                                self.num_blocks,
+                                kv_dtype=self.kv_dtype)
         return KVCache(self.model.cache_shapes(self.max_seq_len),
-                       self.num_slots)
+                       self.num_slots, kv_dtype=self.kv_dtype)
 
     def _update_block_gauges(self):
         """Push allocator + liveness gauges into the metrics object
@@ -691,6 +707,9 @@ class GenerationEngine:
             fill[b] = bs  # indexed blocks are full prompt blocks
         self.metrics.kv_tokens_live = sum(fill.values())
         self.metrics.kv_tokens_allocated = a.used_count * bs
+        if self.kv_dtype == "int8":
+            # every allocated block holds quantize-on-write content
+            self.metrics.quant_blocks_quantized = a.used_count
         self.metrics.shared_blocks = a.shared_count
         self.metrics.prefix_blocks = len(self._prefix_index)
         self.metrics.sessions_live = len(self._sessions)
@@ -787,9 +806,9 @@ class GenerationEngine:
             # write this request's K/V rows into its slot; positions
             # past ``length`` hold junk from the padded prompt tail but
             # stay masked (and are overwritten as decode advances)
-            kcs = [jax.lax.dynamic_update_slice(kc, k, (slot, 0, 0, 0))
+            kcs = [kv_update_slice(kc, k, (slot, 0, 0, 0))
                    for kc, k in zip(kcs, ks)]
-            vcs = [jax.lax.dynamic_update_slice(vc, v, (slot, 0, 0, 0))
+            vcs = [kv_update_slice(vc, v, (slot, 0, 0, 0))
                    for vc, v in zip(vcs, vs)]
             last = jax.lax.dynamic_index_in_dim(
                 logits[0], length - 1, axis=0, keepdims=False)
@@ -854,8 +873,11 @@ class GenerationEngine:
 
     def _cow_fn(self):
         def cow(kcs, vcs, src, dst):
-            kcs = [kc.at[dst].set(kc[src]) for kc in kcs]
-            vcs = [vc.at[dst].set(vc[src]) for vc in vcs]
+            # kv_copy_row copies the int8 block AND its scale row
+            # together — a scale-less copy would silently rescale the
+            # shared prefix (tests/test_kv_quant.py::TestCOWScales)
+            kcs = [kv_copy_row(kc, src, dst) for kc in kcs]
+            vcs = [kv_copy_row(vc, src, dst) for vc in vcs]
             return kcs, vcs
         return cow
 

@@ -38,27 +38,43 @@ from typing import List, Optional, Sequence, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from ..kernels.kv_quant import canonical_kv_dtype, kv_nbytes, kv_zeros
+
 
 class KVCache:
     """Per-layer K/V slot arrays, held as a pytree the compiled
     prefill/decode executables thread through (functionally: each call
-    returns the updated arrays, which replace these)."""
+    returns the updated arrays, which replace these).
+
+    ``kv_dtype`` selects the storage precision (ROADMAP item 3):
+    ``"f32"`` (exact, default), ``"bf16"`` (half the bytes), or
+    ``"int8"`` (quarter the bytes — each per-layer array becomes a
+    :class:`~deeplearning4j_tpu.kernels.kv_quant.QuantArray` with a
+    per-position per-head f32 scale sidecar; still a pytree, so the
+    executables and donation tuples are unchanged)."""
 
     def __init__(self, layer_shapes: Sequence[Tuple[int, int, int]],
-                 num_slots: int, dtype=jnp.float32):
+                 num_slots: int, kv_dtype: str = "f32"):
         self.num_slots = int(num_slots)
         self.layer_shapes = [tuple(s) for s in layer_shapes]
-        self.dtype = dtype
-        self.ks: List[jnp.ndarray] = [
-            jnp.zeros((self.num_slots,) + s, dtype) for s in self.layer_shapes]
-        self.vs: List[jnp.ndarray] = [
-            jnp.zeros((self.num_slots,) + s, dtype) for s in self.layer_shapes]
+        self.kv_dtype = canonical_kv_dtype(kv_dtype)
+        self.ks: List = [kv_zeros((self.num_slots,) + s, self.kv_dtype)
+                         for s in self.layer_shapes]
+        self.vs: List = [kv_zeros((self.num_slots,) + s, self.kv_dtype)
+                         for s in self.layer_shapes]
 
     def nbytes(self) -> int:
-        """Device bytes the cache pins — the number to budget
-        num_slots * max_seq_len against HBM."""
-        return int(sum(2 * int(np.prod((self.num_slots,) + s))
-                       * jnp.dtype(self.dtype).itemsize
+        """Device bytes the cache pins (int8 scale sidecars included)
+        — the number to budget num_slots * max_seq_len against HBM."""
+        return int(sum(2 * kv_nbytes((self.num_slots,) + s,
+                                     self.kv_dtype)
+                       for s in self.layer_shapes))
+
+    def scale_nbytes(self) -> int:
+        """Bytes of the f32 scale sidecars alone (0 unless int8)."""
+        if self.kv_dtype != "int8":
+            return 0
+        return int(sum(2 * int(np.prod((self.num_slots,) + s[:-1])) * 4
                        for s in self.layer_shapes))
 
 

@@ -149,6 +149,17 @@ class GenerationMetrics:
         self.active_slots = 0      # gauge
         self.num_slots = 0
         self.cache_bytes = 0
+        # quantized KV pool (ISSUE 15; kernels/kv_quant.py). kv_dtype
+        # is a STRING (exposition walker skips strings — identity in
+        # labels), so kv_bits carries the precision into /metrics as a
+        # numeric gauge (32 / 16 / 8)
+        self.kv_dtype = "f32"
+        self.kv_bits = 32
+        self.kv_bytes_per_token = 0    # K+V bytes per position, all
+        #                                layers, sidecar included
+        self.quant_blocks_quantized = 0  # gauge: allocated int8 blocks
+        self.quant_scale_bytes = 0       # f32 sidecar bytes (0 unless
+        #                                  int8)
         # paged-cache gauges/counters (serving/paging.py; all zero
         # when the engine runs the dense slot backend)
         self.cache_backend = "slots"
@@ -291,6 +302,13 @@ class GenerationMetrics:
                 k: round(v, 3) for k, v in
                 self.decode_sync_wait_ms.snapshot().items()},
             "kv_cache_bytes": self.cache_bytes,
+            "kv_dtype": self.kv_dtype,
+            "kv_bits": self.kv_bits,
+            "kv_bytes_per_token": self.kv_bytes_per_token,
+            "quant": {
+                "blocks_quantized": self.quant_blocks_quantized,
+                "scale_bytes": self.quant_scale_bytes,
+            },
             "compile_cache": {
                 "compiles": self.compiles,
                 "warmed_buckets": list(self.warmed_buckets),

@@ -57,6 +57,8 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from ..kernels.kv_quant import canonical_kv_dtype, kv_nbytes, kv_zeros
+
 #: Block index reserved as the write/read target for padded table
 #: entries. Never handed out by the allocator.
 NULL_BLOCK = 0
@@ -220,32 +222,52 @@ class PagedKVCache:
     by every sequence instead of per-sequence slots.
 
     ``layer_shapes`` are per-layer ``(n_heads, block_size, head_dim)``
-    — i.e. ``model.cache_shapes(block_size)``."""
+    — i.e. ``model.cache_shapes(block_size)``.
+
+    ``kv_dtype`` selects the storage precision (ROADMAP item 3):
+    ``"f32"`` (exact, default), ``"bf16"``, or ``"int8"`` — per-layer
+    pools become
+    :class:`~deeplearning4j_tpu.kernels.kv_quant.QuantArray` pytrees
+    with a ``[num_blocks, H, block_size]`` f32 scale sidecar, i.e.
+    per-block-per-head scales indexed by block id (the block is the
+    quantization granule). Copy-on-write and the no-zeroing-on-reuse
+    contract carry over unchanged: a block copy copies its scale row,
+    a recycled block's stale (quantized) tail stays masked by the next
+    owner's length."""
 
     def __init__(self, layer_shapes: Sequence[Tuple[int, int, int]],
-                 num_blocks: int, dtype=jnp.float32):
+                 num_blocks: int, kv_dtype: str = "f32"):
         self.num_blocks = int(num_blocks)
         self.layer_shapes = [tuple(s) for s in layer_shapes]
         self.block_size = int(self.layer_shapes[0][1])
-        self.dtype = dtype
-        self.ks: List[jnp.ndarray] = [
-            jnp.zeros((self.num_blocks,) + s, dtype)
+        self.kv_dtype = canonical_kv_dtype(kv_dtype)
+        self.ks: List = [
+            kv_zeros((self.num_blocks,) + s, self.kv_dtype)
             for s in self.layer_shapes]
-        self.vs: List[jnp.ndarray] = [
-            jnp.zeros((self.num_blocks,) + s, dtype)
+        self.vs: List = [
+            kv_zeros((self.num_blocks,) + s, self.kv_dtype)
             for s in self.layer_shapes]
 
     def nbytes(self) -> int:
         """Device bytes the pool pins: ``num_blocks * block_size * H *
-        Dh * 2 (K+V) * layers * itemsize`` — the number to budget
-        against HBM (docs/generation.md has the sizing guidance)."""
-        return int(sum(2 * int(np.prod((self.num_blocks,) + s))
-                       * jnp.dtype(self.dtype).itemsize
+        Dh * 2 (K+V) * layers * itemsize``, plus the f32 scale
+        sidecars for int8 — the number to budget against HBM
+        (docs/generation.md has the sizing guidance)."""
+        return int(sum(2 * kv_nbytes((self.num_blocks,) + s,
+                                     self.kv_dtype)
                        for s in self.layer_shapes))
 
     def block_nbytes(self) -> int:
-        """Bytes one block pins across all layers (K+V)."""
+        """Bytes one block pins across all layers (K+V, sidecar
+        included)."""
         return self.nbytes() // self.num_blocks
+
+    def scale_nbytes(self) -> int:
+        """Bytes of the f32 scale sidecars alone (0 unless int8)."""
+        if self.kv_dtype != "int8":
+            return 0
+        return int(sum(2 * int(np.prod((self.num_blocks,) + s[:-1]))
+                       * 4 for s in self.layer_shapes))
 
 
 def chain_hashes(tokens: Sequence[int], block_size: int) -> List[bytes]:

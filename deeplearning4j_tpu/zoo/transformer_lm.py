@@ -220,6 +220,27 @@ class CausalTransformerLM:
                                     jnp.asarray(tokens, jnp.int32))[0]
 
 
+def quantize_mlp_weights(model: CausalTransformerLM
+                         ) -> CausalTransformerLM:
+    """Convert every block's MLP weights (W1/W2) to int8 weight-only
+    :class:`~deeplearning4j_tpu.kernels.kv_quant.QuantWeight` matrices
+    in place (per-output-channel scales; biases, attention projections
+    and norms stay f32). The serving-path MLP
+    (`nn/layers/attention.py::TransformerEncoderLayer._mlp`) dispatches
+    on the type — bf16-operand dots, f32 accumulation, dequant fused
+    after the dot — so the quantized params pytree threads through the
+    existing compiled-executable signatures unchanged. Idempotent.
+    Returns the model for chaining."""
+    from ..kernels.kv_quant import QuantWeight, quantize_weight
+    if model._params is None:
+        model.init()
+    for bp in model._params["blocks"]:
+        for name in ("W1", "W2"):
+            if not isinstance(bp[name], QuantWeight):
+                bp[name] = quantize_weight(bp[name])
+    return model
+
+
 def make_draft_lm(target: CausalTransformerLM, d_model: int = 32,
                   n_layers: int = 1, n_heads: int = 2,
                   d_ff: Optional[int] = None,

@@ -142,6 +142,22 @@ METRICS = {
     # BENCH_*.json records a baseline, gated after
     ("extra", "connscale", "streaming_conns"): "connscale_streaming_conns",
     ("extra", "connscale", "p99_ms"): "connscale_p99_ms",
+    # quantized KV pool (ISSUE 15): equal-pool-bytes legs across
+    # kv_dtype — concurrent-user capacity ratio is the headline gate
+    # (int8 >= 2x f32 at equal bytes), tokens/sec per dtype hold the
+    # line, logit rel-err vs f32 is the documented tolerance (lower
+    # is better) — "new, skipped" until the next BENCH_*.json records
+    # a baseline, gated after
+    ("extra", "generation", "kv_bf16_tokens_per_sec"):
+        "kv_bf16_tokens_per_sec",
+    ("extra", "generation", "kv_int8_tokens_per_sec"):
+        "kv_int8_tokens_per_sec",
+    ("extra", "generation", "kv_int8_concurrent_users_vs_f32"):
+        "kv_int8_concurrent_users_vs_f32",
+    ("extra", "generation", "kv_bf16_logit_rel_err"):
+        "kv_bf16_logit_rel_err",
+    ("extra", "generation", "kv_int8_logit_rel_err"):
+        "kv_int8_logit_rel_err",
 }
 
 #: metric NAMES (values of METRICS) where LOWER is better — latency
@@ -165,6 +181,8 @@ LOWER_IS_BETTER = {
     "session_ttft_turnN_ms",
     "spec_itl_p99_ms",
     "connscale_p99_ms",
+    "kv_bf16_logit_rel_err",
+    "kv_int8_logit_rel_err",
 }
 
 # A LOWER_IS_BETTER metric recorded at exactly 0.0 hit its FLOOR —

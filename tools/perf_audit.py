@@ -55,6 +55,113 @@ def _stablehlo_dtype_scan(txt: str) -> dict:
             "by_dtype": dict(c)}
 
 
+def _stablehlo_dot_operand_scan(txt: str) -> dict:
+    """OPERAND-dtype audit of StableHLO dots. The result-dtype scan
+    above is the wrong lens for the quantized KV legs: their cache-side
+    dots run bf16 OPERANDS with ``preferred_element_type=f32``, so the
+    result tensor is f32 by design — what the MXU streams is the
+    operand dtype. Counts (lhs, rhs) dtype pairs of every
+    ``stablehlo.dot_general``."""
+    pairs = re.findall(
+        r"stablehlo\.dot(?:_general)?\b[^\n]*:\s*"
+        r"\(tensor<[^>]*x(\w+)>,\s*tensor<[^>]*x(\w+)>\)", txt)
+    from collections import Counter
+    c = Counter(pairs)
+    return {"dot_total": sum(c.values()),
+            "dot_f32_operands": c.get(("f32", "f32"), 0),
+            "dot_bf16_operands": c.get(("bf16", "bf16"), 0),
+            "by_operands": {f"{a}x{b}": n for (a, b), n in c.items()}}
+
+
+def audit_kv_quant():
+    """ISSUE 15 satellite: StableHLO dot-dtype scan of the generation
+    engine's decode / chunk-prefill / speculative-verify executables
+    across kv_dtype legs. On the bf16/int8 legs every CACHE-side
+    attention dot (QK and PV, 2 per layer) must run on bf16 operands —
+    an f32-operand dot there means a dequantized cache round-tripped
+    through HBM. Checked structurally: the quant leg's f32-operand dot
+    count must equal the f32 baseline's minus exactly the attention
+    dots that moved to bf16, and nothing else may move. Asserts in the
+    returned dict (``unintended_f32_dots`` == 0 per executable) so the
+    bench/CI caller can gate on it."""
+    import jax
+    from deeplearning4j_tpu.serving.generation import GenerationEngine
+    from deeplearning4j_tpu.serving.paging import NULL_BLOCK
+    from deeplearning4j_tpu.serving.speculative import make_verify_slots_fn
+    from deeplearning4j_tpu.zoo.transformer_lm import CausalTransformerLM
+
+    NL, NH, C = 2, 4, 8
+
+    def build(kv_dtype, cache="paged"):
+        lm = CausalTransformerLM(vocab_size=64, d_model=32, n_layers=NL,
+                                 n_heads=NH, max_seq_len=64,
+                                 seed=0).init()
+        kw = dict(num_slots=4, max_queue=32, prompt_buckets=[16],
+                  kv_dtype=kv_dtype)
+        if cache == "paged":
+            kw.update(cache="paged", block_size=8,
+                      prefill_chunk_tokens=16)
+        return GenerationEngine(lm, **kw)
+
+    def lower_decode(eng):
+        S = eng.num_slots
+        args = (eng.model._params, eng._kcs, eng._vcs,
+                np.zeros(S, np.int32), np.zeros(S, np.int32),
+                np.ones(S, bool), np.zeros(S, np.int32),
+                np.full((S, eng._blocks_per_seq), NULL_BLOCK, np.int32),
+                np.zeros(S, np.uint32), np.zeros(S, np.int32),
+                np.zeros(S, np.float32), np.zeros(S, np.int32),
+                np.full(S, -1, np.int32), np.zeros(S, np.int32))
+        return jax.jit(eng._decode_fn(),
+                       donate_argnums=eng._donate).lower(*args).as_text()
+
+    def lower_chunk(eng, cb=16, tb=8):
+        args = (eng.model._params, eng._kcs, eng._vcs,
+                np.zeros((1, cb), np.int32), np.int32(0), np.int32(1),
+                np.full(tb, NULL_BLOCK, np.int32),
+                np.uint32(0), np.float32(0.0), np.int32(0))
+        return jax.jit(eng._chunk_fn(),
+                       donate_argnums=eng._donate).lower(*args).as_text()
+
+    def lower_verify(eng):
+        fn = make_verify_slots_fn(eng.model)
+        args = (eng.model._params, eng._kcs, eng._vcs,
+                np.zeros((1, C), np.int32), np.int32(0), np.int32(1),
+                np.int32(0), np.uint32(0), np.int32(0),
+                np.float32(0.0), np.int32(0))
+        return jax.jit(fn,
+                       donate_argnums=(1, 2)).lower(*args).as_text()
+
+    legs = {}
+    for dt in ("f32", "bf16", "int8"):
+        eng = build(dt)
+        slot_eng = build(dt, cache="slots")
+        legs[dt] = {
+            "decode": _stablehlo_dot_operand_scan(lower_decode(eng)),
+            "prefill_chunk": _stablehlo_dot_operand_scan(
+                lower_chunk(eng)),
+            "verify": _stablehlo_dot_operand_scan(
+                lower_verify(slot_eng)),
+        }
+        eng.stop()
+        slot_eng.stop()
+
+    # QK + PV per layer must move (and ONLY those) on the quant legs
+    expect_moved = 2 * NL
+    for dt in ("bf16", "int8"):
+        for exe, scan in legs[dt].items():
+            base = legs["f32"][exe]
+            scan["unintended_f32_dots"] = (
+                scan["dot_f32_operands"]
+                - (base["dot_f32_operands"] - expect_moved))
+            scan["attention_dots_bf16_ok"] = (
+                scan["dot_bf16_operands"] == expect_moved)
+    ok = all(s["unintended_f32_dots"] == 0 and s["attention_dots_bf16_ok"]
+             for dt in ("bf16", "int8") for s in legs[dt].values())
+    return {"n_layers": NL, "expected_moved_dots": expect_moved,
+            "legs": legs, "ok": ok}
+
+
 def _hlo_scan(txt: str) -> dict:
     """Count the performance-relevant instruction classes in optimized
     HLO text. CPU-backend HLO differs from TPU in fusion/layout detail
@@ -330,6 +437,13 @@ def audit_sharded_collectives(n_devices=8, batch=32):
 
 
 def main():
+    if "--kv-quant" in sys.argv:
+        res = audit_kv_quant()
+        print(json.dumps(res, indent=1))
+        if not res["ok"]:
+            raise AssertionError(
+                "unintended f32 dots on a quantized KV leg")
+        return
     results = {"spec": {"v5e_bf16_flops": V5E_BF16_FLOPS,
                         "v5e_hbm_bps": V5E_HBM_BPS}}
     models = []
@@ -340,6 +454,8 @@ def main():
     print("auditing bert_base...", flush=True)
     models.append(audit_bert())
     results["models"] = models
+    print("auditing quantized KV dot dtypes...", flush=True)
+    results["kv_quant"] = audit_kv_quant()
     print("auditing sharded collectives...", flush=True)
     results["sharded_collectives"] = audit_sharded_collectives()
     results["donation_sites"] = donation_audit()
