@@ -980,6 +980,80 @@ def q_relerr(dt):
     return float(np.max(np.abs(q_logits(dt) - q_ref))
                  / np.max(np.abs(q_ref)))
 
+# -- hierarchical KV tier (ISSUE 16): session persistence BELOW the
+# device pool. NSESS 2-turn conversations against a pool that pins
+# only ~2 of them: completed sessions demote to host RAM on eviction,
+# and every turn-2 resume restores its run instead of re-prefilling.
+# Gated claims: live sessions >= 10x what the pool alone holds, ZERO
+# evicted-session re-prefills (every turn 2 is a session hit),
+# restored-turn TTFT within 2x of a hot resume on an eviction-free
+# pool, tokens identical to the big-pool engine, zero post-warmup
+# recompiles (restores reuse the warmed gather/scatter executables),
+# and the int8 byte shrink carrying into host bytes (>= 3x more
+# sessions per host GB than f32 at head_dim 16).
+OBS, NSESS, O_GEN = 16, 32, 8
+O_PROMPTS = [rs.randint(0, VOCAB, 32).tolist() for _ in range(NSESS)]
+O_SUFFIX = [rs.randint(0, VOCAB, 4).tolist() for _ in range(NSESS)]
+o_bps = blocks_for(32 + O_GEN + 4 + O_GEN - 1, OBS)  # turn-2 pin
+O_BLOCKS = 2 * o_bps + o_bps + 1          # ~2 pinned + 1 active + NULL
+
+def o_mkeng(nblocks, host_bytes=0, dt="f32"):
+    e = GenerationEngine(lm, num_slots=4, max_queue=NSESS * 2 + 8,
+                         cache="paged", block_size=OBS,
+                         num_blocks=nblocks, prompt_buckets=[32],
+                         prefill_chunk_tokens=32, kv_dtype=dt,
+                         offload_host_bytes=host_bytes)
+    e.warmup()
+    return e
+
+def o_run(e, tag):
+    '''All turn 1s, then all turn 2s — every session is long evicted
+    (and with offload, demoted) before its own resume arrives.'''
+    t2_ttft, outs1, outs2 = [], [], []
+    for i in range(NSESS):
+        _, toks = stream_one(e, O_PROMPTS[i], i, O_GEN,
+                             sid="%s-%d" % (tag, i))
+        outs1.append(toks)
+    miss_t1 = e.metrics.session_misses
+    for i in range(NSESS):
+        p2 = O_PROMPTS[i] + outs1[i] + O_SUFFIX[i]
+        ttft, toks = stream_one(e, p2, i, O_GEN, sid="%s-%d" % (tag, i))
+        t2_ttft.append(ttft)
+        outs2.append(toks)
+    return t2_ttft, outs1 + outs2, e.metrics.session_misses - miss_t1
+
+# hot reference: pool big enough that no session is ever evicted —
+# its turn-2 TTFT is the hot-resume bar AND its tokens are the
+# no-offload ground truth
+o_ref = o_mkeng(NSESS * (o_bps + 1) + 8)
+o_run(o_ref, "wu")                          # warmup pass
+o_ref.evict_sessions(); o_ref.clear_prefix_cache()
+ref_t2, ref_out, _ = o_run(o_ref, "m")
+o_ref.stop()
+
+o_eng = o_mkeng(O_BLOCKS, host_bytes=64 << 20)
+o_run(o_eng, "wu")                          # warmup pass
+o_eng.evict_sessions(); o_eng.clear_prefix_cache(); o_eng.clear_offload()
+o_c0 = o_eng.metrics.compiles
+off_t2, off_out, off_reprefills = o_run(o_eng, "m")
+o_recompiles = o_eng.metrics.compiles - o_c0
+o_snap = o_eng.stats()["paged"]["offload"]
+# f32 host cost per demoted block (park everything first)
+o_eng.offload_sessions()
+o_f32_pb = (o_eng.stats()["paged"]["offload"]["host_bytes"]
+            / max(1, o_eng.stats()["paged"]["offload"]["host_blocks"]))
+o_pool_sessions = max(1, (O_BLOCKS - 1) // o_bps)
+o_eng.stop()
+
+# int8 mini-leg: same demote-everything shape, host bytes per block
+o_i8 = o_mkeng(O_BLOCKS, host_bytes=64 << 20, dt="int8")
+for i in range(6):
+    stream_one(o_i8, O_PROMPTS[i], i, O_GEN, sid="cap-%d" % i)
+o_i8.offload_sessions()
+o_i8_snap = o_i8.stats()["paged"]["offload"]
+o_i8_pb = o_i8_snap["host_bytes"] / max(1, o_i8_snap["host_blocks"])
+o_i8.stop()
+
 d = jax.devices()[0]
 print(json.dumps({
     "model": f"CausalTransformerLM d{DM}xL{NL} generation "
@@ -1077,6 +1151,23 @@ print(json.dumps({
     "kv_int8_logit_rel_err": round(q_relerr("int8"), 5),
     "kv_quant_recompiles_post_warmup": sum(
         l["recompiles"] for l in q_legs.values()),
+    "offload_live_sessions": NSESS,
+    "offload_pool_sessions": o_pool_sessions,
+    "offload_sessions_per_pool_ratio": round(NSESS / o_pool_sessions, 2),
+    "offload_evicted_reprefills": off_reprefills,
+    "offload_demotions": o_snap["demotions"],
+    "offload_restores": o_snap["restores"],
+    "offload_prefetch_hits": o_snap["prefetch_hits"],
+    "offload_restore_ttft_ms_p50": round(pct(off_t2, 50), 2),
+    "offload_hot_ttft_ms_p50": round(pct(ref_t2, 50), 2),
+    "offload_restore_ttft_ratio": round(
+        pct(off_t2, 50) / max(1e-9, pct(ref_t2, 50)), 3),
+    "offload_tokens_identical": off_out == ref_out,
+    "offload_recompiles_post_warmup": o_recompiles,
+    "offload_restore_ms_p50": o_snap["restore_ms"]["p50"],
+    "offload_f32_host_bytes_per_block": round(o_f32_pb, 1),
+    "offload_int8_host_bytes_per_block": round(o_i8_pb, 1),
+    "offload_int8_capacity_vs_f32": round(o_f32_pb / o_i8_pb, 2),
     "synthetic_data": True}))
 """
 
@@ -1490,10 +1581,17 @@ def factory():
                         tracing=True, trace_ring=4096)
     s.register("default", SlowMLP())
     g = s.register_generator("lm", lm, num_slots=2, max_seq_len=32,
-                             prompt_buckets=[8], max_queue=8,
+                             prompt_buckets=[8, 16], max_queue=8,
                              cache="paged", block_size=4, num_blocks=16)
     g.warmup()
     return s
+
+# long-context generate class (ISSUE 16): ~13-token prompts land in
+# the 16 bucket — their prefill cost and block footprint are several
+# times the short class's, so under overload they probe whether
+# admission keeps long-prompt TTFT bounded instead of letting the
+# deep prefill starve the short streams (recorded separately below)
+LONG_PROMPT = [(7 * j) % 60 + 1 for j in range(13)]
 
 fleet = ReplicaFleet(poll_interval_s=0.1)
 for _ in range(2):
@@ -1509,7 +1607,7 @@ def mkleg():
             "by_prio": {"interactive": [0, 0], "batch": [0, 0]},
             # [offered, shed] per priority class
             "lat_ms": {"interactive": [], "batch": []},
-            "ttft_ms": [], "itl_ms": []}
+            "ttft_ms": [], "itl_ms": [], "ttft_long_ms": []}
 
 def do_predict(leg, prio, deadline_ms, t_arr):
     st, _body = router.post("/predict",
@@ -1528,12 +1626,13 @@ def do_predict(leg, prio, deadline_ms, t_arr):
         else:
             leg["other"] += 1
 
-def do_generate(leg, t_arr):
+def do_generate(leg, t_arr, long=False):
     gaps, t_first = [], None
+    prompt = LONG_PROMPT if long else [1, 2, 3]
     try:
         last = None
         for it in router.stream("/v1/models/lm/generate",
-                                {"prompt": [1, 2, 3], "max_tokens": 8,
+                                {"prompt": prompt, "max_tokens": 8,
                                  "seed": 0, "priority": "interactive",
                                  "timeout_ms": GEN_DEADLINE_MS}):
             if "token" not in it:
@@ -1562,12 +1661,15 @@ def do_generate(leg, t_arr):
             leg["other"] += 1
             return
         leg["ok"] += 1
-        leg["ttft_ms"].append((t_first - t_arr) * 1e3)
+        key = "ttft_long_ms" if long else "ttft_ms"
+        leg[key].append((t_first - t_arr) * 1e3)
         leg["itl_ms"].extend(gaps)
 
 def issue(leg, kind, prio, t_arr):
     if kind == "gen":
         do_generate(leg, t_arr)
+    elif kind == "genlong":
+        do_generate(leg, t_arr, long=True)
     else:
         dl = SLO_MS if prio == "interactive" else BATCH_DEADLINE_MS
         do_predict(leg, prio, dl, t_arr)
@@ -1592,6 +1694,10 @@ workers = [threading.Thread(target=worker, daemon=True)
 for w in workers: w.start()
 
 def traffic_mix(i):
+    # generation arrivals at multiples of 8; every other one carries
+    # the long-context prompt (ISSUE 16) — a 50/50 short/long gen mix
+    if i % 16 == 8:
+        return "genlong", "interactive"
     kind = "gen" if i % 8 == 0 else "predict"
     prio = "batch" if (kind == "predict" and i % 10 < 3) \
         else "interactive"
@@ -1750,6 +1856,13 @@ print(json.dumps({
     "overload_ttft_ms_p99": round(ttft_p99, 2),
     "overload_itl_ms_p50": round(pct(o["itl_ms"], 50), 2),
     "overload_itl_ms_p99": round(pct(o["itl_ms"], 99), 2),
+    "normal_longctx_ttft_ms_p99": round(
+        pct(normal["ttft_long_ms"], 99), 2),
+    "overload_longctx_completed": len(o["ttft_long_ms"]),
+    "overload_longctx_ttft_ms_p50": round(
+        pct(o["ttft_long_ms"], 50), 2),
+    "overload_longctx_ttft_ms_p99": round(
+        pct(o["ttft_long_ms"], 99), 2),
     "overload_queue_depth_max": max_depth[0],
     # STRICT bound: deadline-aware admission must cap the queue below
     # its raw capacity (growth stops at ~deadline/service-time rows,
@@ -2318,6 +2431,10 @@ def main():
                                    "overload_ttft_ms_p99",
                                    "overload_itl_ms_p50",
                                    "overload_itl_ms_p99",
+                                   "normal_longctx_ttft_ms_p99",
+                                   "overload_longctx_completed",
+                                   "overload_longctx_ttft_ms_p50",
+                                   "overload_longctx_ttft_ms_p99",
                                    "overload_queue_depth_max",
                                    "overload_queue_bounded",
                                    "fleet_sheds_observed",
@@ -2333,7 +2450,7 @@ def main():
                                   if k in ovl}
         # continuous-batching generation vs sequential per-request
         # decode (CPU-JAX by design — the acceptance regime)
-        gen = _run(GENERATION_CODE, _CPU_ENV, timeout=900)
+        gen = _run(GENERATION_CODE, _CPU_ENV, timeout=1500)
         if gen:
             extras["generation"] = {k: gen[k] for k in
                                     ("model", "tokens_per_sec",
@@ -2413,7 +2530,23 @@ def main():
                                      "kv_int8_concurrent_users_vs_f32",
                                      "kv_bf16_logit_rel_err",
                                      "kv_int8_logit_rel_err",
-                                     "kv_quant_recompiles_post_warmup")
+                                     "kv_quant_recompiles_post_warmup",
+                                     "offload_live_sessions",
+                                     "offload_pool_sessions",
+                                     "offload_sessions_per_pool_ratio",
+                                     "offload_evicted_reprefills",
+                                     "offload_demotions",
+                                     "offload_restores",
+                                     "offload_prefetch_hits",
+                                     "offload_restore_ttft_ms_p50",
+                                     "offload_hot_ttft_ms_p50",
+                                     "offload_restore_ttft_ratio",
+                                     "offload_tokens_identical",
+                                     "offload_recompiles_post_warmup",
+                                     "offload_restore_ms_p50",
+                                     "offload_f32_host_bytes_per_block",
+                                     "offload_int8_host_bytes_per_block",
+                                     "offload_int8_capacity_vs_f32")
                                     if k in gen}
         # resilient-training chaos probe: supervised step loop absorbing
         # ~1% transient step faults + one scripted preemption/resume

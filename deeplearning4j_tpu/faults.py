@@ -25,6 +25,13 @@ Serving seams (PR 4; fired by the engines in :mod:`.serving`):
 - ``verify``        — immediately before a speculative verification
   call against the target cache (donated — corrupting fires force
   recompute-recovery, same blast radius as ``device_step``)
+- ``offload_io``    — immediately before a KV-tier demotion
+  (device→host block-run copy) or restore (host→device) touches any
+  engine state (PR 16, :mod:`.serving.offload`). A fire models torn
+  tier IO: the engine drops the host copy and falls back to plain
+  discard (demotion) or clean re-prefill (restore) — a failed tier
+  copy never corrupts a lane. Combine with ``slow_ms`` to model a
+  slow host/disk tier instead of a broken one.
 
 Training seams (this PR; fired by
 :class:`~.parallel.elastic.FaultTolerantTrainer`'s supervised loop):
@@ -76,8 +83,8 @@ import numpy as np
 #: configuration typo and fails loudly at construction rather than
 #: silently never firing
 SEAMS = ("device_step", "prefill", "alloc", "client_disconnect",
-         "latency", "draft", "verify", "train_step", "data_batch",
-         "checkpoint_io", "preempt")
+         "latency", "draft", "verify", "offload_io", "train_step",
+         "data_batch", "checkpoint_io", "preempt")
 
 
 class FaultError(RuntimeError):

@@ -185,6 +185,71 @@ def kv_copy_row(cache, src, dst):
     return cache.at[dst].set(cache[src])
 
 
+# ------------------------------------------- block-run gather / scatter
+#
+# The hierarchical KV tier (PR 16, serving/offload.py) moves RUNS of
+# pool rows between device and host. Device-side movement is two tiny
+# pure fns — gather rows out (demotion, pools NOT donated) and scatter
+# rows back in (restore, pools donated) — compiled once per pow2 idx
+# bucket through the engine's compile_memoized path, exactly like the
+# COW copy. Host-side, a run becomes contiguous numpy copies (int8
+# values + f32 scale sidecars for quantized pools) so the byte budget
+# and the disk ring see plain buffers.
+
+def kv_gather_rows(cache, idx):
+    """Gather leading-axis rows ``idx`` out of a pool (demotion read).
+    For int8 pools the scale rows ride along — a demoted run is always
+    (values, scales) at pool dtype, never a dequantized f32 blow-up."""
+    if is_quantized(cache):
+        return QuantArray(jnp.take(cache.q, idx, axis=0),
+                          jnp.take(cache.scale, idx, axis=0))
+    return jnp.take(cache, idx, axis=0)
+
+
+def kv_scatter_rows(cache, rows, idx):
+    """Scatter ``rows`` (as produced by :func:`kv_gather_rows`) back
+    into pool rows ``idx`` (restore write). Padded idx entries may
+    repeat a junk destination (the engine points them at NULL_BLOCK);
+    ``.at[].set`` keeps that well-defined — last write wins and the
+    null block is never read."""
+    if is_quantized(cache):
+        return QuantArray(cache.q.at[idx].set(rows.q),
+                          cache.scale.at[idx].set(rows.scale))
+    return cache.at[idx].set(rows)
+
+
+def kv_pack_host(rows, n: int):
+    """Materialize the first ``n`` gathered rows as contiguous HOST
+    numpy arrays: ``(values,)`` for plain pools, ``(q, scale)`` for
+    int8. ``np.asarray`` forces the device→host transfer AND the sync,
+    so once this returns the source pool rows may be freed/reused."""
+    if is_quantized(rows):
+        return (np.ascontiguousarray(np.asarray(rows.q)[:n]),
+                np.ascontiguousarray(np.asarray(rows.scale)[:n]))
+    return (np.ascontiguousarray(np.asarray(rows)[:n]),)
+
+
+def kv_unpack_host(parts, bucket: int):
+    """Rebuild scatter operands from :func:`kv_pack_host` output,
+    zero-padded up to ``bucket`` rows so every restore of the same
+    bucket reuses one compiled scatter executable (runtime operands
+    only — the zero-recompile contract)."""
+    vals = parts[0]
+    n = vals.shape[0]
+    pad = [(0, bucket - n)] + [(0, 0)] * (vals.ndim - 1)
+    padded = np.pad(vals, pad)
+    if len(parts) == 2:
+        scale = np.pad(parts[1],
+                       [(0, bucket - n)] + [(0, 0)] * (parts[1].ndim - 1))
+        return QuantArray(jnp.asarray(padded), jnp.asarray(scale))
+    return jnp.asarray(padded)
+
+
+def kv_host_nbytes(parts) -> int:
+    """Host bytes one packed run occupies (budget accounting)."""
+    return int(sum(p.nbytes for p in parts))
+
+
 # ---------------------------------------------------------------- reads
 
 def kv_dequant_f32(cache) -> jnp.ndarray:

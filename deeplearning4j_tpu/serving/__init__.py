@@ -134,6 +134,7 @@ from .generation import GenerationEngine
 from .kvcache import KVCache, SlotTable
 from .metrics import (GenerationMetrics, ServingMetrics,
                       profiler_sections, prometheus_text)
+from .offload import DiskRing, HostBlockStore, HostRun
 from .paging import BlockAllocator, BlockTable, PagedKVCache
 from .registry import (ModelNotFound, ModelRegistry, ServedGenerator,
                        ServedModel)
@@ -143,6 +144,7 @@ __all__ = [
     "ModelNotFound", "ServedModel", "ServedGenerator", "GenerationEngine",
     "GenerationMetrics", "KVCache", "SlotTable", "PagedKVCache",
     "BlockAllocator", "BlockTable", "ServingMetrics",
+    "HostBlockStore", "HostRun", "DiskRing",
     "ClientError", "ServingError", "QueueFullError",
     "DeadlineExceededError", "DrainingError", "FaultInjector",
     "TransientFault", "CorruptedStateFault", "PoisonRequestError",

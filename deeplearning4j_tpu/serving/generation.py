@@ -58,12 +58,16 @@ from .engine import ClientError, ServingError, compile_memoized
 from .faults import (CorruptedStateFault, PoisonRequestError,
                      TransientFault, poll_until_idle)
 from ..kernels.kv_quant import (canonical_kv_dtype, kv_bytes_per_token,
-                                kv_copy_row, kv_update_slice)
+                                kv_copy_row, kv_pack_host,
+                                kv_unpack_host, kv_update_slice,
+                                kv_zeros)
 from .kvcache import KVCache, SlotTable
 from .metrics import GenerationMetrics
+from .offload import (DiskRing, HostBlockStore, HostRun,
+                      OffloadPrefetcher)
 from .paging import (NULL_BLOCK, BlockAllocator, BlockTable, PagedKVCache,
                      PrefixIndex, SessionStore, blocks_for, chain_hashes,
-                     pow2_bucket)
+                     export_block_run, import_block_run, pow2_bucket)
 from .speculative import (make_prime_fn, make_propose_fn,
                           make_verify_paged_fn, make_verify_slots_fn,
                           verify_bucket)
@@ -396,7 +400,11 @@ class GenerationEngine:
                  speculation_k: int = 0,
                  draft_model=None,
                  decode_pipeline: bool = True,
-                 kv_dtype: str = "f32"):
+                 kv_dtype: str = "f32",
+                 offload_host_bytes: int = 0,
+                 offload_disk_bytes: int = 0,
+                 offload_dir: Optional[str] = None,
+                 offload_prefetch: bool = True):
         if getattr(model, "_params", None) is None:
             model.init()
         self.model = model
@@ -514,6 +522,44 @@ class GenerationEngine:
         else:
             self.prefill_chunk_tokens = None
             self.enable_prefix_sharing = False
+        # -- hierarchical KV tier (PR 16; serving/offload.py) --------
+        # offload_host_bytes > 0 turns demote-on-evict on: evicted
+        # session/prefix pins copy device->host (at kv_dtype, scale
+        # sidecars included) instead of being discarded, and a
+        # returning session RESTORES host->device instead of
+        # re-prefilling. offload_disk_bytes adds a mmap'd ring file
+        # as a third tier below host RAM.
+        self.offload_host_bytes = int(offload_host_bytes)
+        self._offload: Optional[HostBlockStore] = None
+        self._offload_prefetcher: Optional[OffloadPrefetcher] = None
+        self._off_buckets: List[int] = []
+        if self.offload_host_bytes > 0:
+            if self.cache_backend != "paged":
+                raise ValueError("offload_host_bytes requires the "
+                                 "paged cache backend (cache='paged')")
+            if not self.enable_prefix_sharing:
+                raise ValueError(
+                    "offload_host_bytes requires prefix sharing "
+                    "(enable_prefix_sharing=True): restores re-enter "
+                    "the engine through session/prefix matching")
+            disk = None
+            if int(offload_disk_bytes) > 0:
+                import os as _os
+                path = (_os.path.join(offload_dir, "kv_ring.bin")
+                        if offload_dir else None)
+                disk = DiskRing(int(offload_disk_bytes), path=path)
+            self._offload = HostBlockStore(self.offload_host_bytes,
+                                           disk=disk)
+            # demoted runs span 1..blocks_for(max_seq_len) blocks;
+            # pow2-bucketing the gather/scatter index keeps the
+            # executable set finite and AOT-warmable (the same rule
+            # the block tables use)
+            top = pow2_bucket(self._blocks_per_seq)
+            self._off_buckets = [b for b in self._tbl_buckets
+                                 if b <= top]
+            if offload_prefetch:
+                self._offload_prefetcher = OffloadPrefetcher(
+                    self._stage_restore)
         self.metrics = metrics or GenerationMetrics()
         self.metrics.queue_max = int(max_queue)
         self.metrics.num_slots = self.num_slots
@@ -566,6 +612,7 @@ class GenerationEngine:
             self.metrics.block_size = self.block_size
             self.metrics.blocks_total = self._allocator.capacity
             self.metrics.prefix_sharing = self.enable_prefix_sharing
+            self.metrics.offload_enabled = self._offload is not None
             self._update_block_gauges()
         self._profiler = OpProfiler.get_instance()
         # exactly two executable kinds: decode (one) + prefill (per
@@ -574,6 +621,10 @@ class GenerationEngine:
         self._decode_exe = None
         self._prefill_exe: Dict[int, Any] = {}
         self._cow_exe = None  # paged + sharing: block device-copy
+        # hierarchical KV tier: block-run gather (demote) / scatter
+        # (restore) executables, one per pow2 idx bucket
+        self._offload_save_exe: Dict[int, Any] = {}
+        self._offload_load_exe: Dict[int, Any] = {}
         # speculative executables: one draft-propose, draft-prime per
         # prime bucket, verify per table bucket (paged) or one (slots)
         self._draft_exe = None
@@ -713,6 +764,17 @@ class GenerationEngine:
         self.metrics.shared_blocks = a.shared_count
         self.metrics.prefix_blocks = len(self._prefix_index)
         self.metrics.sessions_live = len(self._sessions)
+        off = self._offload
+        if off is not None:
+            s = off.stats()
+            m = self.metrics
+            m.offload_host_runs = s["host_runs"]
+            m.offload_host_blocks = s["host_blocks"]
+            m.offload_host_bytes = s["host_bytes"]
+            m.offload_disk_blocks = s["disk_blocks"]
+            m.offload_disk_bytes = s["disk_bytes"]
+            m.offload_spills = s["spills"]
+            m.offload_drops = s["drops"]
 
     # -- executables ---------------------------------------------------
     # Every executable also returns a FINITE-LOGITS flag computed
@@ -909,6 +971,267 @@ class GenerationEngine:
                 self._kcs, self._vcs, np.int32(src), np.int32(dst))
             jax.block_until_ready(self._kcs[0])  # surface device faults
 
+    # -- hierarchical KV tier (PR 16; serving/offload.py) --------------
+    # Demotion gathers a block run device->host; restore scatters it
+    # back. Both are one executable per pow2 idx bucket, compiled
+    # through the same memoized path as the COW copy — the idx array
+    # and row operands are RUNTIME values, so after warmup() no
+    # offload traffic can ever recompile.
+    def _get_offload_save_exe(self, bucket: int):
+        """Block-run gather executable (demotion read). Pools are NOT
+        donated: a failed demotion must leave the device tier exactly
+        as it was, so the engine can fall back to plain discard."""
+        exe = self._offload_save_exe.get(bucket)
+        if exe is not None:
+            return exe
+        with self._exe_lock:
+            exe = self._offload_save_exe.get(bucket)
+            if exe is not None:
+                return exe
+            args = (self._kcs, self._vcs,
+                    np.full(bucket, NULL_BLOCK, np.int32))
+            with self._profiler.record("generation.compile"):
+                exe = compile_memoized(export_block_run, args, ())
+            self.metrics.inc("compiles")
+            self._offload_save_exe[bucket] = exe
+            return exe
+
+    def _get_offload_load_exe(self, bucket: int):
+        """Block-run scatter executable (restore write). Pools ARE
+        donated (the restore writes in place); padded idx rows point
+        at the null block. A real failure here donated the pools away
+        — the caller maps it to recompute-recovery, exactly like a
+        failed prefill."""
+        exe = self._offload_load_exe.get(bucket)
+        if exe is not None:
+            return exe
+        with self._exe_lock:
+            exe = self._offload_load_exe.get(bucket)
+            if exe is not None:
+                return exe
+            rows_k = [kv_zeros((bucket,) + s, self.kv_dtype)
+                      for s in self._cache.layer_shapes]
+            rows_v = [kv_zeros((bucket,) + s, self.kv_dtype)
+                      for s in self._cache.layer_shapes]
+            args = (self._kcs, self._vcs, rows_k, rows_v,
+                    np.full(bucket, NULL_BLOCK, np.int32))
+            with self._profiler.record("generation.compile"):
+                exe = compile_memoized(import_block_run, args, (0, 1))
+            self.metrics.inc("compiles")
+            self._offload_load_exe[bucket] = exe
+            return exe
+
+    def _export_run(self, tokens: np.ndarray,
+                    blocks: List[int]) -> HostRun:
+        """Device half of a demotion: gather the run's pool rows (all
+        layers, K+V) and pack them into contiguous host arrays at the
+        pool dtype. kv_pack_host's np.asarray forces the device->host
+        sync, so on return the source blocks may be freed."""
+        bucket = pow2_bucket(len(blocks))
+        idx = np.full(bucket, NULL_BLOCK, np.int32)
+        idx[:len(blocks)] = blocks
+        with self._profiler.record("generation.offload_demote"):
+            k_rows, v_rows = self._get_offload_save_exe(bucket)(
+                self._kcs, self._vcs, idx)
+            ks = [kv_pack_host(r, len(blocks)) for r in k_rows]
+            vs = [kv_pack_host(r, len(blocks)) for r in v_rows]
+        return HostRun(tokens, ks, vs, self.kv_dtype)
+
+    def _build_restore_ops(self, run: HostRun, bucket: int):
+        """Zero-pad a HostRun's packed layers up to ``bucket`` rows —
+        the scatter executable's operands. Pure host/h2d work: this is
+        the half a prefetch overlaps with admission."""
+        return ([kv_unpack_host(layer, bucket) for layer in run.ks],
+                [kv_unpack_host(layer, bucket) for layer in run.vs])
+
+    def _import_run(self, run: HostRun, blocks: List[int], ops=None):
+        """Device half of a restore: scatter the packed run into the
+        freshly-allocated ``blocks``. Raises whatever the device call
+        raises — the pools were donated, so the CALLER maps failures
+        to recompute-recovery."""
+        bucket = pow2_bucket(len(blocks))
+        idx = np.full(bucket, NULL_BLOCK, np.int32)
+        idx[:len(blocks)] = blocks
+        if ops is None:
+            ops = self._build_restore_ops(run, bucket)
+        k_rows, v_rows = ops
+        with self._profiler.record("generation.offload_restore"):
+            self._kcs, self._vcs = self._get_offload_load_exe(bucket)(
+                self._kcs, self._vcs, k_rows, v_rows, idx)
+            jax.block_until_ready(self._kcs[0])  # surface device faults
+
+    def _demote_session(self, sess) -> bool:
+        """Copy an evicted session's block run to the host tier (the
+        caller still frees the device blocks — ownership of the BYTES
+        moves down a tier, ownership of the BLOCKS ends). Any failure
+        — the offload_io seam or a real gather error — degrades to the
+        old discard path: the gather never donates, so the device tier
+        is untouched and dropping the copy is always safe."""
+        off = self._offload
+        sid = sess.session_id
+        if off is None or sid is None:
+            return False
+        t0 = time.perf_counter()
+        try:
+            self._hit("offload_io")
+            run = self._export_run(sess.tokens, sess.blocks)
+        except Exception:  # noqa: BLE001 — torn demotion -> discard
+            self.metrics.inc("offload_demote_failures")
+            return False
+        off.put(sid, run)
+        self.metrics.inc("offload_demotions")
+        self.metrics.offload_demote_ms.record(
+            (time.perf_counter() - t0) * 1e3)
+        return True
+
+    def _demote_prefix(self, digest: bytes, block: int) -> bool:
+        """Demote one evicted prefix-index block, keyed by its chained
+        digest — a future admission whose prompt hashes to the same
+        chain restores it instead of re-prefilling the block."""
+        off = self._offload
+        if off is None:
+            return False
+        try:
+            self._hit("offload_io")
+            run = self._export_run(np.zeros(0, np.int32), [block])
+        except Exception:  # noqa: BLE001 — torn demotion -> discard
+            self.metrics.inc("offload_demote_failures")
+            return False
+        off.put("px:" + digest.hex(), run)
+        self.metrics.inc("offload_demotions")
+        return True
+
+    def _stage_restore(self, key: str):
+        """Prefetch-thread staging: read the run (RAM or disk) and
+        build the padded scatter operands. HOST + h2d work only — the
+        allocator and every pool-mutating device call stay on the
+        scheduler thread, so staging can never race engine state."""
+        off = self._offload
+        if off is None:
+            return None
+        run = off.get(key)
+        if run is None:
+            return None
+        bucket = pow2_bucket(run.n_blocks)
+        return run, self._build_restore_ops(run, bucket)
+
+    def _offload_restore(self, req: _GenRequest) -> bool:
+        """The restore-vs-reprefill decision for one admission: if the
+        request's session was demoted, scatter its run back into
+        freshly-allocated blocks and re-pin it — ``_match_prefix`` then
+        finds a normal session hit and the turn pays only its suffix
+        prefill (a restore is a planned cache miss, never a
+        re-prefill). Falls back to the plain path (full prefill) on:
+        no host copy, token mismatch, pool too full even after
+        eviction, or a torn restore (offload_io seam). Only a REAL
+        scatter failure escapes — as CorruptedStateFault, because the
+        pools were donated to the scatter call."""
+        off = self._offload
+        if off is None or req.tokens or req.session_id is None:
+            return False
+        sid = req.session_id
+        if sid in self._sessions:
+            return False  # device pin is current; host copy is stale
+        staged = None
+        pf = self._offload_prefetcher
+        if pf is not None:
+            staged = pf.take(sid)
+        run = ops = None
+        if staged is not None:
+            run, ops = staged
+            if off.peek(sid) is not run:
+                # the session was re-demoted (or popped) after staging
+                # — the staged operands describe stale bytes
+                run = ops = None
+        if run is None:
+            run = off.get(sid)
+            if run is None:
+                return False
+        # token-granular usefulness check, same rule as _match_prefix's
+        # session branch: the stored turn must prefix-match the prompt
+        prompt = req.prompt
+        stored = run.tokens
+        n = min(len(stored), len(prompt) - 1)
+        neq = stored[:n] != prompt[:n]
+        m = int(np.argmax(neq)) if neq.any() else n
+        if m <= 0:
+            return False
+        try:
+            self._hit("offload_io")
+        except (TransientFault, CorruptedStateFault):
+            # torn restore: invalidate the host copy and re-prefill —
+            # the lane never saw a device call, nothing to corrupt
+            off.pop(sid)
+            if pf is not None:
+                pf.discard(sid)
+            self.metrics.inc("offload_restore_failures")
+            return False
+        blocks = self._alloc_with_eviction(run.n_blocks)
+        if blocks is None:
+            return False  # pool cannot hold the run; re-prefill
+        t0 = time.perf_counter()
+        try:
+            self._import_run(run, blocks, ops)
+        except Exception as e:  # noqa: BLE001 — pools donated
+            raise CorruptedStateFault(
+                f"offload restore device call failed: {e!r}")
+        displaced = self._sessions.put(sid, run.tokens, list(blocks))
+        evictions = 0
+        for old in displaced:
+            if old.session_id != sid:
+                self._demote_session(old)
+                evictions += 1
+            self._allocator.free(old.blocks)
+        if evictions:
+            self.metrics.inc("session_evictions", evictions)
+        off.pop(sid)
+        self.metrics.inc("offload_restores")
+        if ops is not None:
+            self.metrics.inc("offload_prefetch_hits")
+        self.metrics.offload_restore_ms.record(
+            (time.perf_counter() - t0) * 1e3)
+        if req.trace is not None:
+            req.trace.span("offload_restore", tokens=len(stored),
+                           blocks=run.n_blocks,
+                           prefetched=ops is not None).end()
+        return True
+
+    def _restore_prefix_blocks(self, req: _GenRequest):
+        """Restore demoted PREFIX blocks the prompt's chain hashes
+        to. Runs before ``_match_prefix`` so restored entries are
+        matched by the normal index path; stops at the first digest
+        found in neither the index nor the host tier (the chain is
+        broken there — later blocks cannot be used anyway)."""
+        off = self._offload
+        if off is None or req.tokens or not self.enable_prefix_sharing:
+            return
+        if req.session_id is not None and req.session_id in self._sessions:
+            return  # the session pin already covers the prefix
+        for h in chain_hashes(req.prompt, self.block_size):
+            if self._prefix_index.match([h]):
+                continue
+            key = "px:" + h.hex()
+            run = off.get(key)
+            if run is None:
+                return
+            try:
+                self._hit("offload_io")
+            except (TransientFault, CorruptedStateFault):
+                off.pop(key)
+                self.metrics.inc("offload_restore_failures")
+                return
+            blocks = self._alloc_with_eviction(1)
+            if blocks is None:
+                return
+            try:
+                self._import_run(run, blocks)
+            except Exception as e:  # noqa: BLE001 — pools donated
+                raise CorruptedStateFault(
+                    f"offload prefix restore device call failed: {e!r}")
+            self._prefix_index.register(h, blocks[0])
+            off.pop(key)
+            self.metrics.inc("offload_restores")
+
     def _get_prefill_exe(self, bucket: int):
         exe = self._prefill_exe.get(bucket)
         if exe is not None:
@@ -1034,6 +1357,12 @@ class GenerationEngine:
         if self.cache_backend == "paged":
             if self.enable_prefix_sharing:
                 self._get_cow_exe()
+            if self._offload is not None:
+                # one gather + one scatter executable per pow2 run
+                # bucket: warmed here, offload traffic never compiles
+                for b in self._off_buckets:
+                    self._get_offload_save_exe(b)
+                    self._get_offload_load_exe(b)
             for c in sorted(set(int(x) for x in (buckets
                                                  or self.chunk_buckets))):
                 if c not in self.chunk_buckets:
@@ -1304,6 +1633,18 @@ class GenerationEngine:
                     decode_ewma_ms=round(self._decode_ewma_ms, 3)).end()
                 req.qspan = trace.span("queue",
                                        priority=req.priority)
+            if (self._offload is not None
+                    and self._offload_prefetcher is not None
+                    and req.session_id is not None
+                    and req.session_id not in self._sessions
+                    and req.session_id in self._offload):
+                # async prefetch: start staging the demoted run (disk
+                # read + padded operand build + h2d) NOW, so it
+                # overlaps this request's queue wait — the scheduler
+                # takes the staged operands at admission and pays only
+                # the scatter. Staleness is re-checked at take time,
+                # so a racy glance at the session store here is safe.
+                self._offload_prefetcher.request(req.session_id)
             self._enqueue(req)
             return req
         except (ClientError, QueueFullError, DeadlineExceededError) as e:
@@ -1670,14 +2011,23 @@ class GenerationEngine:
         """Release ONE cache pin under block pressure: the LRU prefix-
         index entry first (one block, finest granularity), then the
         LRU session. False when nothing is evictable — every block is
-        held by in-flight work."""
-        b = self._prefix_index.evict_lru()
-        if b is not None:
+        held by in-flight work.
+
+        With the hierarchical KV tier enabled, eviction DEMOTES
+        instead of discarding: the pin's block run copies device->host
+        before its blocks are freed, so the state is a planned cache
+        miss (restorable) rather than gone. A torn demotion degrades
+        to the old discard — the free below runs either way."""
+        ent = self._prefix_index.evict_lru_entry()
+        if ent is not None:
+            digest, b = ent
+            self._demote_prefix(digest, b)
             self._allocator.free([b])
             self.metrics.inc("prefix_evictions")
             return True
         sess = self._sessions.evict_lru()
         if sess is not None:
+            self._demote_session(sess)
             self._allocator.free(sess.blocks)
             self.metrics.inc("session_evictions")
             return True
@@ -1750,6 +2100,22 @@ class GenerationEngine:
                 # retry (or recovery) re-admits it, in order
                 self._requeue.appendleft(req)
                 raise
+            if self._offload is not None:
+                # restore-vs-reprefill decision: a demoted session (or
+                # demoted prefix blocks) scatters back into the pool
+                # BEFORE matching, so _match_prefix sees a normal hit.
+                # Torn restores were already degraded to re-prefill
+                # inside; only a real device failure escapes (pools
+                # donated to the scatter) -> recompute-recovery, with
+                # the request re-admitted in order like any other
+                # corrupting admission fault
+                try:
+                    self._offload_restore(req)
+                    self._restore_prefix_blocks(req)
+                except CorruptedStateFault:
+                    self._requeue.appendleft(req)
+                    raise
+                self._update_block_gauges()
             match_len, shared, cow_src, source = self._match_prefix(req)
             pinned = shared + ([cow_src] if cow_src is not None else [])
             if pinned:
@@ -1982,13 +2348,27 @@ class GenerationEngine:
         kept, trailing = table.blocks[:keep], table.blocks[keep:]
         if trailing:
             self._allocator.free(trailing)
-        replaced = req.session_id in self._sessions
         displaced = self._sessions.put(req.session_id, seq, kept)
+        evictions = 0
         for sess in displaced:
-            self._allocator.free(sess.blocks)
-        evictions = len(displaced) - (1 if replaced else 0)
+            if sess.session_id == req.session_id:
+                # the same session's superseded pin: the new pin is
+                # the truth, nothing to demote
+                self._allocator.free(sess.blocks)
+            else:
+                # LRU displacement: demote to the host tier (or
+                # discard if demotion tears), then free
+                self._demote_session(sess)
+                self._allocator.free(sess.blocks)
+                evictions += 1
         if evictions:
             self.metrics.inc("session_evictions", evictions)
+        if self._offload is not None:
+            # the freshly-pinned device copy supersedes any demoted
+            # one — a stale host run must never be restored over it
+            self._offload.pop(req.session_id)
+            if self._offload_prefetcher is not None:
+                self._offload_prefetcher.discard(req.session_id)
         self._slots.free(slot)
         self._slot_blocks[slot] = None
         self._tables[slot] = NULL_BLOCK
@@ -2017,6 +2397,10 @@ class GenerationEngine:
             self._slot_blocks = [None] * self.num_slots
             self._prefix_index.clear()
             self._sessions.clear()
+            # the HOST tier deliberately survives: demoted runs are
+            # host numpy, independent of the donated-away device
+            # pools, so previously-demoted sessions stay restorable
+            # after the rebuild
             self._update_block_gauges()
         self._cache = self._fresh_cache()
         self._kcs = self._cache.ks
@@ -2060,7 +2444,10 @@ class GenerationEngine:
             # cached prefixes and session pins died with the pools:
             # drop the bookkeeping (no frees — the allocator is new)
             # so post-recovery admissions rebuild refcounts from zero
-            # instead of matching blocks whose K/V no longer exists
+            # instead of matching blocks whose K/V no longer exists.
+            # The HOST tier survives on purpose — demoted runs are
+            # host numpy, untouched by device donation, so sessions
+            # demoted BEFORE the fault still restore afterwards
             self._prefix_index.clear()
             self._sessions.clear()
         self._cache = self._fresh_cache()
@@ -2753,6 +3140,38 @@ class GenerationEngine:
         self._update_block_gauges()
         return len(sessions)
 
+    def offload_sessions(self) -> int:
+        """Demote EVERY session pin to the host tier (freeing its
+        device blocks), returning how many demoted cleanly. The bulk
+        version of demote-on-evict — admin maintenance before a
+        planned restart, or tests forcing the cold path. Same
+        idle-engine-only contract as :meth:`evict_sessions`."""
+        if self.cache_backend != "paged" or self._offload is None:
+            return 0
+        sessions = self._sessions.clear()
+        demoted = 0
+        for sess in sessions:
+            if self._demote_session(sess):
+                demoted += 1
+            self._allocator.free(sess.blocks)
+        if sessions:
+            self.metrics.inc("session_evictions", len(sessions))
+        self._update_block_gauges()
+        return demoted
+
+    def clear_offload(self) -> int:
+        """Drop every demoted run from the host AND disk tiers,
+        returning how many runs were discarded. Sessions fall back to
+        re-prefill on their next turn — correctness is unaffected,
+        only the planned-miss optimization is reset."""
+        off = self._offload
+        if off is None:
+            return 0
+        n = len(off.keys())
+        off.clear()
+        self._update_block_gauges()
+        return n
+
     def clear_prefix_cache(self) -> int:
         """Release every prefix-index pin, returning how many blocks
         were unpinned. Same idle-engine-only contract as
@@ -2827,3 +3246,10 @@ class GenerationEngine:
         self._running = False
         self._wake.set()  # unpark an idle scheduler immediately
         self._thread.join(timeout=timeout_s)
+        if self._offload_prefetcher is not None:
+            self._offload_prefetcher.stop()
+        if self._offload is not None:
+            # drops the host entries and unlinks the disk ring's
+            # tempfile; runs after the scheduler join so no demote/
+            # restore can still be writing into the store
+            self._offload.close()

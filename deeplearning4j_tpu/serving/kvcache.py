@@ -38,7 +38,8 @@ from typing import List, Optional, Sequence, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from ..kernels.kv_quant import canonical_kv_dtype, kv_nbytes, kv_zeros
+from ..kernels.kv_quant import (canonical_kv_dtype, kv_bytes_per_token,
+                                kv_nbytes, kv_zeros)
 
 
 class KVCache:
@@ -76,6 +77,13 @@ class KVCache:
             return 0
         return int(sum(2 * int(np.prod((self.num_slots,) + s[:-1])) * 4
                        for s in self.layer_shapes))
+
+    def bytes_per_token(self) -> int:
+        """K+V bytes one token position costs across all layers at the
+        cache dtype — the sizing unit shared by the slot cache, the
+        paged pool, and the host/disk tier below it
+        (docs/generation.md "Hierarchical KV tier")."""
+        return kv_bytes_per_token(self.layer_shapes, self.kv_dtype)
 
 
 class SlotTable:

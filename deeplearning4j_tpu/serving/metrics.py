@@ -185,6 +185,27 @@ class GenerationMetrics:
         self.shared_blocks = 0         # gauge: blocks with refcount > 1
         self.prefix_blocks = 0         # gauge: blocks the index pins
         self.sessions_live = 0         # gauge
+        # hierarchical KV tier (PR 16; serving/offload.py): demote-on-
+        # evict to host RAM (+ optional disk ring), restore-on-resume.
+        # All zero unless offload_host_bytes > 0
+        self.offload_enabled = False   # config flag
+        self.offload_demotions = 0     # device->host block-run copies
+        self.offload_restores = 0      # host->device restores (each one
+        #                                is a re-prefill avoided)
+        self.offload_prefetch_hits = 0  # restores served from staged
+        #                                 prefetch (overlapped IO)
+        self.offload_demote_failures = 0   # torn demotions -> discard
+        self.offload_restore_failures = 0  # torn restores -> re-prefill
+        self.offload_spills = 0        # gauge: RAM -> disk-ring spills
+        self.offload_drops = 0         # gauge: runs lost off the bottom
+        self.offload_host_runs = 0     # gauge: runs in host RAM
+        self.offload_host_blocks = 0   # gauge: blocks in host RAM
+        self.offload_host_bytes = 0    # gauge
+        self.offload_disk_blocks = 0   # gauge: blocks in the disk ring
+        self.offload_disk_bytes = 0    # gauge
+        self.offload_restore_ms = Reservoir(latency_window)  # host->
+        #                              device restore wall time
+        self.offload_demote_ms = Reservoir(latency_window)
         # speculative decoding (serving/speculative.py; both backends;
         # all zero with speculation_k=0)
         self.speculation_k = 0            # config knob (0 = off)
@@ -242,6 +263,27 @@ class GenerationMetrics:
                     "shared_blocks": self.shared_blocks,
                     "prefix_blocks": self.prefix_blocks,
                     "sessions_live": self.sessions_live,
+                },
+                "offload": {
+                    "enabled": self.offload_enabled,
+                    "demotions": self.offload_demotions,
+                    "restores": self.offload_restores,
+                    "prefetch_hits": self.offload_prefetch_hits,
+                    "demote_failures": self.offload_demote_failures,
+                    "restore_failures": self.offload_restore_failures,
+                    "spills": self.offload_spills,
+                    "drops": self.offload_drops,
+                    "host_runs": self.offload_host_runs,
+                    "host_blocks": self.offload_host_blocks,
+                    "host_bytes": self.offload_host_bytes,
+                    "disk_blocks": self.offload_disk_blocks,
+                    "disk_bytes": self.offload_disk_bytes,
+                    "restore_ms": {
+                        k: round(v, 3) for k, v in
+                        self.offload_restore_ms.snapshot().items()},
+                    "demote_ms": {
+                        k: round(v, 3) for k, v in
+                        self.offload_demote_ms.snapshot().items()},
                 },
             }
         return {
@@ -349,6 +391,9 @@ _PROM_COUNTERS = frozenset({
     # are matched here)
     "draft_tokens_proposed", "draft_tokens_accepted", "verify_batches",
     "rollbacks", "draft_fallbacks",
+    # hierarchical KV tier (the `paged.offload` snapshot block)
+    "demotions", "restores", "prefetch_hits", "demote_failures",
+    "restore_failures",
     "compiles", "hits", "misses", "evictions",
     "client_disconnects",
     # fleet-side counters

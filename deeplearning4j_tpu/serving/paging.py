@@ -57,7 +57,9 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from ..kernels.kv_quant import canonical_kv_dtype, kv_nbytes, kv_zeros
+from ..kernels.kv_quant import (canonical_kv_dtype, kv_bytes_per_token,
+                                kv_gather_rows, kv_nbytes,
+                                kv_scatter_rows, kv_zeros)
 
 #: Block index reserved as the write/read target for padded table
 #: entries. Never handed out by the allocator.
@@ -269,6 +271,34 @@ class PagedKVCache:
         return int(sum(2 * int(np.prod((self.num_blocks,) + s[:-1]))
                        * 4 for s in self.layer_shapes))
 
+    def bytes_per_token(self) -> int:
+        """K+V bytes one token position costs across all layers at the
+        pool dtype — the per-session sizing unit for both the device
+        pool AND the host tier below it (a demoted run stores the same
+        bytes per token; see docs/generation.md "Hierarchical KV
+        tier")."""
+        return kv_bytes_per_token(self.layer_shapes, self.kv_dtype)
+
+
+def export_block_run(kcs, vcs, idx):
+    """Pure fn: gather pool rows ``idx`` out of every layer's K and V
+    pool — the device half of a demotion. Traced into one executable
+    per pow2 idx bucket by the engine (pools NOT donated: a failed
+    demotion must leave the device tier untouched)."""
+    return ([kv_gather_rows(k, idx) for k in kcs],
+            [kv_gather_rows(v, idx) for v in vcs])
+
+
+def import_block_run(kcs, vcs, k_rows, v_rows, idx):
+    """Pure fn: scatter gathered runs back into pool rows ``idx`` —
+    the device half of a restore. Padded idx entries point at
+    :data:`NULL_BLOCK` so junk writes land where nothing is ever read.
+    The engine compiles this with pools DONATED (a restore writes in
+    place), so a real failure here is a
+    :class:`~deeplearning4j_tpu.faults.CorruptedStateFault`."""
+    return ([kv_scatter_rows(k, r, idx) for k, r in zip(kcs, k_rows)],
+            [kv_scatter_rows(v, r, idx) for v, r in zip(vcs, v_rows)])
+
 
 def chain_hashes(tokens: Sequence[int], block_size: int) -> List[bytes]:
     """Chained content hash per FULL block of ``tokens``:
@@ -352,6 +382,15 @@ class PrefixIndex:
         _, block = self._entries.popitem(last=False)
         return block
 
+    def evict_lru_entry(self) -> Optional[Tuple[bytes, int]]:
+        """Like :meth:`evict_lru` but returns ``(digest, block)`` so a
+        demoting caller can key the host copy by the chained digest
+        (the engine's demote-on-evict path needs the identity, not
+        just the block to free)."""
+        if not self._entries:
+            return None
+        return self._entries.popitem(last=False)
+
     def evict_over_capacity(self) -> List[int]:
         """Evict LRU entries until within capacity; returns their
         blocks for the caller to free."""
@@ -370,12 +409,17 @@ class PrefixIndex:
 class Session:
     """One pinned conversation: the K/V-valid token prefix (prompt +
     generated tokens whose K/V were actually written) and the blocks
-    holding it. Held by :class:`SessionStore`."""
-    __slots__ = ("tokens", "blocks")
+    holding it. Held by :class:`SessionStore`. ``session_id`` is
+    stamped by :meth:`SessionStore.put` so a displaced/evicted Session
+    still knows which conversation it belongs to — the demote-on-evict
+    path keys the host-tier copy by it."""
+    __slots__ = ("tokens", "blocks", "session_id")
 
-    def __init__(self, tokens: np.ndarray, blocks: List[int]):
+    def __init__(self, tokens: np.ndarray, blocks: List[int],
+                 session_id: Optional[str] = None):
         self.tokens = tokens
         self.blocks = blocks
+        self.session_id = session_id
 
 
 class SessionStore:
@@ -420,7 +464,7 @@ class SessionStore:
         old = self._entries.pop(session_id, None)
         if old is not None:
             displaced.append(old)
-        self._entries[session_id] = Session(tokens, blocks)
+        self._entries[session_id] = Session(tokens, blocks, session_id)
         while len(self._entries) > self.capacity:
             displaced.append(self._entries.popitem(last=False)[1])
         return displaced

@@ -158,6 +158,31 @@ METRICS = {
         "kv_bf16_logit_rel_err",
     ("extra", "generation", "kv_int8_logit_rel_err"):
         "kv_int8_logit_rel_err",
+    # hierarchical KV tier (ISSUE 16): host-RAM/disk offload below the
+    # device pool — live sessions per pool-resident session (>= 10x is
+    # the acceptance bar), evicted-session re-prefills (must stay 0:
+    # every turn-2 resume restores instead of re-prefilling),
+    # restored-turn TTFT as a ratio of a hot resume (<= 2x), restore
+    # count holds the line, post-warmup recompiles stay 0 (restores
+    # reuse warmed gather/scatter executables), and the int8 host-byte
+    # shrink per block vs f32 (~3.2x at head_dim 16) — "new, skipped"
+    # until the next BENCH_*.json records a baseline, gated after
+    ("extra", "generation", "offload_sessions_per_pool_ratio"):
+        "offload_sessions_per_pool_ratio",
+    ("extra", "generation", "offload_evicted_reprefills"):
+        "offload_evicted_reprefills",
+    ("extra", "generation", "offload_restores"): "offload_restores",
+    ("extra", "generation", "offload_restore_ttft_ratio"):
+        "offload_restore_ttft_ratio",
+    ("extra", "generation", "offload_recompiles_post_warmup"):
+        "offload_recompiles_post_warmup",
+    ("extra", "generation", "offload_int8_capacity_vs_f32"):
+        "offload_int8_capacity_vs_f32",
+    # long-context generate class under the open-loop overload harness
+    # (ISSUE 16 satellite): TTFT p99 of ~13-token prompts at 2x
+    # capacity — lower is better
+    ("extra", "overload", "overload_longctx_ttft_ms_p99"):
+        "overload_longctx_ttft_p99_ms",
 }
 
 #: metric NAMES (values of METRICS) where LOWER is better — latency
@@ -183,6 +208,10 @@ LOWER_IS_BETTER = {
     "connscale_p99_ms",
     "kv_bf16_logit_rel_err",
     "kv_int8_logit_rel_err",
+    "offload_evicted_reprefills",
+    "offload_restore_ttft_ratio",
+    "offload_recompiles_post_warmup",
+    "overload_longctx_ttft_p99_ms",
 }
 
 # A LOWER_IS_BETTER metric recorded at exactly 0.0 hit its FLOOR —
@@ -193,6 +222,11 @@ LOWER_IS_BETTER = {
 ABS_CEILING_FROM_ZERO = {
     "generation_scheduler_overhead_frac": 0.05,
     "training_trace_overhead_frac": 0.05,
+    # recorded 0 is the acceptance state: ANY evicted-session
+    # re-prefill or post-warmup recompile in a fresh run is a
+    # regression (0.5 tolerates only float formatting, not one event)
+    "offload_evicted_reprefills": 0.5,
+    "offload_recompiles_post_warmup": 0.5,
 }
 
 
